@@ -1,0 +1,24 @@
+"""idc_models_tpu — a TPU-native framework for IDC histopathology classification.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+``jamesnguyen123/idc_models`` repository (see SURVEY.md): distributed
+data-parallel transfer learning (VGG16 / MobileNetV2 / DenseNet201),
+federated averaging, and secure (masked / homomorphic) aggregation —
+expressed as sharded, jitted programs over a `jax.sharding.Mesh` instead
+of tf.distribute strategies and NCCL.
+
+Layering (bottom-up):
+
+- `mesh` / `collectives`    device mesh + XLA collective wrappers (ICI/DCN)
+- `data`                    host-side loaders + host->HBM prefetch pipeline
+- `models`                  explicit-pytree model zoo (pure jnp)
+- `train`                   jitted train/eval steps, two-phase loops, metrics
+- `federated`               FedAvg with client-per-core sharding
+- `secure`                  pairwise-mask secure aggregation + Paillier parity
+- `observe`                 timers, structured logs, curve plots, profiler
+- `configs` / `cli`         the five reference preset workloads
+"""
+
+__version__ = "0.1.0"
+
+from idc_models_tpu import collectives, mesh  # noqa: F401

@@ -1,0 +1,1 @@
+from idc_models_tpu.data import synthetic  # noqa: F401

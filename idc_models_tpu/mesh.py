@@ -1,0 +1,134 @@
+"""Device-mesh construction and axis bookkeeping.
+
+This is the foundation the rest of the framework compiles against — the
+TPU-native replacement for the reference's `tf.distribute` strategy objects
+(`MirroredStrategy` at dist_model_tf_vgg.py:115, device lists at
+dist_model_tf_dense.py:16-24). Instead of a strategy that owns the step,
+we build a `jax.sharding.Mesh` and express placement with `PartitionSpec`s;
+XLA inserts the ICI/DCN collectives.
+
+Axis conventions used throughout the framework:
+
+- ``"data"``    batch / data-parallel axis (reference D1)
+- ``"model"``   tensor-parallel axis (reserved; unused by the five presets)
+- ``"client"``  federated-client axis — one client per device (reference D3)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+CLIENT_AXIS = "client"
+
+
+def force_host_devices(n: int) -> None:
+    """Ask XLA to expose `n` virtual CPU devices (must run before jax init).
+
+    Test-time stand-in for a TPU pod, mirroring how the reference's federated
+    code simulates clients inside one process (fed_model.py:184).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} {opt}".strip()
+
+
+def make_mesh(
+    axes: dict[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    ``axes`` maps axis name -> size; one size may be ``-1`` meaning "all
+    remaining devices". Default is a 1-D data-parallel mesh over every
+    visible device — the analogue of `MirroredStrategy()` enumerating GPUs.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    if axes is None:
+        axes = {DATA_AXIS: len(devices)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed axes {axes}"
+            )
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {len(devices)}")
+    grid = np.asarray(devices[:total], dtype=object).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(names))
+
+
+def data_mesh(n: int | None = None) -> Mesh:
+    """1-D data-parallel mesh (axis "data") over n (default: all) devices."""
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return make_mesh({DATA_AXIS: len(devs)}, devices=devs)
+
+
+def client_mesh(n_clients: int | None = None) -> Mesh:
+    """1-D federated mesh (axis "client"), one client per device."""
+    devs = jax.devices()
+    if n_clients is not None:
+        devs = devs[:n_clients]
+    return make_mesh({CLIENT_AXIS: len(devs)}, devices=devs)
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """NamedSharding for `spec` over `mesh` (e.g. sharding(mesh, "data"))."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Context manager installing `mesh` as the ambient mesh."""
+    with mesh:
+        yield mesh
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def initialize_multihost(coordinator: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> None:
+    """Initialize `jax.distributed` for multi-host (DCN) pods.
+
+    Replaces the reference's implicit single-process assumption: the
+    reference never runs multi-node (SURVEY.md §4); here multi-host is
+    first-class — after this call, `jax.devices()` spans the pod and every
+    mesh built above rides ICI within a host and DCN across hosts.
+    No-ops when running single-process (e.g. tests, single-chip bench).
+    """
+    if num_processes is None and coordinator is None:
+        return  # single-process
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,2 @@
+from idc_models_tpu.models import core  # noqa: F401
+from idc_models_tpu.models.small_cnn import small_cnn  # noqa: F401

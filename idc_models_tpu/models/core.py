@@ -1,0 +1,331 @@
+"""Explicit-pytree neural-network layer library (the Keras replacement).
+
+Every layer is a `Module`: a pair of pure functions
+
+    init(rng)                         -> Variables{"params", "state"}
+    apply(params, state, x, train, rng) -> (y, new_state)
+
+Parameters and mutable state (BatchNorm moving statistics) are plain nested
+dicts of jnp arrays — ordinary pytrees that `jit`, `grad`, `shard_map`,
+optax, and orbax all consume directly. There is no module instance holding
+tensors, so "clone the model per graph context" (the reference's
+fed_model.py:196-205 contortion) is just... reusing the pytree.
+
+Layout is NHWC with HWIO conv kernels — the layout XLA:TPU prefers for
+feeding the MXU. Initializers match Keras defaults (glorot_uniform kernels,
+zero biases) so parity runs start from the same distribution family as the
+reference models (e.g. secure_fed_model.py:84-98).
+
+Trainability is expressed as a boolean pytree mask consumed by
+`optax.masked` (see `trainability_mask`) instead of the reference's
+freeze/recompile dance (quirk Q6, dist_model_tf_vgg.py:141-154).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections.abc import Callable, Sequence
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Any  # nested dict pytree of jnp arrays
+State = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Variables:
+    params: Params
+    state: State
+
+
+@dataclasses.dataclass(frozen=True)
+class Module:
+    """A pure init/apply pair. `name` is used as the pytree key in Sequential."""
+
+    init: Callable[[jax.Array], Variables]
+    apply: Callable[..., tuple[jax.Array, State]]
+    name: str = "module"
+
+
+def _split(rng, n):
+    return jax.random.split(rng, n)
+
+
+# ---------------------------------------------------------------------------
+# initializers (Keras-default parity)
+# ---------------------------------------------------------------------------
+
+def glorot_uniform(rng, shape, fan_in, fan_out, dtype=jnp.float32):
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def he_normal(rng, shape, fan_in, dtype=jnp.float32):
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(rng, shape, dtype) * std
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+def dense(features_in: int, features_out: int, *, use_bias: bool = True,
+          name: str = "dense") -> Module:
+    def init(rng):
+        k = glorot_uniform(rng, (features_in, features_out),
+                           features_in, features_out)
+        p = {"kernel": k}
+        if use_bias:
+            p["bias"] = jnp.zeros((features_out,))
+        return Variables(p, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        y = x @ params["kernel"]
+        if use_bias:
+            y = y + params["bias"]
+        return y, state
+
+    return Module(init, apply, name)
+
+
+def conv2d(features_in: int, features_out: int, kernel_size: int | tuple = 3,
+           *, stride: int | tuple = 1, padding: str = "SAME",
+           use_bias: bool = True, name: str = "conv") -> Module:
+    kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else kernel_size)
+    strides = (stride, stride) if isinstance(stride, int) else stride
+
+    def init(rng):
+        fan_in = kh * kw * features_in
+        fan_out = kh * kw * features_out
+        k = glorot_uniform(rng, (kh, kw, features_in, features_out),
+                           fan_in, fan_out)
+        p = {"kernel": k}
+        if use_bias:
+            p["bias"] = jnp.zeros((features_out,))
+        return Variables(p, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype), strides, padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+    return Module(init, apply, name)
+
+
+def depthwise_conv2d(features: int, kernel_size: int | tuple = 3, *,
+                     stride: int | tuple = 1, padding: str = "SAME",
+                     use_bias: bool = False,
+                     name: str = "dwconv") -> Module:
+    """Depthwise conv (MobileNetV2 building block) via feature_group_count."""
+    kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else kernel_size)
+    strides = (stride, stride) if isinstance(stride, int) else stride
+
+    def init(rng):
+        fan_in = kh * kw
+        k = glorot_uniform(rng, (kh, kw, 1, features), fan_in, fan_in)
+        p = {"kernel": k}
+        if use_bias:
+            p["bias"] = jnp.zeros((features,))
+        return Variables(p, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype), strides, padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=features)
+        if use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+    return Module(init, apply, name)
+
+
+def batch_norm(features: int, *, momentum: float = 0.99, eps: float = 1e-3,
+               axis_name: str | None = None, name: str = "bn") -> Module:
+    """BatchNorm with explicit moving statistics.
+
+    In train mode, batch statistics are computed over the local batch; if
+    `axis_name` is given (when running under shard_map) they are averaged
+    cross-replica with `lax.pmean`, making global-batch statistics explicit —
+    the decision the reference leaves implicit to Keras (SURVEY.md §7 "hard
+    parts": BN under freeze/fine-tune). In eval mode (and for frozen
+    backbones) the stored moving stats are used.
+    """
+
+    def init(rng):
+        p = {"scale": jnp.ones((features,)), "bias": jnp.zeros((features,))}
+        s = {"mean": jnp.zeros((features,)), "var": jnp.ones((features,))}
+        return Variables(p, s)
+
+    def apply(params, state, x, *, train=False, rng=None):
+        if train:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x.astype(jnp.float32), axes)
+            second = jnp.mean(jnp.square(x.astype(jnp.float32)), axes)
+            if axis_name is not None:
+                # Average the raw moments, not per-shard variances: global
+                # var must come from global moments or it is underestimated
+                # whenever shard means differ (e.g. non-IID client shards).
+                mean = lax.pmean(mean, axis_name)
+                second = lax.pmean(second, axis_name)
+            var = second - mean**2
+            new_state = {
+                "mean": momentum * state["mean"] + (1 - momentum) * mean,
+                "var": momentum * state["var"] + (1 - momentum) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + eps) * params["scale"]
+        y = (x.astype(jnp.float32) - mean) * inv + params["bias"]
+        return y.astype(x.dtype), new_state
+
+    return Module(init, apply, name)
+
+
+def relu(name: str = "relu") -> Module:
+    return _stateless(lambda x: jax.nn.relu(x), name)
+
+
+def relu6(name: str = "relu6") -> Module:
+    return _stateless(lambda x: jnp.minimum(jax.nn.relu(x), 6.0), name)
+
+
+def _stateless(fn, name):
+    def init(rng):
+        return Variables({}, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        return fn(x), state
+
+    return Module(init, apply, name)
+
+
+def max_pool(window: int = 2, stride: int | None = None, *,
+             padding: str = "VALID", name: str = "maxpool") -> Module:
+    stride = window if stride is None else stride
+
+    def apply_fn(x):
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            (1, window, window, 1), (1, stride, stride, 1), padding)
+
+    return _stateless(apply_fn, name)
+
+
+def avg_pool(window: int = 2, stride: int | None = None, *,
+             padding: str = "VALID", name: str = "avgpool") -> Module:
+    stride = window if stride is None else stride
+
+    def apply_fn(x):
+        dims = (1, window, window, 1)
+        strides = (1, stride, stride, 1)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+        if padding == "VALID":
+            return s / (window * window)
+        # SAME: divide by the count of real (non-padded) elements per
+        # window, matching Keras AveragePooling2D edge behavior.
+        ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+        count = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+        return s / count
+
+    return _stateless(apply_fn, name)
+
+
+def global_avg_pool(name: str = "gap") -> Module:
+    """GlobalAveragePooling2D — the head junction in every reference model
+    (e.g. dist_model_tf_vgg.py:125-129)."""
+    return _stateless(lambda x: jnp.mean(x, axis=(1, 2)), name)
+
+
+def flatten(name: str = "flatten") -> Module:
+    return _stateless(lambda x: x.reshape(x.shape[0], -1), name)
+
+
+def dropout(rate: float, name: str = "dropout") -> Module:
+    def init(rng):
+        return Variables({}, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        if not train or rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError(f"dropout({name}) needs an rng in train mode")
+        keep = 1.0 - rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state
+
+    return Module(init, apply, name)
+
+
+# ---------------------------------------------------------------------------
+# composition
+# ---------------------------------------------------------------------------
+
+def sequential(layers: Sequence[Module], name: str = "sequential") -> Module:
+    """Compose modules; params/state are dicts keyed by unique layer names."""
+    keys: list[str] = []
+    used: set[str] = set()
+    for m in layers:
+        n = m.name
+        i = 0
+        while n in used:
+            n = f"{m.name}_{i}"
+            i += 1
+        used.add(n)
+        keys.append(n)
+
+    def init(rng):
+        rngs = _split(rng, len(layers))
+        params, state = {}, {}
+        for key, m, r in zip(keys, layers, rngs):
+            v = m.init(r)
+            if v.params:
+                params[key] = v.params
+            if v.state:
+                state[key] = v.state
+        return Variables(params, state)
+
+    def apply(params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+        rngs = _split(rng, len(layers)) if rng is not None else [None] * len(layers)
+        for key, m, r in zip(keys, layers, rngs):
+            p = params.get(key, {})
+            s = state.get(key, {})
+            x, s2 = m.apply(p, s, x, train=train, rng=r)
+            if key in state:
+                new_state[key] = s2
+        return x, new_state
+
+    return Module(init, apply, name)
+
+
+# ---------------------------------------------------------------------------
+# trainability masks (replaces Keras freeze/recompile — quirk Q6)
+# ---------------------------------------------------------------------------
+
+def trainability_mask(params: Params,
+                      predicate: Callable[[tuple[str, ...]], bool]):
+    """Boolean pytree over `params`: True where trainable.
+
+    `predicate` receives the path as a tuple of dict keys, e.g.
+    ("backbone", "conv1", "kernel"). Feed the result to
+    `optax.masked(optimizer, mask)` so frozen parameters receive zero
+    updates — the explicit form of the reference's
+    `base_model.trainable=False` + recompile (dist_model_tf_vgg.py:122,141-154).
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: predicate(tuple(p.key for p in path)), params)
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))

@@ -1,0 +1,33 @@
+"""The small custom CNN used by the secure-federated workload.
+
+Capability parity with the reference's `create_model`
+(secure_fed_model.py:84-98): Conv2D(32, 3x3, stride 2, relu) -> MaxPool(2x2)
+-> Dropout(0.25) -> Flatten -> Dense(8, relu) -> Dropout(0.5) -> Dense(1)
+for 10x10x3 inputs, binary logits.
+"""
+
+from __future__ import annotations
+
+from idc_models_tpu.models import core
+
+
+def small_cnn(input_size: int = 10, channels: int = 3,
+              num_outputs: int = 1) -> core.Module:
+    # stride-2 SAME conv: 10x10 -> 5x5; maxpool 2x2 VALID: 5x5 -> 2x2
+    conv_out = (input_size + 1) // 2
+    pooled = conv_out // 2
+    flat = pooled * pooled * 32
+    return core.sequential(
+        [
+            core.conv2d(channels, 32, 3, stride=2, padding="SAME", name="conv1"),
+            core.relu(),
+            core.max_pool(2, name="pool1"),
+            core.dropout(0.25, name="drop1"),
+            core.flatten(),
+            core.dense(flat, 8, name="fc1"),
+            core.relu(name="relu_1"),
+            core.dropout(0.5, name="drop2"),
+            core.dense(8, num_outputs, name="head"),
+        ],
+        name="small_cnn",
+    )

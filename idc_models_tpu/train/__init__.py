@@ -1,0 +1,9 @@
+from idc_models_tpu.train import losses, metrics, state, step  # noqa: F401
+from idc_models_tpu.train.state import TrainState, create_train_state, rmsprop  # noqa: F401
+from idc_models_tpu.train.step import (  # noqa: F401
+    jit_data_parallel,
+    make_eval_step,
+    make_train_step,
+    replicate,
+    shard_batch,
+)

@@ -1,0 +1,28 @@
+"""Loss functions (from-logits, matching the reference's compile() choices)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def binary_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """BCE from logits; logits [B,1] or [B], labels [B] in {0,1}.
+    Parity: BinaryCrossentropy(from_logits=True), dist_model_tf_vgg.py:131."""
+    logits = logits.reshape(-1)
+    labels = labels.reshape(-1).astype(logits.dtype)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+
+def sparse_categorical_cross_entropy(logits: jax.Array,
+                                     labels: jax.Array) -> jax.Array:
+    """Softmax CE against integer labels.
+
+    The reference uses dense `CategoricalCrossentropy` against integer
+    labels (quirk Q4, dist_model_tf_dense.py:143) — a bug; the framework
+    uses the intended sparse loss.
+    """
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels.astype(jnp.int32)))

@@ -1,0 +1,52 @@
+"""On-device metrics in jnp: accuracy, binary accuracy, AUROC.
+
+Parity targets: Keras `metrics=['accuracy']` (dist_model_tf_vgg.py:132),
+`BinaryAccuracy` (fed_model.py:205), and `roc_auc_score` wrapped in a
+py_func (quirk-free replacement for secure_fed_model.py:81-82 — here AUROC
+is computed on-device with a sort, no host round-trip).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Multiclass accuracy; logits [B,C], integer labels [B]."""
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+
+def binary_accuracy(logits: jax.Array, labels: jax.Array,
+                    threshold: float = 0.0) -> jax.Array:
+    """Binary accuracy on logits (threshold 0 == probability 0.5)."""
+    pred = (logits.reshape(-1) > threshold)
+    return jnp.mean((pred == (labels.reshape(-1) > 0.5)).astype(jnp.float32))
+
+
+def auroc(scores: jax.Array, labels: jax.Array) -> jax.Array:
+    """AUROC via the rank-sum (Mann-Whitney U) identity, with tie handling.
+
+    Entirely on-device (sort + segment ops); matches
+    sklearn.metrics.roc_auc_score on tied and untied inputs.
+    """
+    scores = scores.reshape(-1).astype(jnp.float32)
+    labels = (labels.reshape(-1) > 0.5).astype(jnp.float32)
+    n = scores.shape[0]
+    order = jnp.argsort(scores)
+    s = scores[order]
+    l = labels[order]
+    # average ranks over ties: rank_i = mean of positions of equal scores
+    idx = jnp.arange(n, dtype=jnp.float32)
+    # For each element, first and last index of its tie group.
+    is_new = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    group = jnp.cumsum(is_new) - 1  # group id per sorted element
+    group_sum = jax.ops.segment_sum(idx, group, num_segments=n)
+    group_cnt = jax.ops.segment_sum(jnp.ones_like(idx), group, num_segments=n)
+    avg_rank = (group_sum / jnp.maximum(group_cnt, 1.0))[group] + 1.0  # 1-based
+    n_pos = jnp.sum(l)
+    n_neg = n - n_pos
+    rank_sum_pos = jnp.sum(avg_rank * l)
+    u = rank_sum_pos - n_pos * (n_pos + 1.0) / 2.0
+    denom = jnp.maximum(n_pos * n_neg, 1.0)
+    return jnp.where((n_pos == 0) | (n_neg == 0), jnp.nan, u / denom)

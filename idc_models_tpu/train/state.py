@@ -1,0 +1,55 @@
+"""Training state: the explicit pytree that replaces Keras' compiled model.
+
+The reference never owns its step function — `model.fit` / TFF internals do
+(SURVEY.md §3.5). Here the full state (params, BN stats, optimizer state,
+step counter) is one pytree, so checkpointing, federated broadcast, secure
+masking, and sharding all operate on it uniformly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from idc_models_tpu.models import core
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    model_state: Any          # BatchNorm moving statistics etc.
+    opt_state: Any
+
+    def replace(self, **kw) -> "TrainState":
+        return dataclasses.replace(self, **kw)
+
+
+def create_train_state(model: core.Module, optimizer: optax.GradientTransformation,
+                       rng: jax.Array) -> TrainState:
+    variables = model.init(rng)
+    opt_state = optimizer.init(variables.params)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=variables.params,
+        model_state=variables.state,
+        opt_state=opt_state,
+    )
+
+
+def rmsprop(learning_rate: float, *, rho: float = 0.9, eps: float = 1e-7,
+            trainable_mask: Any | None = None) -> optax.GradientTransformation:
+    """RMSprop matching Keras defaults (the reference's only optimizer —
+    dist_model_tf_vgg.py:130, fed_model.py:208), with an optional
+    trainability mask replacing freeze/recompile (quirk Q6)."""
+    # eps_in_sqrt=False: Keras updates with g / (sqrt(nu) + eps); optax's
+    # default puts eps inside the sqrt, which damps very differently at nu~0.
+    opt = optax.rmsprop(learning_rate, decay=rho, eps=eps, eps_in_sqrt=False)
+    if trainable_mask is not None:
+        opt = optax.masked(opt, trainable_mask)
+    return opt

@@ -1,0 +1,130 @@
+"""The jitted train/eval step — the framework owns it explicitly.
+
+In the reference the step function is hidden inside `model.fit` and
+MirroredStrategy (forward+backward per replica, NCCL allreduce, mirrored
+update — SURVEY.md §3.1 "HOT LOOP"). Here it is one pure function:
+
+    loss -> grad -> (XLA-inserted allreduce over the "data" mesh axis) ->
+    optax update -> new TrainState
+
+Data parallelism uses the modern jit-with-shardings style: the global batch
+is sharded over the mesh's "data" axis, parameters are replicated, and XLA
+lowers the gradient reduction onto ICI automatically — there is no pmap and
+no hand-written collective in the hot path. (The explicit-collective style
+still exists in this framework where per-device control genuinely matters:
+federated and secure aggregation use `shard_map` + `collectives`.)
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.models import core
+from idc_models_tpu.train import metrics as metrics_lib
+from idc_models_tpu.train.state import TrainState
+
+LossFn = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def make_train_step(model: core.Module, optimizer: optax.GradientTransformation,
+                    loss_fn: LossFn, *, compute_dtype=jnp.float32):
+    """Returns train_step(state, images, labels, rng) -> (state, metrics)."""
+
+    def train_step(state: TrainState, images, labels, rng):
+        images = images.astype(compute_dtype)
+
+        def loss_of(params):
+            logits, new_model_state = model.apply(
+                params, state.model_state, images, train=True, rng=rng)
+            logits = logits.astype(jnp.float32)
+            return loss_fn(logits, labels), (logits, new_model_state)
+
+        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state.params)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        out = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_model_state,
+            opt_state=new_opt_state,
+        )
+        m = {"loss": loss, "accuracy": _auto_accuracy(logits, labels)}
+        return out, m
+
+    return train_step
+
+
+def make_eval_step(model: core.Module, loss_fn: LossFn, *,
+                   compute_dtype=jnp.float32):
+    """Returns eval_step(state, images, labels) -> metrics (loss/acc/logits)."""
+
+    def eval_step(state: TrainState, images, labels):
+        images = images.astype(compute_dtype)
+        logits, _ = model.apply(state.params, state.model_state, images,
+                                train=False)
+        logits = logits.astype(jnp.float32)
+        return {
+            "loss": loss_fn(logits, labels),
+            "accuracy": _auto_accuracy(logits, labels),
+            "logits": logits,
+        }
+
+    return eval_step
+
+
+def _auto_accuracy(logits, labels):
+    if logits.ndim == 2 and logits.shape[-1] > 1:
+        return metrics_lib.accuracy(logits, labels)
+    return metrics_lib.binary_accuracy(logits, labels)
+
+
+# ---------------------------------------------------------------------------
+# data-parallel jit wrappers
+# ---------------------------------------------------------------------------
+
+def jit_data_parallel(step_fn, mesh: Mesh, *, donate_state: bool = True,
+                      extra_batch_args: int = 0):
+    """Jit `step_fn(state, images, labels, *rest)` with DP shardings.
+
+    State replicated; images/labels (and `extra_batch_args` further
+    positional args) sharded on their leading axis over the "data" mesh
+    axis. This is the whole MirroredStrategy replacement for D1.
+    """
+    repl = meshlib.replicated(mesh)
+    batch = meshlib.sharding(mesh, meshlib.DATA_AXIS)
+    n_batch = 2 + extra_batch_args
+    in_shardings = (repl,) + (batch,) * n_batch
+    return jax.jit(
+        step_fn,
+        in_shardings=in_shardings + (repl,) if _wants_rng(step_fn) else in_shardings,
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+
+def _wants_rng(fn) -> bool:
+    import inspect
+
+    try:
+        return "rng" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Device_put host arrays sharded over the "data" axis of `mesh`."""
+    sh = meshlib.sharding(mesh, meshlib.DATA_AXIS)
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def replicate(mesh: Mesh, tree):
+    """Device_put a pytree fully replicated over `mesh`."""
+    return jax.device_put(tree, meshlib.replicated(mesh))

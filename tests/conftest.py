@@ -1,0 +1,33 @@
+"""Test harness: fake an 8-device TPU pod with virtual CPU devices.
+
+Must run before jax initializes — pytest imports conftest first, so setting
+the env here is sufficient as long as no test module imports jax at
+collection time before this file executes (pytest guarantees conftest.py
+is imported before test modules).
+"""
+
+import os
+
+# Force CPU: the ambient environment may point JAX_PLATFORMS at a real
+# (single) TPU chip; tests need the 8-device virtual pod instead. jax may
+# already be preloaded into the interpreter, so set the platform through
+# jax.config (env vars would be read too late) — the XLA_FLAGS below are
+# still honored because the CPU backend is only created on first use.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from idc_models_tpu import mesh as _meshlib  # noqa: E402
+
+_meshlib.force_host_devices(8)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

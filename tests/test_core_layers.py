@@ -1,0 +1,86 @@
+"""Unit tests for the explicit-pytree layer library."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu.models import core, small_cnn
+
+
+def test_dense_shapes():
+    m = core.dense(16, 4)
+    v = m.init(jax.random.key(0))
+    y, _ = m.apply(v.params, v.state, jnp.ones((2, 16)))
+    assert y.shape == (2, 4)
+
+
+def test_conv_shapes_and_stride():
+    m = core.conv2d(3, 8, 3, stride=2, padding="SAME")
+    v = m.init(jax.random.key(0))
+    y, _ = m.apply(v.params, v.state, jnp.ones((2, 10, 10, 3)))
+    assert y.shape == (2, 5, 5, 8)
+
+
+def test_depthwise_conv():
+    m = core.depthwise_conv2d(6, 3)
+    v = m.init(jax.random.key(0))
+    y, _ = m.apply(v.params, v.state, jnp.ones((2, 8, 8, 6)))
+    assert y.shape == (2, 8, 8, 6)
+    assert v.params["kernel"].shape == (3, 3, 1, 6)
+
+
+def test_batch_norm_train_vs_eval():
+    m = core.batch_norm(4, momentum=0.5)
+    v = m.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (32, 4)) * 3 + 1
+    y, new_state = m.apply(v.params, v.state, x, train=True)
+    # normalized output: ~zero mean, ~unit var
+    np.testing.assert_allclose(np.mean(y, 0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.std(y, 0), 1.0, atol=1e-2)
+    # moving stats moved toward batch stats
+    assert not np.allclose(new_state["mean"], v.state["mean"])
+    # eval mode uses stored stats and does not update them
+    y2, s2 = m.apply(v.params, new_state, x, train=False)
+    assert s2 is new_state
+
+
+def test_maxpool_matches_numpy():
+    m = core.max_pool(2)
+    v = m.init(jax.random.key(0))
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = m.apply(v.params, v.state, x)
+    expect = np.array([[5, 7], [13, 15]], np.float32).reshape(1, 2, 2, 1)
+    np.testing.assert_array_equal(np.asarray(y), expect)
+
+
+def test_dropout_train_eval():
+    m = core.dropout(0.5)
+    v = m.init(jax.random.key(0))
+    x = jnp.ones((4, 100))
+    y_eval, _ = m.apply(v.params, v.state, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_tr, _ = m.apply(v.params, v.state, x, train=True, rng=jax.random.key(1))
+    zeros = float(jnp.mean(y_tr == 0))
+    assert 0.3 < zeros < 0.7
+    # surviving entries are scaled by 1/keep
+    assert float(jnp.max(y_tr)) == 2.0
+
+
+def test_small_cnn_forward_and_param_count():
+    m = small_cnn(10, 3, 1)
+    v = m.init(jax.random.key(0))
+    y, _ = m.apply(v.params, v.state, jnp.ones((5, 10, 10, 3)),
+                   train=True, rng=jax.random.key(1))
+    assert y.shape == (5, 1)
+    # conv: 3*3*3*32+32 = 896 ; fc1: (2*2*32)*8+8 = 1032 ; head: 8+1 = 9
+    assert core.count_params(v.params) == 896 + 1032 + 9
+
+
+def test_trainability_mask():
+    m = small_cnn(10, 3, 1)
+    v = m.init(jax.random.key(0))
+    mask = core.trainability_mask(v.params, lambda path: path[0] == "head")
+    flat = jax.tree_util.tree_flatten_with_path(mask)[0]
+    for path, val in flat:
+        keys = tuple(p.key for p in path)
+        assert val == (keys[0] == "head")

@@ -1,0 +1,82 @@
+"""Slice 0 end-to-end: small CNN + DP train step over an 8-device mesh.
+
+Asserts (a) the mesh/jit/sharding machinery compiles and runs, (b) loss
+decreases on learnable synthetic data, (c) 8-device data-parallel training
+is numerically equivalent to single-device training on the same global
+batch (the defining property of MirroredStrategy-style DP, reference D1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.models import small_cnn
+from idc_models_tpu.train import (
+    create_train_state, jit_data_parallel, make_eval_step, make_train_step,
+    replicate, rmsprop, shard_batch,
+)
+from idc_models_tpu.train.losses import binary_cross_entropy
+
+
+def _setup(mesh):
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    train_step = make_train_step(model, opt, binary_cross_entropy)
+    return model, opt, state, train_step
+
+
+def test_loss_decreases_on_8_device_mesh(devices):
+    mesh = meshlib.data_mesh(8)
+    model, opt, state, train_step = _setup(mesh)
+    step = jit_data_parallel(train_step, mesh)
+    imgs, labels = synthetic.make_idc_like(256, size=10, seed=0)
+    state = replicate(mesh, state)
+
+    losses = []
+    key = jax.random.key(42)
+    for i in range(30):
+        key, sub = jax.random.split(key)
+        x, y = shard_batch(mesh, imgs, labels)
+        state, m = step(state, x, y, sub)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses
+    assert int(state.step) == 30
+
+
+def test_dp_equals_single_device(devices):
+    imgs, labels = synthetic.make_idc_like(64, size=10, seed=1)
+    key = jax.random.key(7)
+
+    def run(n_dev):
+        mesh = meshlib.data_mesh(n_dev)
+        model, opt, state, train_step = _setup(mesh)
+        step = jit_data_parallel(train_step, mesh)
+        state = replicate(mesh, state)
+        k = key
+        for _ in range(5):
+            k, sub = jax.random.split(k)
+            x, y = shard_batch(mesh, imgs, labels)
+            state, m = step(state, x, y, sub)
+        return jax.device_get(state.params), float(m["loss"])
+
+    p8, l8 = run(8)
+    p1, l1 = run(1)
+    np.testing.assert_allclose(l8, l1, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p8), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_eval_step(devices):
+    mesh = meshlib.data_mesh(8)
+    model, opt, state, _ = _setup(mesh)
+    eval_step = jit_data_parallel(make_eval_step(model, binary_cross_entropy),
+                                  mesh, donate_state=False)
+    imgs, labels = synthetic.make_idc_like(64, size=10, seed=2)
+    state = replicate(mesh, state)
+    x, y = shard_batch(mesh, imgs, labels)
+    m = eval_step(state, x, y)
+    assert 0.0 <= float(m["accuracy"]) <= 1.0
+    assert np.isfinite(float(m["loss"]))
