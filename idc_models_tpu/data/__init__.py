@@ -1,1 +1,7 @@
-from idc_models_tpu.data import synthetic  # noqa: F401
+from idc_models_tpu.data import cifar10, idc, partition, pipeline, synthetic  # noqa: F401
+from idc_models_tpu.data.idc import (  # noqa: F401
+    ArrayDataset,
+    load_directory,
+    train_val_test_split,
+)
+from idc_models_tpu.data.pipeline import Loader, prefetch_to_mesh  # noqa: F401

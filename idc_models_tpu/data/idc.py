@@ -1,0 +1,122 @@
+"""IDC directory-tree image loader.
+
+Capability parity with the reference's C1/C4 pipeline (`get_label` /
+`decode_img` / `process_path` / take-skip split, dist_model_tf_vgg.py:34-45,
+105-110): a labeled dataset is built from `<root>/.../<label>/<file>.png`
+where the label is the file's parent directory name ('0'/'1'), images are
+decoded to float32 in [0,1] and resized.
+
+Deliberate behavior fixes over the reference (SURVEY.md quirks):
+- Q1: the reference's `list_files` reshuffles per iteration so its
+  take/skip train/val/test split re-deals files every epoch — here the
+  file list is sorted, shuffled once with a seed, and the split is
+  materialized.
+- Q2: the discarded `.shuffle()` no-op is simply not reproduced.
+
+Decoding runs in a host-side thread pool (PNG decode releases the GIL in
+zlib/PIL) — the framework's stand-in for tf.data's C++ runtime until the
+native loader (idc_models_tpu.data.native) takes over.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayDataset:
+    """An in-memory labeled image dataset (NHWC float32 in [0,1])."""
+
+    images: np.ndarray
+    labels: np.ndarray
+
+    def __post_init__(self):
+        assert len(self.images) == len(self.labels)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def take(self, n: int) -> "ArrayDataset":
+        return ArrayDataset(self.images[:n], self.labels[:n])
+
+    def skip(self, n: int) -> "ArrayDataset":
+        return ArrayDataset(self.images[n:], self.labels[n:])
+
+    def shard(self, num_shards: int, index: int) -> "ArrayDataset":
+        """Strided shard, matching tf.data `Dataset.shard` semantics
+        (used for secure-fed clients, secure_fed_model.py:206-210)."""
+        return ArrayDataset(self.images[index::num_shards],
+                            self.labels[index::num_shards])
+
+    def shuffled(self, seed: int) -> "ArrayDataset":
+        perm = np.random.default_rng(seed).permutation(len(self))
+        return ArrayDataset(self.images[perm], self.labels[perm])
+
+
+def list_labeled_files(root: str | os.PathLike,
+                       pattern: str = "*/*.png") -> list[tuple[str, int]]:
+    """Sorted (path, label) pairs; label = parent directory name == '1'."""
+    root = Path(root)
+    files = sorted(root.glob(pattern))
+    return [(str(f), int(f.parent.name == "1")) for f in files
+            if f.parent.name in ("0", "1")]
+
+
+def _decode_one(path: str, size: int) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        if im.size != (size, size):
+            im = im.resize((size, size), Image.BILINEAR)
+        return np.asarray(im, np.float32) / 255.0
+
+
+def load_directory(root: str | os.PathLike, *, image_size: int = 50,
+                   limit: int | None = None, seed: int = 0,
+                   workers: int = 16) -> ArrayDataset:
+    """Load the `<root>/<label>/*.png` tree into an ArrayDataset.
+
+    The file list is deterministically shuffled with `seed` before an
+    optional `limit` is applied (the reference's balanced_IDC_30k subset is
+    a pre-balanced directory; `limit` supports the same "first N of a
+    shuffled list" usage without per-epoch reshuffle leakage).
+    """
+    pairs = list_labeled_files(root)
+    if not pairs:
+        raise FileNotFoundError(f"no <label>/*.png files under {root}")
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(pairs))
+    pairs = [pairs[i] for i in order]
+    if limit is not None:
+        pairs = pairs[:limit]
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        imgs = list(pool.map(lambda p: _decode_one(p[0], image_size), pairs))
+    images = np.stack(imgs)
+    labels = np.asarray([l for _, l in pairs], np.int32)
+    return ArrayDataset(images, labels)
+
+
+def train_val_test_split(ds: ArrayDataset,
+                         fractions: tuple[float, float, float] = (0.8, 0.1, 0.1),
+                         *, seed: int | None = None,
+                         ) -> tuple[ArrayDataset, ArrayDataset, ArrayDataset]:
+    """Deterministic materialized split (fixes quirk Q1).
+
+    If `seed` is given the dataset is shuffled first; the split sizes follow
+    the reference's 80/10/10 take/skip scheme (dist_model_tf_vgg.py:10-13).
+    """
+    if seed is not None:
+        ds = ds.shuffled(seed)
+    n = len(ds)
+    n_train = int(fractions[0] * n)
+    n_val = int(fractions[1] * n)
+    train = ds.take(n_train)
+    val = ds.skip(n_train).take(n_val)
+    test = ds.skip(n_train + n_val)
+    return train, val, test

@@ -1,0 +1,55 @@
+"""Federated client partitioning: IID / non-IID shards.
+
+Capability parity with the reference's `get_data` + client sharding
+(C9/C10): IID = globally shuffled examples cut into contiguous
+equal-size client shards (fed_model.py:150-165); non-IID = all class-1
+examples concatenated before class-0 so contiguous shards are label-skewed
+(fed_model.py:161-165); secure-fed uses strided `shard(N, i)` instead
+(secure_fed_model.py:206-210, available as `ArrayDataset.shard`).
+
+Shards are materialized as a stacked [num_clients, client_size, ...] array
+so the federated trainer can lay clients out along the "client" mesh axis
+with one device_put — deterministic per client with no host round-trips
+(SURVEY.md "hard parts": non-IID determinism).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from idc_models_tpu.data.idc import ArrayDataset
+
+
+def partition_clients(ds: ArrayDataset, num_clients: int, *, iid: bool,
+                      seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Returns (images [C, S, H, W, 3], labels [C, S]) client shards.
+
+    S = len(ds) // num_clients; surplus examples are dropped (the
+    reference's CLIENT_SIZE arithmetic, fed_model.py:58).
+    """
+    if num_clients <= 0:
+        raise ValueError("num_clients must be positive")
+    n = len(ds)
+    client_size = n // num_clients
+    if client_size == 0:
+        raise ValueError(f"{n} examples cannot feed {num_clients} clients")
+    if iid:
+        order = np.random.default_rng(seed).permutation(n)
+    else:
+        # class-1 first, then class-0, each deterministically shuffled
+        # within class — contiguous shards become label-skewed.
+        rng = np.random.default_rng(seed)
+        pos = np.flatnonzero(ds.labels == 1)
+        neg = np.flatnonzero(ds.labels != 1)
+        order = np.concatenate([rng.permutation(pos), rng.permutation(neg)])
+    order = order[:client_size * num_clients]
+    idx = order.reshape(num_clients, client_size)
+    return ds.images[idx], ds.labels[idx]
+
+
+def train_test_client_split(num_clients: int, test_fraction: float = 0.2,
+                            *, seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Split client *ids* into train/test populations (fed_model.py:47-49)."""
+    ids = np.random.default_rng(seed).permutation(num_clients)
+    n_test = max(1, int(round(test_fraction * num_clients)))
+    return np.sort(ids[n_test:]), np.sort(ids[:n_test])

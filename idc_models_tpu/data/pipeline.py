@@ -1,0 +1,137 @@
+"""Host→HBM input pipeline: batching, shuffling, and prefetch.
+
+The TPU-native replacement for the reference's `prepare_for_training`
+(cache → shuffle(1000) → batch → prefetch(AUTOTUNE), e.g.
+dist_model_tf_vgg.py:47-65). Data lives in host RAM as numpy (the cache);
+per-epoch order is a fresh seeded permutation (the shuffle); batches are
+cut to a multiple of the mesh's data-axis size; and a background thread
+keeps `prefetch` batches already transferred to device HBM with the right
+NamedSharding (the prefetch) so the chips never wait on PCIe/host.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections.abc import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data.idc import ArrayDataset
+
+
+class Loader:
+    """Iterates (images, labels) numpy batches over epochs.
+
+    - `shuffle`: new seeded permutation each epoch (epoch mixed into seed)
+    - `drop_remainder`: required under data parallelism so every step's
+      global batch divides the mesh; the reference gets this implicitly
+      from fixed-size take/skip splits
+    """
+
+    def __init__(self, ds: ArrayDataset, batch_size: int, *,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_remainder: bool = True):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if len(ds) < batch_size and drop_remainder:
+            raise ValueError(
+                f"dataset of {len(ds)} examples yields zero batches of "
+                f"size {batch_size} with drop_remainder")
+        self.ds = ds
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+
+    def __len__(self) -> int:
+        n = len(self.ds)
+        return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
+
+    def epoch(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        n = len(self.ds)
+        if self.shuffle:
+            order = np.random.default_rng((self.seed, epoch)).permutation(n)
+        else:
+            order = np.arange(n)
+        stop = (n // self.batch_size * self.batch_size
+                if self.drop_remainder else n)
+        for i in range(0, stop, self.batch_size):
+            idx = order[i:i + self.batch_size]
+            yield self.ds.images[idx], self.ds.labels[idx]
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+def prefetch_to_mesh(batches: Iterator, mesh: Mesh, *, axis=meshlib.DATA_AXIS,
+                     prefetch: int = 2) -> Iterator:
+    """Background-thread device_put: yields batches already resident in HBM.
+
+    Each incoming (images, labels) batch is placed with its leading axis
+    sharded over `axis`. A bounded queue of `prefetch` in-flight transfers
+    overlaps host decode/transfer with device compute — the AUTOTUNE
+    prefetch of the reference, made explicit.
+    """
+    sh = meshlib.sharding(mesh, axis)
+    q: queue.Queue = queue.Queue(maxsize=prefetch)
+    stop = threading.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        # Bounded put that gives up when the consumer is gone — otherwise
+        # an abandoned iterator would leave this thread blocked forever,
+        # pinning `prefetch` HBM-resident batches.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for batch in batches:
+                if not put(jax.tree.map(lambda a: jax.device_put(a, sh),
+                                        batch)):
+                    return
+        except BaseException as e:  # surface errors to the consumer
+            put(e)
+            return
+        put(_END)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+def pad_to_multiple(images: np.ndarray, labels: np.ndarray,
+                    multiple: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad a final partial batch up to `multiple`, returning a validity mask.
+
+    Used by eval loops that must see every example exactly once while still
+    dividing the mesh (training uses drop_remainder instead).
+    """
+    n = len(images)
+    pad = (-n) % multiple
+    if pad == 0:
+        return images, labels, np.ones(n, bool)
+    images = np.concatenate([images, np.zeros((pad,) + images.shape[1:],
+                                              images.dtype)])
+    labels = np.concatenate([labels, np.zeros((pad,) + labels.shape[1:],
+                                              labels.dtype)])
+    mask = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+    return images, labels, mask

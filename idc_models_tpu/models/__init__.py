@@ -1,2 +1,6 @@
-from idc_models_tpu.models import core  # noqa: F401
+from idc_models_tpu.models import core, densenet, mobilenet, registry, vgg  # noqa: F401
+from idc_models_tpu.models.densenet import densenet201  # noqa: F401
+from idc_models_tpu.models.mobilenet import mobilenet_v2  # noqa: F401
+from idc_models_tpu.models.registry import REGISTRY, get_model  # noqa: F401
 from idc_models_tpu.models.small_cnn import small_cnn  # noqa: F401
+from idc_models_tpu.models.vgg import vgg16  # noqa: F401

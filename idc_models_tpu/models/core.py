@@ -17,8 +17,8 @@ zero biases) so parity runs start from the same distribution family as the
 reference models (e.g. secure_fed_model.py:84-98).
 
 Trainability is expressed as a boolean pytree mask consumed by
-`optax.masked` (see `trainability_mask`) instead of the reference's
-freeze/recompile dance (quirk Q6, dist_model_tf_vgg.py:141-154).
+`train.state.freeze_where` (see `trainability_mask`) instead of the
+reference's freeze/recompile dance (quirk Q6, dist_model_tf_vgg.py:141-154).
 """
 
 from __future__ import annotations
@@ -93,8 +93,13 @@ def dense(features_in: int, features_out: int, *, use_bias: bool = True,
 
 
 def conv2d(features_in: int, features_out: int, kernel_size: int | tuple = 3,
-           *, stride: int | tuple = 1, padding: str = "SAME",
+           *, stride: int | tuple = 1,
+           padding: str | tuple = "SAME",
            use_bias: bool = True, name: str = "conv") -> Module:
+    """2-D convolution. `padding` is "SAME"/"VALID" or explicit
+    ((lo_h, hi_h), (lo_w, hi_w)) pairs — the explicit form is needed where
+    Keras uses symmetric ZeroPadding2D + valid conv (e.g. the DenseNet
+    stem), which lax SAME (asymmetric lo<=hi split) does not reproduce."""
     kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
               else kernel_size)
     strides = (stride, stride) if isinstance(stride, int) else stride
@@ -109,9 +114,11 @@ def conv2d(features_in: int, features_out: int, kernel_size: int | tuple = 3,
             p["bias"] = jnp.zeros((features_out,))
         return Variables(p, {})
 
+    pad = padding if isinstance(padding, str) else [tuple(p) for p in padding]
+
     def apply(params, state, x, *, train=False, rng=None):
         y = lax.conv_general_dilated(
-            x, params["kernel"].astype(x.dtype), strides, padding,
+            x, params["kernel"].astype(x.dtype), strides, pad,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if use_bias:
             y = y + params["bias"].astype(y.dtype)
@@ -150,15 +157,21 @@ def depthwise_conv2d(features: int, kernel_size: int | tuple = 3, *,
 
 
 def batch_norm(features: int, *, momentum: float = 0.99, eps: float = 1e-3,
-               axis_name: str | None = None, name: str = "bn") -> Module:
+               axis_name: str | None = None, frozen: bool = False,
+               name: str = "bn") -> Module:
     """BatchNorm with explicit moving statistics.
 
     In train mode, batch statistics are computed over the local batch; if
     `axis_name` is given (when running under shard_map) they are averaged
     cross-replica with `lax.pmean`, making global-batch statistics explicit —
     the decision the reference leaves implicit to Keras (SURVEY.md §7 "hard
-    parts": BN under freeze/fine-tune). In eval mode (and for frozen
-    backbones) the stored moving stats are used.
+    parts": BN under freeze/fine-tune). In eval mode the stored moving
+    stats are used.
+
+    `frozen=True` reproduces Keras' `trainable=False` BN semantics: the
+    layer always runs in inference mode (moving stats, no updates) even
+    when the model is applied with train=True — required so a frozen
+    pretrained backbone's function does not drift under a training head.
     """
 
     def init(rng):
@@ -167,7 +180,7 @@ def batch_norm(features: int, *, momentum: float = 0.99, eps: float = 1e-3,
         return Variables(p, s)
 
     def apply(params, state, x, *, train=False, rng=None):
-        if train:
+        if train and not frozen:
             axes = tuple(range(x.ndim - 1))
             mean = jnp.mean(x.astype(jnp.float32), axes)
             second = jnp.mean(jnp.square(x.astype(jnp.float32)), axes)
@@ -319,9 +332,11 @@ def trainability_mask(params: Params,
 
     `predicate` receives the path as a tuple of dict keys, e.g.
     ("backbone", "conv1", "kernel"). Feed the result to
-    `optax.masked(optimizer, mask)` so frozen parameters receive zero
-    updates — the explicit form of the reference's
-    `base_model.trainable=False` + recompile (dist_model_tf_vgg.py:122,141-154).
+    `train.state.freeze_where(optimizer, mask)` so frozen parameters
+    receive zero updates — the explicit form of the reference's
+    `base_model.trainable=False` + recompile (dist_model_tf_vgg.py:122,
+    141-154). (Do NOT use bare `optax.masked`: it passes raw gradients
+    through False leaves instead of zeroing them.)
     """
     return jax.tree_util.tree_map_with_path(
         lambda path, _: predicate(tuple(p.key for p in path)), params)
@@ -329,3 +344,23 @@ def trainability_mask(params: Params,
 
 def count_params(params: Params) -> int:
     return sum(x.size for x in jax.tree.leaves(params))
+
+
+def head_only_mask(params: Params):
+    """Phase-1 transfer-learning mask: only the "head" subtree trains."""
+    return trainability_mask(params, lambda p: p[0] == "head")
+
+
+def keras_fine_tune_mask(params: Params, index_map: dict[str, int],
+                         fine_tune_at: int):
+    """Phase-2 mask: head + backbone layers whose Keras layer index (from
+    the model's KERAS_LAYER_INDEX map) is >= fine_tune_at — the exact
+    semantics of the reference's `for layer in model.layers[:fine_tune_at]:
+    layer.trainable = False` (dist_model_tf_vgg.py:144-147)."""
+
+    def pred(path):
+        if path[0] == "head":
+            return True
+        return index_map.get(path[1], -1) >= fine_tune_at
+
+    return trainability_mask(params, pred)

@@ -1,0 +1,197 @@
+"""DenseNet201 backbone + transfer-learning head.
+
+Capability parity with the reference's dense preset
+(dist_model_tf_dense.py:131-141): DenseNet201 without top, GAP, Dense(10)
+softmax-logits head for CIFAR-10, fine_tune_at=150
+(dist_model_tf_dense.py:158).
+
+Architecture follows keras.applications DenseNet201: stem conv(64,7x7,s2)
+-> maxpool -> dense blocks [6,12,48,32] (growth 32; each layer is
+BN-ReLU-conv1x1(128) -> BN-ReLU-conv3x3(32) -> concat) with 0.5-compression
+transitions, final BN+ReLU. All convs bias-free; BN eps=1.001e-5. Total
+params (incl. BN moving stats) = 18,321,984, matching Keras
+include_top=False.
+
+`KERAS_LAYER_INDEX` reproduces Keras' flat layer numbering so the
+reference's `fine_tune_at=150` (an index into `base_model.layers`, landing
+inside conv4_block2) selects the same parameters here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from idc_models_tpu.models import core
+
+_BLOCKS = [6, 12, 48, 32]
+_GROWTH = 32
+_BN = dict(eps=1.001e-5, momentum=0.99)
+
+KERAS_LAYER_INDEX: dict[str, int] = {}
+
+
+def _build_index():
+    i = 0
+    idx = {}
+
+    def layer(name=None):
+        nonlocal i
+        if name is not None:
+            idx[name] = i
+        i += 1
+
+    layer()                       # InputLayer
+    layer()                       # ZeroPadding2D
+    layer("conv1_conv")
+    layer("conv1_bn")
+    layer()                       # conv1_relu
+    layer()                       # ZeroPadding2D
+    layer()                       # pool1
+    for stage, n_layers in enumerate(_BLOCKS, start=2):
+        for l in range(1, n_layers + 1):
+            p = f"conv{stage}_block{l}"
+            layer(f"{p}_0_bn")
+            layer()               # 0_relu
+            layer(f"{p}_1_conv")
+            layer(f"{p}_1_bn")
+            layer()               # 1_relu
+            layer(f"{p}_2_conv")
+            layer()               # concat
+        if stage < 5:
+            layer(f"pool{stage}_bn")
+            layer()               # pool relu
+            layer(f"pool{stage}_conv")
+            layer()               # avgpool
+    layer("bn")
+    layer()                       # relu
+    return idx
+
+
+KERAS_LAYER_INDEX = _build_index()
+
+
+FREEZE_ALL = 10**9
+
+
+def densenet201_backbone(in_channels: int = 3, *,
+                         bn_frozen_below: int = 0) -> core.Module:
+    """`bn_frozen_below`: BN layers with Keras index < this run in
+    permanent inference mode (Keras trainable=False semantics)."""
+    specs: list[tuple[str, core.Module]] = []
+
+    def add(m):
+        specs.append((m.name, m))
+
+    def bn(c, name):
+        frozen = KERAS_LAYER_INDEX[name] < bn_frozen_below
+        return core.batch_norm(c, name=name, frozen=frozen, **_BN)
+
+    # Keras stem: ZeroPadding2D((3,3)) + valid 7x7/2 conv, then
+    # ZeroPadding2D((1,1)) + valid 3x3/2 pool — symmetric padding, which
+    # lax SAME (lo<=hi asymmetric) would shift by one pixel.
+    add(core.conv2d(in_channels, 64, 7, stride=2, use_bias=False,
+                    padding=((3, 3), (3, 3)), name="conv1_conv"))
+    add(bn(64, "conv1_bn"))
+    c = 64
+    stages = []
+    for stage, n_layers in enumerate(_BLOCKS, start=2):
+        for l in range(1, n_layers + 1):
+            p = f"conv{stage}_block{l}"
+            add(bn(c + (l - 1) * _GROWTH, f"{p}_0_bn"))
+            add(core.conv2d(c + (l - 1) * _GROWTH, 4 * _GROWTH, 1,
+                            use_bias=False, name=f"{p}_1_conv"))
+            add(bn(4 * _GROWTH, f"{p}_1_bn"))
+            add(core.conv2d(4 * _GROWTH, _GROWTH, 3, use_bias=False,
+                            name=f"{p}_2_conv"))
+        c = c + n_layers * _GROWTH
+        if stage < 5:
+            add(bn(c, f"pool{stage}_bn"))
+            add(core.conv2d(c, c // 2, 1, use_bias=False,
+                            name=f"pool{stage}_conv"))
+            c = c // 2
+        stages.append((stage, n_layers))
+    add(bn(c, "bn"))
+    modules = dict(specs)
+    out_channels = c  # 1920
+
+    def init(rng):
+        rngs = jax.random.split(rng, len(specs))
+        params, state = {}, {}
+        for (name, m), r in zip(specs, rngs):
+            v = m.init(r)
+            if v.params:
+                params[name] = v.params
+            if v.state:
+                state[name] = v.state
+        return core.Variables(params, state)
+
+    def apply(params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+
+        def run(name, h):
+            m = modules[name]
+            y, s2 = m.apply(params.get(name, {}), state.get(name, {}), h,
+                            train=train, rng=None)
+            if name in state:
+                new_state[name] = s2
+            return y
+
+        h = run("conv1_conv", x)
+        h = jax.nn.relu(run("conv1_bn", h))
+        h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max,
+                                  (1, 3, 3, 1), (1, 2, 2, 1),
+                                  [(0, 0), (1, 1), (1, 1), (0, 0)])
+        for stage, n_layers in stages:
+            for l in range(1, n_layers + 1):
+                p = f"conv{stage}_block{l}"
+                y = jax.nn.relu(run(f"{p}_0_bn", h))
+                y = run(f"{p}_1_conv", y)
+                y = jax.nn.relu(run(f"{p}_1_bn", y))
+                y = run(f"{p}_2_conv", y)
+                h = jnp.concatenate([h, y], axis=-1)
+            if stage < 5:
+                h = jax.nn.relu(run(f"pool{stage}_bn", h))
+                h = run(f"pool{stage}_conv", h)
+                h = jax.lax.reduce_window(h, 0.0, jax.lax.add,
+                                          (1, 2, 2, 1), (1, 2, 2, 1),
+                                          "VALID") / 4.0
+        h = jax.nn.relu(run("bn", h))
+        return h, new_state
+
+    m = core.Module(init, apply, "densenet201")
+    return m
+
+
+DENSENET201_FEATURES = 1920
+
+
+def densenet201(num_outputs: int = 10, in_channels: int = 3, *,
+                bn_frozen_below: int = 0) -> core.Module:
+    backbone = densenet201_backbone(in_channels,
+                                    bn_frozen_below=bn_frozen_below)
+    head = core.dense(DENSENET201_FEATURES, num_outputs, name="head")
+
+    def init(rng):
+        r1, r2 = jax.random.split(rng)
+        bb = backbone.init(r1)
+        hd = head.init(r2)
+        return core.Variables({"backbone": bb.params, "head": hd.params},
+                              {"backbone": bb.state})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        h, bb_state = backbone.apply(params["backbone"],
+                                     state.get("backbone", {}), x,
+                                     train=train, rng=rng)
+        h = h.mean(axis=(1, 2))
+        y, _ = head.apply(params["head"], {}, h, train=train)
+        return y, {"backbone": bb_state}
+
+    return core.Module(init, apply, "densenet201_classifier")
+
+
+head_only_mask = core.head_only_mask
+
+
+def fine_tune_mask(params, fine_tune_at: int = 150):
+    return core.keras_fine_tune_mask(params, KERAS_LAYER_INDEX, fine_tune_at)

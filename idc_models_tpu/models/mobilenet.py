@@ -1,0 +1,207 @@
+"""MobileNetV2 backbone + transfer-learning head.
+
+Capability parity with the reference's mobile preset
+(dist_model_tf_mobile.py:119-129): MobileNetV2 (alpha=1.0) without top,
+GlobalAveragePooling2D, Dense(1) logits head, fine_tune_at=100
+(dist_model_tf_mobile.py:146).
+
+The architecture follows keras.applications MobileNetV2: stem conv(32,s2)
+-> 17 inverted-residual blocks (expansion 6 except the first) -> conv(1280)
+with BN(eps=1e-3, momentum=0.999) + ReLU6 throughout and residual adds on
+stride-1 same-width blocks. Total params (incl. BN moving stats) =
+2,257,984, matching Keras include_top=False.
+
+`KERAS_LAYER_INDEX` reproduces Keras' flat layer numbering (ZeroPadding and
+Add layers included) so the reference's `fine_tune_at` — an index into
+`base_model.layers` — selects the same parameters here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from idc_models_tpu.models import core
+
+# (expansion t, out channels c, stride s) per block, keras order
+_BLOCKS = (
+    [(1, 16, 1)]
+    + [(6, 24, 2), (6, 24, 1)]
+    + [(6, 32, 2), (6, 32, 1), (6, 32, 1)]
+    + [(6, 64, 2), (6, 64, 1), (6, 64, 1), (6, 64, 1)]
+    + [(6, 96, 1), (6, 96, 1), (6, 96, 1)]
+    + [(6, 160, 2), (6, 160, 1), (6, 160, 1)]
+    + [(6, 320, 1)]
+)
+
+KERAS_LAYER_INDEX: dict[str, int] = {}
+
+
+def _build_index():
+    """Replicate Keras MobileNetV2's layer ordering: param groups get the
+    index of their conv/BN layer; activations/pads/adds only advance it."""
+    i = 0
+    idx = {}
+
+    def layer(name=None):
+        nonlocal i
+        if name is not None:
+            idx[name] = i
+        i += 1
+
+    layer()                      # InputLayer
+    layer("Conv1")
+    layer("bn_Conv1")
+    layer()                      # Conv1_relu
+    # block 0 (expanded_conv): no expand conv
+    layer("expanded_conv_depthwise")
+    layer("expanded_conv_depthwise_BN")
+    layer()                      # relu
+    layer("expanded_conv_project")
+    layer("expanded_conv_project_BN")
+    c_in = 16
+    for b, (t, c, s) in enumerate(_BLOCKS[1:], start=1):
+        layer(f"block_{b}_expand")
+        layer(f"block_{b}_expand_BN")
+        layer()                  # expand_relu
+        if s == 2:
+            layer()              # ZeroPadding2D
+        layer(f"block_{b}_depthwise")
+        layer(f"block_{b}_depthwise_BN")
+        layer()                  # depthwise_relu
+        layer(f"block_{b}_project")
+        layer(f"block_{b}_project_BN")
+        if s == 1 and c == c_in:
+            layer()              # Add
+        c_in = c
+    layer("Conv_1")
+    layer("Conv_1_bn")
+    layer()                      # out_relu
+    return idx
+
+
+KERAS_LAYER_INDEX = _build_index()
+
+_BN = dict(momentum=0.999, eps=1e-3)
+
+FREEZE_ALL = 10**9  # bn_frozen_below value freezing every BN layer
+
+
+def mobilenet_v2_backbone(in_channels: int = 3, *,
+                          bn_frozen_below: int = 0) -> core.Module:
+    """Returns the backbone module; params keyed by Keras layer names.
+
+    `bn_frozen_below`: BN layers with Keras index < this run in permanent
+    inference mode (Keras `trainable=False` semantics) — pass FREEZE_ALL
+    for the head-only phase and the phase-2 `fine_tune_at` for fine-tuning,
+    mirroring the masks.
+    """
+    specs: list[tuple[str, core.Module]] = []
+
+    def add(m: core.Module):
+        specs.append((m.name, m))
+
+    def _bn(c, name):
+        frozen = KERAS_LAYER_INDEX[name] < bn_frozen_below
+        return core.batch_norm(c, name=name, frozen=frozen, **_BN)
+
+    add(core.conv2d(in_channels, 32, 3, stride=2, use_bias=False, name="Conv1"))
+    add(_bn(32, "bn_Conv1"))
+    add(core.depthwise_conv2d(32, 3, use_bias=False,
+                              name="expanded_conv_depthwise"))
+    add(_bn(32, "expanded_conv_depthwise_BN"))
+    add(core.conv2d(32, 16, 1, use_bias=False, name="expanded_conv_project"))
+    add(_bn(16, "expanded_conv_project_BN"))
+    c_in = 16
+    blocks = []
+    for b, (t, c, s) in enumerate(_BLOCKS[1:], start=1):
+        hidden = t * c_in
+        add(core.conv2d(c_in, hidden, 1, use_bias=False, name=f"block_{b}_expand"))
+        add(_bn(hidden, f"block_{b}_expand_BN"))
+        add(core.depthwise_conv2d(hidden, 3, stride=s, use_bias=False,
+                                  name=f"block_{b}_depthwise"))
+        add(_bn(hidden, f"block_{b}_depthwise_BN"))
+        add(core.conv2d(hidden, c, 1, use_bias=False, name=f"block_{b}_project"))
+        add(_bn(c, f"block_{b}_project_BN"))
+        blocks.append((b, t, c, s, c_in))
+        c_in = c
+    add(core.conv2d(320, 1280, 1, use_bias=False, name="Conv_1"))
+    add(_bn(1280, "Conv_1_bn"))
+    modules = dict(specs)
+
+    def init(rng):
+        rngs = jax.random.split(rng, len(specs))
+        params, state = {}, {}
+        for (name, m), r in zip(specs, rngs):
+            v = m.init(r)
+            if v.params:
+                params[name] = v.params
+            if v.state:
+                state[name] = v.state
+        return core.Variables(params, state)
+
+    def apply(params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+
+        def run(name, h):
+            m = modules[name]
+            y, s2 = m.apply(params.get(name, {}), state.get(name, {}), h,
+                            train=train, rng=None)
+            if name in state:
+                new_state[name] = s2
+            return y
+
+        h = run("Conv1", x)
+        h = jnp.minimum(jax.nn.relu(run("bn_Conv1", h)), 6.0)
+        h = run("expanded_conv_depthwise", h)
+        h = jnp.minimum(jax.nn.relu(run("expanded_conv_depthwise_BN", h)), 6.0)
+        h = run("expanded_conv_project", h)
+        h = run("expanded_conv_project_BN", h)
+        for b, t, c, s, ci in blocks:
+            inp = h
+            h = run(f"block_{b}_expand", h)
+            h = jnp.minimum(jax.nn.relu(run(f"block_{b}_expand_BN", h)), 6.0)
+            h = run(f"block_{b}_depthwise", h)
+            h = jnp.minimum(jax.nn.relu(run(f"block_{b}_depthwise_BN", h)), 6.0)
+            h = run(f"block_{b}_project", h)
+            h = run(f"block_{b}_project_BN", h)
+            if s == 1 and c == ci:
+                h = h + inp
+        h = run("Conv_1", h)
+        h = jnp.minimum(jax.nn.relu(run("Conv_1_bn", h)), 6.0)
+        return h, new_state
+
+    return core.Module(init, apply, "mobilenet_v2")
+
+
+def mobilenet_v2(num_outputs: int = 1, in_channels: int = 3, *,
+                 bn_frozen_below: int = 0) -> core.Module:
+    backbone = mobilenet_v2_backbone(in_channels,
+                                     bn_frozen_below=bn_frozen_below)
+    head = core.dense(1280, num_outputs, name="head")
+
+    def init(rng):
+        r1, r2 = jax.random.split(rng)
+        bb = backbone.init(r1)
+        hd = head.init(r2)
+        return core.Variables({"backbone": bb.params, "head": hd.params},
+                              {"backbone": bb.state})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        h, bb_state = backbone.apply(params["backbone"],
+                                     state.get("backbone", {}), x,
+                                     train=train, rng=rng)
+        h = h.mean(axis=(1, 2))
+        y, _ = head.apply(params["head"], {}, h, train=train)
+        return y, {"backbone": bb_state}
+
+    return core.Module(init, apply, "mobilenet_v2_classifier")
+
+
+head_only_mask = core.head_only_mask
+
+
+def fine_tune_mask(params, fine_tune_at: int = 100):
+    """Unfreeze backbone layers with Keras index >= fine_tune_at
+    (dist_model_tf_mobile.py:146 uses 100, which lands inside block 11)."""
+    return core.keras_fine_tune_mask(params, KERAS_LAYER_INDEX, fine_tune_at)

@@ -1,0 +1,145 @@
+"""Pretrained-weight import: Keras h5 / npz checkpoints -> explicit pytrees.
+
+The reference downloads ImageNet weights through keras.applications at
+runtime (dist_model_tf_vgg.py:119). This environment has no network egress,
+so the framework takes weights from local artifacts instead:
+
+- ``load_npz`` / ``save_npz``: the framework's own flat "path/to/leaf" npz
+  pytree format (also used by unit tests and the offline conversion).
+- ``load_keras_h5``: one-time offline conversion from a Keras
+  `.h5` weights file (as produced by `model.save_weights`), mapping Keras
+  layer names onto this package's identical param-group names. Conv kernels
+  are already HWIO in Keras h5, so no transposition is needed; only
+  depthwise kernels need their (kh, kw, in, 1) -> (kh, kw, 1, in) swap.
+
+If no weight file is available, models start from the standard random
+initialization and `maybe_load_pretrained` says so — capability parity
+degrades gracefully rather than failing.
+"""
+
+from __future__ import annotations
+
+import warnings
+from pathlib import Path
+
+import jax
+import numpy as np
+
+
+def _flatten(tree, prefix=()):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (k,)))
+    else:
+        out["/".join(prefix)] = tree
+    return out
+
+
+def _unflatten(flat: dict[str, np.ndarray]):
+    tree: dict = {}
+    for path, v in flat.items():
+        node = tree
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def save_npz(path: str | Path, tree) -> None:
+    flat = {k: np.asarray(v) for k, v in _flatten(tree).items()}
+    np.savez(path, **flat)
+
+
+def load_npz(path: str | Path):
+    with np.load(path) as z:
+        return _unflatten({k: z[k] for k in z.files})
+
+
+def merge_pretrained(params, loaded, *, strict: bool = False):
+    """Graft `loaded` leaves onto `params` where paths+shapes match.
+
+    Returns (merged, n_loaded, mismatches). With strict=True any shape
+    mismatch or missing path raises.
+    """
+    flat_p = _flatten(params)
+    flat_l = _flatten(loaded)
+    merged = dict(flat_p)
+    mismatches = []
+    n = 0
+    for k, v in flat_l.items():
+        if k not in flat_p:
+            mismatches.append(f"unexpected: {k}")
+            continue
+        if tuple(np.shape(v)) != tuple(np.shape(flat_p[k])):
+            mismatches.append(
+                f"shape {k}: {np.shape(v)} vs {np.shape(flat_p[k])}")
+            continue
+        merged[k] = np.asarray(v, dtype=np.asarray(flat_p[k]).dtype)
+        n += 1
+    if strict and (mismatches or n < len(flat_p)):
+        raise ValueError(f"pretrained merge failed: {mismatches[:10]}, "
+                         f"loaded {n}/{len(flat_p)}")
+    return _unflatten(merged), n, mismatches
+
+
+_KERAS_SUFFIX = {
+    "kernel:0": "kernel", "bias:0": "bias",
+    "gamma:0": "scale", "beta:0": "bias",
+    "moving_mean:0": "mean", "moving_variance:0": "var",
+}
+
+
+def load_keras_h5(path: str | Path):
+    """Read a Keras `save_weights` h5 into (params_flat, state_flat) trees
+    keyed by Keras layer name — the same names this package's backbones use."""
+    import h5py  # optional; only needed for offline conversion
+
+    params: dict = {}
+    state: dict = {}
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        for layer in root:
+            g = root[layer]
+            for w in g.attrs.get("weight_names", []):
+                name = w.decode() if isinstance(w, bytes) else w
+                arr = np.asarray(g[name])
+                suffix = name.split("/")[-1]
+                key = _KERAS_SUFFIX.get(suffix)
+                if key is None:
+                    continue
+                layer_name = name.split("/")[-2]
+                if "depthwise" in layer_name and key == "kernel":
+                    arr = np.transpose(arr, (0, 1, 3, 2))
+                dest = state if suffix.startswith("moving") else params
+                dest.setdefault(layer_name, {})[key] = arr
+    return params, state
+
+
+def maybe_load_pretrained(params, weights_path: str | Path | None, *,
+                          subtree: str = "backbone"):
+    """Merge a weight artifact into `params[subtree]` if it exists.
+
+    Accepts .npz (framework format). Returns possibly-updated params;
+    warns (not fails) when the artifact is absent — the no-egress analogue
+    of the reference's weights='imagenet' download.
+    """
+    if weights_path is None:
+        return params
+    p = Path(weights_path)
+    if not p.exists():
+        warnings.warn(f"pretrained weights {p} not found; using random "
+                      f"initialization", stacklevel=2)
+        return params
+    loaded = load_npz(p)
+    target = params[subtree] if subtree else params
+    merged, n, mis = merge_pretrained(target, loaded)
+    if mis:
+        warnings.warn(f"pretrained merge: {len(mis)} mismatches "
+                      f"(first: {mis[:3]})", stacklevel=2)
+    out = dict(params)
+    if subtree:
+        out[subtree] = merged
+        return out
+    return merged

@@ -1,0 +1,52 @@
+"""Model registry: name -> (builder, head-only mask, fine-tune mask).
+
+Gives the CLI/configs one lookup for the reference's model zoo
+(keras.applications in the reference; SURVEY.md C5/C6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable
+
+from idc_models_tpu.models import densenet, mobilenet, small_cnn as small_cnn_mod, vgg
+from idc_models_tpu.models.core import Module
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    build: Callable[..., Module]          # (num_outputs, in_channels) -> Module
+    head_only_mask: Callable              # params -> bool pytree
+    fine_tune_mask: Callable              # (params, fine_tune_at) -> bool pytree
+    default_fine_tune_at: int
+    feature_dim: int
+
+
+def _always_trainable(params, fine_tune_at=0):
+    import jax
+
+    return jax.tree.map(lambda _: True, params)
+
+
+REGISTRY: dict[str, ModelSpec] = {
+    "vgg16": ModelSpec(vgg.vgg16, vgg.head_only_mask, vgg.fine_tune_mask,
+                       default_fine_tune_at=15, feature_dim=512),
+    "mobilenet_v2": ModelSpec(mobilenet.mobilenet_v2,
+                              mobilenet.head_only_mask,
+                              mobilenet.fine_tune_mask,
+                              default_fine_tune_at=100, feature_dim=1280),
+    "densenet201": ModelSpec(densenet.densenet201, densenet.head_only_mask,
+                             densenet.fine_tune_mask,
+                             default_fine_tune_at=150, feature_dim=1920),
+    "small_cnn": ModelSpec(
+        lambda num_outputs=1, in_channels=3: small_cnn_mod.small_cnn(
+            10, in_channels, num_outputs),
+        _always_trainable, _always_trainable,
+        default_fine_tune_at=0, feature_dim=8),
+}
+
+
+def get_model(name: str) -> ModelSpec:
+    if name not in REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
+    return REGISTRY[name]

@@ -1,0 +1,78 @@
+"""VGG16 backbone + transfer-learning head.
+
+Capability parity with the reference's flagship model
+(dist_model_tf_vgg.py:119-129, fed_model.py:113-123): VGG16 without top,
+GlobalAveragePooling2D, Dense(1) logits head. 14,714,688 backbone params
+(matches keras.applications VGG16 include_top=False).
+
+Freezing follows the reference's two phases: phase 1 trains the head only
+(backbone frozen, dist_model_tf_vgg.py:122); phase 2 unfreezes layers with
+Keras index >= fine_tune_at=15 (dist_model_tf_vgg.py:146) — i.e. block 5's
+convolutions. Here that is an explicit optax mask from `fine_tune_mask`,
+keyed by the same Keras layer indices (see KERAS_LAYER_INDEX).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from idc_models_tpu.models import core
+
+# (block, filters, convs-per-block) — VGG16 topology
+_CFG = [(1, 64, 2), (2, 128, 2), (3, 256, 3), (4, 512, 3), (5, 512, 3)]
+
+# Keras layer index of every parameterized backbone layer, matching
+# keras.applications.VGG16(include_top=False).layers (index 0 = InputLayer,
+# pools occupy indices too). Used to translate the reference's
+# `fine_tune_at` layer numbers into param-group masks.
+KERAS_LAYER_INDEX: dict[str, int] = {}
+_i = 1
+for _b, _f, _n in _CFG:
+    for _c in range(1, _n + 1):
+        KERAS_LAYER_INDEX[f"block{_b}_conv{_c}"] = _i
+        _i += 1
+    _i += 1  # the block's pooling layer
+
+
+def vgg16_backbone(in_channels: int = 3) -> core.Module:
+    layers: list[core.Module] = []
+    c_in = in_channels
+    for block, filters, n_convs in _CFG:
+        for conv in range(1, n_convs + 1):
+            layers.append(core.conv2d(c_in, filters, 3,
+                                      name=f"block{block}_conv{conv}"))
+            layers.append(core.relu(name=f"block{block}_relu{conv}"))
+            c_in = filters
+        layers.append(core.max_pool(2, name=f"block{block}_pool"))
+    return core.sequential(layers, name="vgg16")
+
+
+def vgg16(num_outputs: int = 1, in_channels: int = 3) -> core.Module:
+    """Backbone + GAP + Dense head; params = {"backbone": ..., "head": ...}."""
+    backbone = vgg16_backbone(in_channels)
+    head = core.dense(512, num_outputs, name="head")
+
+    def init(rng):
+        r1, r2 = jax.random.split(rng)
+        bb = backbone.init(r1)
+        hd = head.init(r2)
+        return core.Variables({"backbone": bb.params, "head": hd.params},
+                              {"backbone": bb.state})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        h, bb_state = backbone.apply(params["backbone"],
+                                     state.get("backbone", {}), x,
+                                     train=train, rng=rng)
+        h = h.mean(axis=(1, 2))  # GlobalAveragePooling2D
+        y, _ = head.apply(params["head"], {}, h, train=train)
+        return y, {"backbone": bb_state}
+
+    return core.Module(init, apply, "vgg16_classifier")
+
+
+head_only_mask = core.head_only_mask
+
+
+def fine_tune_mask(params, fine_tune_at: int = 15):
+    """Phase-2 mask: head + backbone layers with Keras index >= fine_tune_at."""
+    return core.keras_fine_tune_mask(params, KERAS_LAYER_INDEX, fine_tune_at)

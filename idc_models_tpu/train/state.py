@@ -50,6 +50,15 @@ def rmsprop(learning_rate: float, *, rho: float = 0.9, eps: float = 1e-7,
     # eps_in_sqrt=False: Keras updates with g / (sqrt(nu) + eps); optax's
     # default puts eps inside the sqrt, which damps very differently at nu~0.
     opt = optax.rmsprop(learning_rate, decay=rho, eps=eps, eps_in_sqrt=False)
-    if trainable_mask is not None:
-        opt = optax.masked(opt, trainable_mask)
-    return opt
+    return freeze_where(opt, trainable_mask)
+
+
+def freeze_where(opt: optax.GradientTransformation,
+                 trainable_mask: Any | None) -> optax.GradientTransformation:
+    """Zero updates where mask is False. (optax.masked alone is NOT a
+    freeze: it passes raw gradients through untransformed leaves.)"""
+    if trainable_mask is None:
+        return opt
+    labels = jax.tree.map(lambda t: "train" if t else "freeze", trainable_mask)
+    return optax.multi_transform(
+        {"train": opt, "freeze": optax.set_to_zero()}, labels)

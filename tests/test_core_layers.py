@@ -84,3 +84,24 @@ def test_trainability_mask():
     for path, val in flat:
         keys = tuple(p.key for p in path)
         assert val == (keys[0] == "head")
+
+
+def test_batch_norm_frozen_ignores_train_flag():
+    m = core.batch_norm(4, frozen=True)
+    v = m.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (16, 4)) * 3 + 2
+    y, new_state = m.apply(v.params, v.state, x, train=True)
+    # inference mode: stats unchanged, normalization uses stored (0,1)
+    np.testing.assert_array_equal(np.asarray(new_state["mean"]),
+                                  np.asarray(v.state["mean"]))
+    eps = 1e-3
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(x) / np.sqrt(1 + eps), rtol=1e-5)
+
+
+def test_conv2d_explicit_padding():
+    m = core.conv2d(1, 1, 7, stride=2, padding=((3, 3), (3, 3)),
+                    use_bias=False)
+    v = m.init(jax.random.key(0))
+    y, _ = m.apply(v.params, v.state, jnp.ones((1, 224, 224, 1)))
+    assert y.shape == (1, 112, 112, 1)

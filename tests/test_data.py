@@ -1,0 +1,190 @@
+"""Data layer tests: loader determinism, splits, sharding, prefetch."""
+
+import numpy as np
+import pytest
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import (
+    ArrayDataset, Loader, cifar10, partition, pipeline, synthetic,
+)
+from idc_models_tpu.data.idc import load_directory, train_val_test_split
+
+
+@pytest.fixture(scope="module")
+def png_tree(tmp_path_factory):
+    """A tiny <root>/<label>/*.png tree with recoverable labels."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("idc")
+    rng = np.random.default_rng(0)
+    for label in (0, 1):
+        d = root / str(label)
+        d.mkdir()
+        for i in range(12):
+            arr = (rng.random((50, 50, 3)) * 100 + label * 120).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"p{i}.png")
+    return root
+
+
+def test_load_directory_labels_and_range(png_tree):
+    ds = load_directory(png_tree, image_size=50, seed=3)
+    assert len(ds) == 24
+    assert ds.images.dtype == np.float32
+    assert 0.0 <= ds.images.min() and ds.images.max() <= 1.0
+    assert set(np.unique(ds.labels)) == {0, 1}
+    # label is recoverable from brightness (class 1 is brighter)
+    bright = ds.images.mean(axis=(1, 2, 3))
+    assert bright[ds.labels == 1].mean() > bright[ds.labels == 0].mean()
+
+
+def test_load_directory_deterministic(png_tree):
+    a = load_directory(png_tree, seed=7)
+    b = load_directory(png_tree, seed=7)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.images, b.images)
+    c = load_directory(png_tree, seed=8)
+    assert not np.array_equal(a.labels, c.labels) or not np.array_equal(
+        a.images, c.images)
+
+
+def test_load_directory_resize(png_tree):
+    ds = load_directory(png_tree, image_size=10)
+    assert ds.images.shape[1:] == (10, 10, 3)
+
+
+def test_split_is_materialized_and_disjoint():
+    imgs, labels = synthetic.make_idc_like(100, size=8, seed=0)
+    # tag each image with a unique corner value to detect overlap
+    imgs[:, 0, 0, 0] = np.arange(100) / 100.0
+    ds = ArrayDataset(imgs, labels)
+    tr, va, te = train_val_test_split(ds, (0.8, 0.1, 0.1), seed=5)
+    assert (len(tr), len(va), len(te)) == (80, 10, 10)
+    ids = np.concatenate([d.images[:, 0, 0, 0] for d in (tr, va, te)])
+    assert len(np.unique(ids)) == 100  # disjoint, covers everything
+
+
+def test_loader_epochs_and_drop_remainder():
+    imgs, labels = synthetic.make_idc_like(70, size=8, seed=0)
+    ld = Loader(ArrayDataset(imgs, labels), 32, seed=1)
+    assert len(ld) == 2
+    b0 = list(ld.epoch(0))
+    b1 = list(ld.epoch(1))
+    assert all(x.shape[0] == 32 for x, _ in b0)
+    # different epoch -> different order
+    assert not np.array_equal(b0[0][0], b1[0][0])
+    # same epoch replayed -> identical
+    b0r = list(ld.epoch(0))
+    np.testing.assert_array_equal(b0[0][0], b0r[0][0])
+
+
+def test_prefetch_to_mesh_shards(devices):
+    mesh = meshlib.data_mesh(8)
+    imgs, labels = synthetic.make_idc_like(64, size=8, seed=0)
+    ld = Loader(ArrayDataset(imgs, labels), 16, shuffle=False)
+    out = list(pipeline.prefetch_to_mesh(iter(ld), mesh))
+    assert len(out) == 4
+    x, y = out[0]
+    assert x.shape == (16, 8, 8, 3)
+    assert len(x.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(y), labels[:16])
+
+
+def test_prefetch_propagates_errors(devices):
+    mesh = meshlib.data_mesh(8)
+
+    def bad():
+        yield (np.zeros((8, 4, 4, 3), np.float32), np.zeros(8, np.int32))
+        raise RuntimeError("decode failed")
+
+    it = pipeline.prefetch_to_mesh(bad(), mesh)
+    next(it)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(it)
+
+
+def test_pad_to_multiple():
+    x = np.ones((10, 4, 4, 3), np.float32)
+    y = np.ones(10, np.int32)
+    px, py, mask = pipeline.pad_to_multiple(x, y, 8)
+    assert px.shape[0] == 16 and mask.sum() == 10
+    px2, _, mask2 = pipeline.pad_to_multiple(x[:8], y[:8], 8)
+    assert px2.shape[0] == 8 and mask2.all()
+
+
+def test_partition_iid_vs_noniid():
+    imgs, labels = synthetic.make_idc_like(400, size=8, seed=0,
+                                           pos_fraction=0.5)
+    ds = ArrayDataset(imgs, labels)
+    ci, cl = partition.partition_clients(ds, 8, iid=True, seed=0)
+    assert ci.shape == (8, 50, 8, 8, 3) and cl.shape == (8, 50)
+    iid_skew = np.abs(cl.mean(axis=1) - labels.mean()).max()
+    _, cl_n = partition.partition_clients(ds, 8, iid=False, seed=0)
+    # non-IID: most clients are single-class
+    frac = cl_n.mean(axis=1)
+    assert np.sum((frac > 0.99) | (frac < 0.01)) >= 6
+    assert iid_skew < 0.2
+
+
+def test_partition_deterministic():
+    imgs, labels = synthetic.make_idc_like(64, size=8, seed=0)
+    ds = ArrayDataset(imgs, labels)
+    a = partition.partition_clients(ds, 4, iid=False, seed=3)
+    b = partition.partition_clients(ds, 4, iid=False, seed=3)
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_train_test_client_split():
+    tr, te = partition.train_test_client_split(10, 0.2, seed=0)
+    assert len(tr) == 8 and len(te) == 2
+    assert set(tr) | set(te) == set(range(10))
+
+
+def test_strided_shard():
+    imgs, labels = synthetic.make_idc_like(20, size=8, seed=0)
+    labels = np.arange(20, dtype=np.int32)
+    ds = ArrayDataset(imgs, labels)
+    s = ds.shard(4, 1)
+    np.testing.assert_array_equal(s.labels, [1, 5, 9, 13, 17])
+
+
+def test_cifar10_synthetic_fallback():
+    with pytest.warns(UserWarning, match="synthetic stand-in"):
+        ds = cifar10.load_cifar10(None, synthetic_size=128)
+    assert ds.images.shape == (128, 32, 32, 3)
+    assert ds.labels.max() < 10
+
+
+def test_cifar10_npz(tmp_path):
+    x = (np.random.default_rng(0).random((8, 32, 32, 3)) * 255).astype(np.uint8)
+    y = np.arange(8) % 10
+    np.savez(tmp_path / "cifar10.npz", x_train=x, y_train=y,
+             x_test=x[:4], y_test=y[:4])
+    ds = cifar10.load_cifar10(str(tmp_path), split="train")
+    assert len(ds) == 8
+    np.testing.assert_allclose(ds.images, x.astype(np.float32) / 255.0)
+
+
+def test_prefetch_abandoned_iterator_stops_producer(devices):
+    import threading
+    mesh = meshlib.data_mesh(8)
+    imgs, labels = synthetic.make_idc_like(64, size=8, seed=0)
+    ld = Loader(ArrayDataset(imgs, labels), 8, shuffle=False)
+    n_before = threading.active_count()
+    it = pipeline.prefetch_to_mesh(iter(ld), mesh, prefetch=1)
+    next(it)
+    it.close()  # abandon early
+    import time
+    for _ in range(50):
+        if threading.active_count() <= n_before:
+            break
+        time.sleep(0.1)
+    assert threading.active_count() <= n_before
+
+
+def test_cifar10_synthetic_splits_differ():
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        tr = cifar10.load_cifar10(None, split="train", synthetic_size=64)
+        te = cifar10.load_cifar10(None, split="test", synthetic_size=64)
+    assert not np.array_equal(tr.images, te.images)

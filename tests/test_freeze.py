@@ -1,0 +1,35 @@
+"""Regression test: frozen parameters must receive exactly zero updates.
+
+(optax.masked alone passes raw gradients through False leaves — caught by
+driving the two-phase VGG flow; freeze_where is the fix.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu.models import small_cnn
+from idc_models_tpu.models.core import trainability_mask
+from idc_models_tpu.train import create_train_state, make_train_step, rmsprop
+from idc_models_tpu.train.losses import binary_cross_entropy
+
+
+def test_frozen_params_do_not_move():
+    model = small_cnn(10, 3, 1)
+    variables = model.init(jax.random.key(0))
+    mask = trainability_mask(variables.params, lambda p: p[0] == "head")
+    opt = rmsprop(1e-2, trainable_mask=mask)
+    state = create_train_state(model, opt, jax.random.key(0))
+    step = jax.jit(make_train_step(model, opt, binary_cross_entropy))
+    x = jnp.asarray(np.random.default_rng(0).random((16, 10, 10, 3)),
+                    jnp.float32)
+    y = jnp.asarray(np.arange(16) % 2)
+    before = jax.device_get(state.params)
+    for i in range(3):
+        state, _ = step(state, x, y, jax.random.key(i))
+    after = jax.device_get(state.params)
+    for name in ("conv1", "fc1"):
+        for k in before[name]:
+            np.testing.assert_array_equal(before[name][k], after[name][k])
+    assert not np.array_equal(before["head"]["kernel"],
+                              after["head"]["kernel"])

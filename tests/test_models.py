@@ -1,0 +1,127 @@
+"""Model zoo tests: shapes, parameter-count parity with Keras, masks.
+
+Param-count targets are keras.applications' published totals for
+include_top=False backbones (trainable + non-trainable, where
+non-trainable = BN moving statistics, which this framework stores in
+`state` rather than `params`).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu.models import core, densenet, get_model, mobilenet, vgg
+
+
+def _count(tree):
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def test_vgg16_param_count_matches_keras():
+    bb = vgg.vgg16_backbone()
+    v = bb.init(jax.random.key(0))
+    assert _count(v.params) == 14_714_688
+    assert _count(v.state) == 0  # no BN in VGG16
+
+
+def test_vgg16_forward_shape():
+    m = vgg.vgg16(num_outputs=1)
+    v = m.init(jax.random.key(0))
+    y, _ = m.apply(v.params, v.state, jnp.ones((2, 50, 50, 3)))
+    assert y.shape == (2, 1)
+
+
+def test_vgg16_fine_tune_mask_selects_block5():
+    m = vgg.vgg16(1)
+    v = m.init(jax.random.key(0))
+    mask = vgg.fine_tune_mask(v.params, 15)
+    trainable = sum(p.size for p, t in zip(jax.tree.leaves(v.params),
+                                           jax.tree.leaves(mask)) if t)
+    # block5: 3 convs 512->512 (2,359,808 each) + head (513)
+    assert trainable == 3 * 2_359_808 + 513
+    head_mask = vgg.head_only_mask(v.params)
+    head_trainable = sum(p.size for p, t in zip(jax.tree.leaves(v.params),
+                                                jax.tree.leaves(head_mask)) if t)
+    assert head_trainable == 513
+
+
+@pytest.mark.slow
+def test_mobilenet_v2_param_count_matches_keras():
+    bb = mobilenet.mobilenet_v2_backbone()
+    v = bb.init(jax.random.key(0))
+    total = _count(v.params) + _count(v.state)
+    assert total == 2_257_984
+
+
+def test_mobilenet_v2_forward_shape_and_bn_state():
+    m = mobilenet.mobilenet_v2(num_outputs=1)
+    v = m.init(jax.random.key(0))
+    y, new_state = m.apply(v.params, v.state, jnp.ones((2, 50, 50, 3)),
+                           train=True)
+    assert y.shape == (2, 1)
+    # train mode must update BN moving stats somewhere
+    changed = any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree.leaves(v.state), jax.tree.leaves(new_state)))
+    assert changed
+
+
+def test_mobilenet_keras_index_spot_checks():
+    idx = mobilenet.KERAS_LAYER_INDEX
+    assert idx["Conv1"] == 1
+    assert idx["expanded_conv_depthwise"] == 4
+    assert idx["block_1_expand"] == 9
+    # fine_tune_at=100 splits inside block 11
+    assert idx["block_10_project_BN"] < 100 <= idx["block_11_depthwise"]
+
+
+@pytest.mark.slow
+def test_densenet201_param_count_matches_keras():
+    bb = densenet.densenet201_backbone()
+    v = bb.init(jax.random.key(0))
+    total = _count(v.params) + _count(v.state)
+    assert total == 18_321_984
+
+
+@pytest.mark.slow
+def test_densenet201_forward_shape():
+    m = densenet.densenet201(num_outputs=10)
+    v = m.init(jax.random.key(0))
+    y, _ = m.apply(v.params, v.state, jnp.ones((1, 32, 32, 3)))
+    assert y.shape == (1, 10)
+
+
+def test_densenet_keras_index_spot_checks():
+    idx = densenet.KERAS_LAYER_INDEX
+    assert idx["conv1_conv"] == 2
+    assert idx["conv2_block1_0_bn"] == 7
+    # 150 lands inside conv4_block2 (after 6+12 blocks and two transitions)
+    assert idx["conv4_block1_0_bn"] < 150 <= idx["conv4_block2_2_conv"]
+
+
+def test_registry():
+    spec = get_model("vgg16")
+    m = spec.build(num_outputs=1)
+    v = m.init(jax.random.key(0))
+    mask = spec.fine_tune_mask(v.params, spec.default_fine_tune_at)
+    assert isinstance(jax.tree.leaves(mask)[0], bool)
+    with pytest.raises(KeyError):
+        get_model("resnet50")
+
+
+def test_densenet_stem_symmetric_padding():
+    # Keras: ZeroPad(3)+valid conv7/2 -> 112; ZeroPad(1)+valid pool3/2 -> 56
+    bb = densenet.densenet201_backbone()
+    v = bb.init(jax.random.key(0))
+    y, _ = bb.apply(v.params, v.state, jnp.ones((1, 64, 64, 3)))
+    assert y.shape == (1, 2, 2, 1920)
+
+
+def test_mobilenet_frozen_bn_state_static():
+    m = mobilenet.mobilenet_v2(1, bn_frozen_below=mobilenet.FREEZE_ALL)
+    v = m.init(jax.random.key(0))
+    _, new_state = m.apply(v.params, v.state, jnp.ones((2, 32, 32, 3)),
+                           train=True)
+    for a, b in zip(jax.tree.leaves(v.state), jax.tree.leaves(new_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

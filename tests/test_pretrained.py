@@ -1,0 +1,59 @@
+"""Pretrained weight import round-trips and graceful degradation."""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from idc_models_tpu.models import pretrained, small_cnn
+
+
+def _params():
+    m = small_cnn(10, 3, 1)
+    return m.init(jax.random.key(0)).params
+
+
+def test_npz_roundtrip(tmp_path):
+    p = _params()
+    f = tmp_path / "w.npz"
+    pretrained.save_npz(f, p)
+    loaded = pretrained.load_npz(f)
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), b)
+
+
+def test_merge_partial_and_mismatch():
+    p = _params()
+    partial = {"head": {"kernel": np.zeros((8, 1), np.float32)}}
+    merged, n, mis = pretrained.merge_pretrained(p, partial)
+    assert n == 1 and not mis
+    assert np.allclose(merged["head"]["kernel"], 0.0)
+    # untouched leaves unchanged
+    np.testing.assert_array_equal(np.asarray(p["conv1"]["kernel"]),
+                                  np.asarray(merged["conv1"]["kernel"]))
+    bad = {"head": {"kernel": np.zeros((9, 1), np.float32)}}
+    _, n2, mis2 = pretrained.merge_pretrained(p, bad)
+    assert n2 == 0 and len(mis2) == 1
+    with pytest.raises(ValueError):
+        pretrained.merge_pretrained(p, bad, strict=True)
+
+
+def test_maybe_load_missing_warns():
+    p = {"backbone": _params()}
+    with pytest.warns(UserWarning, match="not found"):
+        out = pretrained.maybe_load_pretrained(p, "/nonexistent/w.npz")
+    assert out is p
+
+
+def test_maybe_load_applies(tmp_path):
+    inner = _params()
+    p = {"backbone": inner, "head": {"kernel": np.ones((8, 1), np.float32)}}
+    zeros = jax.tree.map(lambda x: np.zeros_like(np.asarray(x)), inner)
+    f = tmp_path / "bb.npz"
+    pretrained.save_npz(f, zeros)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = pretrained.maybe_load_pretrained(p, f)
+    assert all(np.allclose(x, 0) for x in jax.tree.leaves(out["backbone"]))
+    assert np.allclose(out["head"]["kernel"], 1.0)
