@@ -24,9 +24,16 @@ from idc_models_tpu.data import synthetic
 NUM_CLASSES = 10
 
 
+_SPLITS = ("train", "test")
+
+
 def load_cifar10(root: str | None = None, *, split: str = "train",
                  synthetic_size: int = 2048,
                  seed: int = 0) -> ArrayDataset:
+    if split not in _SPLITS:
+        raise ValueError(f"split must be one of {_SPLITS}, got {split!r} "
+                         "(carve validation out of 'train' with "
+                         "train_val_test_split)")
     if root is not None:
         found = _find_local(Path(root), split)
         if found is not None:

@@ -1,0 +1,7 @@
+from idc_models_tpu.federated.fedavg import (  # noqa: F401
+    ServerState,
+    initialize_server,
+    make_fedavg_round,
+    make_federated_eval,
+    seed_server_with,
+)

@@ -44,11 +44,19 @@ class Variables:
 
 @dataclasses.dataclass(frozen=True)
 class Module:
-    """A pure init/apply pair. `name` is used as the pytree key in Sequential."""
+    """A pure init/apply pair. `name` is used as the pytree key in Sequential.
+
+    `layer_names` records the model's layer order (the order Keras
+    `get_weights()` would enumerate) for composites built by `sequential` /
+    `classifier`; consumers that need ordered-tensor semantics (the secure
+    `percent`-of-tensors knob) use it instead of jax's alphabetical
+    flatten order.
+    """
 
     init: Callable[[jax.Array], Variables]
     apply: Callable[..., tuple[jax.Array, State]]
     name: str = "module"
+    layer_names: tuple[str, ...] = ()
 
 
 def _split(rng, n):
@@ -319,7 +327,34 @@ def sequential(layers: Sequence[Module], name: str = "sequential") -> Module:
                 new_state[key] = s2
         return x, new_state
 
-    return Module(init, apply, name)
+    return Module(init, apply, name, layer_names=tuple(keys))
+
+
+def classifier(backbone: Module, feature_dim: int, num_outputs: int,
+               name: str | None = None) -> Module:
+    """Backbone + GlobalAveragePooling + Dense head — the model shape every
+    reference workload shares (SURVEY.md §3.5, e.g. dist_model_tf_vgg.py:
+    125-129). Params = {"backbone": ..., "head": ...}.
+    """
+    head = dense(feature_dim, num_outputs, name="head")
+
+    def init(rng):
+        r1, r2 = _split(rng, 2)
+        bb = backbone.init(r1)
+        hd = head.init(r2)
+        return Variables({"backbone": bb.params, "head": hd.params},
+                         {"backbone": bb.state})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        h, bb_state = backbone.apply(params["backbone"],
+                                     state.get("backbone", {}), x,
+                                     train=train, rng=rng)
+        h = h.mean(axis=(1, 2))  # GlobalAveragePooling2D
+        y, _ = head.apply(params["head"], {}, h, train=train)
+        return y, {"backbone": bb_state}
+
+    return Module(init, apply, name or f"{backbone.name}_classifier",
+                  layer_names=("backbone", "head"))
 
 
 # ---------------------------------------------------------------------------

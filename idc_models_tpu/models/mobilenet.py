@@ -178,24 +178,8 @@ def mobilenet_v2(num_outputs: int = 1, in_channels: int = 3, *,
                  bn_frozen_below: int = 0) -> core.Module:
     backbone = mobilenet_v2_backbone(in_channels,
                                      bn_frozen_below=bn_frozen_below)
-    head = core.dense(1280, num_outputs, name="head")
-
-    def init(rng):
-        r1, r2 = jax.random.split(rng)
-        bb = backbone.init(r1)
-        hd = head.init(r2)
-        return core.Variables({"backbone": bb.params, "head": hd.params},
-                              {"backbone": bb.state})
-
-    def apply(params, state, x, *, train=False, rng=None):
-        h, bb_state = backbone.apply(params["backbone"],
-                                     state.get("backbone", {}), x,
-                                     train=train, rng=rng)
-        h = h.mean(axis=(1, 2))
-        y, _ = head.apply(params["head"], {}, h, train=train)
-        return y, {"backbone": bb_state}
-
-    return core.Module(init, apply, "mobilenet_v2_classifier")
+    return core.classifier(backbone, 1280, num_outputs,
+                           name="mobilenet_v2_classifier")
 
 
 head_only_mask = core.head_only_mask

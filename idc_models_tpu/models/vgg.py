@@ -14,8 +14,6 @@ keyed by the same Keras layer indices (see KERAS_LAYER_INDEX).
 
 from __future__ import annotations
 
-import jax
-
 from idc_models_tpu.models import core
 
 # (block, filters, convs-per-block) — VGG16 topology
@@ -49,25 +47,8 @@ def vgg16_backbone(in_channels: int = 3) -> core.Module:
 
 def vgg16(num_outputs: int = 1, in_channels: int = 3) -> core.Module:
     """Backbone + GAP + Dense head; params = {"backbone": ..., "head": ...}."""
-    backbone = vgg16_backbone(in_channels)
-    head = core.dense(512, num_outputs, name="head")
-
-    def init(rng):
-        r1, r2 = jax.random.split(rng)
-        bb = backbone.init(r1)
-        hd = head.init(r2)
-        return core.Variables({"backbone": bb.params, "head": hd.params},
-                              {"backbone": bb.state})
-
-    def apply(params, state, x, *, train=False, rng=None):
-        h, bb_state = backbone.apply(params["backbone"],
-                                     state.get("backbone", {}), x,
-                                     train=train, rng=rng)
-        h = h.mean(axis=(1, 2))  # GlobalAveragePooling2D
-        y, _ = head.apply(params["head"], {}, h, train=train)
-        return y, {"backbone": bb_state}
-
-    return core.Module(init, apply, "vgg16_classifier")
+    return core.classifier(vgg16_backbone(in_channels), 512, num_outputs,
+                           name="vgg16_classifier")
 
 
 head_only_mask = core.head_only_mask

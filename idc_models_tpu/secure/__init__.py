@@ -1,0 +1,9 @@
+from idc_models_tpu.secure.masking import (  # noqa: F401
+    dequantize,
+    first_fraction_selection,
+    pairwise_mask,
+    quantize,
+)
+from idc_models_tpu.secure.fedavg import (  # noqa: F401
+    make_secure_fedavg_round,
+)

@@ -1,0 +1,267 @@
+"""Secure-aggregation FedAvg: the TPU pairwise-mask round and the
+host-side Paillier parity classes.
+
+Capability parity with the reference's secure federated stack (SURVEY.md
+C12-C15, D4; secure_fed_model.py:101-236):
+
+- each client trains E local epochs on its private shard,
+- "encrypts" a `percent` fraction of its weight tensors,
+- the server aggregates an (unweighted, quirk Q7) elementwise mean while
+  only ever seeing ciphertext for the protected tensors,
+- clients decrypt the aggregate and adopt it,
+- per-round evaluation on a global held-out set (loss / BinaryAccuracy /
+  AUROC — C16) is the caller's step (see cli.secure_fed).
+
+The TPU fast path replaces Paillier with pairwise one-time masks
+(`secure.masking`): inside one jitted `shard_map` program the protected
+tensors are quantized to int32, masked with antisymmetric pairwise PRG
+streams, and `psum`-ed — the sum the "server" observes per device is
+uniformly random, but the masks cancel bit-for-bit and the dequantized
+result equals the plain mean to quantization precision (2^-scale_bits).
+Unprotected tensors ride a plain `pmean`, mirroring the reference's
+partial encryption.
+
+The host-side `PaillierClient` / `PaillierServer` classes reproduce the
+reference's object-level protocol (Client.client_fit / enc_model /
+client_update, Server.aggregate — secure_fed_model.py:101-168) with the
+from-scratch `secure.paillier` in place of `phe`, kept as the
+cross-checkable reference mode for the masking path.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from idc_models_tpu import collectives
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.federated.fedavg import ServerState, make_local_trainer
+from idc_models_tpu.models import core
+from idc_models_tpu.secure import masking
+from idc_models_tpu.secure.paillier import (
+    PaillierPrivateKey, PaillierPublicKey,
+)
+
+LossFn = Callable[[jax.Array, jax.Array], jax.Array]
+shard_map = jax.shard_map
+
+
+def make_secure_fedavg_round(
+    model: core.Module,
+    optimizer: optax.GradientTransformation,
+    loss_fn: LossFn,
+    mesh: Mesh,
+    *,
+    percent: float,
+    local_epochs: int = 5,
+    batch_size: int = 32,
+    scale_bits: int = masking.DEFAULT_SCALE_BITS,
+    compute_dtype=jnp.float32,
+):
+    """Build the jitted one-round secure-FedAvg program.
+
+    Returns ``round_fn(server_state, images [C,S,...], labels [C,S], rng)
+    -> (server_state, metrics)``. The aggregate is the unweighted mean
+    (reference parity, quirk Q7); `percent` of the parameter tensors (in
+    flatten order) go through the masked integer path.
+    """
+    n_clients = mesh.shape[meshlib.CLIENT_AXIS]
+    local_train = make_local_trainer(
+        model, optimizer, loss_fn, local_epochs=local_epochs,
+        batch_size=batch_size, compute_dtype=compute_dtype)
+
+    def per_client(params, model_state, imgs, labels, rng, mask_key):
+        imgs = imgs[0]
+        labels = labels[0]
+        cid = collectives.axis_index(meshlib.CLIENT_AXIS)
+        rng = jax.random.fold_in(rng, cid)
+
+        new_params, new_model_state, (losses, accs) = local_train(
+            params, model_state, imgs, labels, rng)
+
+        # Round boundary: masked psum for the protected prefix of tensors,
+        # plain pmean for the rest and for model state. "First fraction"
+        # follows the model's layer order (Keras get_weights() enumeration,
+        # secure_fed_model.py:115-121), not jax's alphabetical flatten.
+        protect = masking.first_fraction_selection(new_params, percent,
+                                                   model.layer_names)
+        leaves, treedef = jax.tree.flatten(new_params)
+        flags = jax.tree.leaves(protect)
+
+        agg_leaves = []
+        for t_index, (leaf, protected) in enumerate(zip(leaves, flags)):
+            if protected:
+                q = masking.quantize(leaf, scale_bits)
+                tensor_key = jax.random.fold_in(mask_key, t_index)
+                m = masking.pairwise_mask(tensor_key, cid, n_clients,
+                                          leaf.shape)
+                summed = collectives.psum(q + m, meshlib.CLIENT_AXIS)
+                agg_leaves.append(
+                    masking.dequantize(summed, scale_bits, count=n_clients))
+            else:
+                agg_leaves.append(
+                    collectives.pmean(leaf, meshlib.CLIENT_AXIS))
+        agg_params = jax.tree.unflatten(treedef, agg_leaves)
+        agg_state = collectives.pmean(new_model_state, meshlib.CLIENT_AXIS)
+        metrics = collectives.pmean(
+            {"loss": jnp.mean(losses), "accuracy": jnp.mean(accs)},
+            meshlib.CLIENT_AXIS)
+        return agg_params, agg_state, metrics
+
+    mapped = shard_map(
+        per_client,
+        mesh=mesh,
+        in_specs=(P(), P(), P(meshlib.CLIENT_AXIS), P(meshlib.CLIENT_AXIS),
+                  P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+
+    def round_fn(server: ServerState, images, labels, rng):
+        if images.shape[0] != n_clients:
+            raise ValueError(
+                f"got {images.shape[0]} client shards for a "
+                f"{n_clients}-client mesh")
+        # One-time masks: the mask key is derived from the fresh per-round
+        # rng (distinct fold from the training rng), so streams are never
+        # reused across rounds.
+        params, model_state, metrics = mapped(
+            server.params, server.model_state, images, labels, rng,
+            jax.random.fold_in(rng, jnp.int32(-1)))
+        new_server = server.replace(
+            round=server.round + 1, params=params, model_state=model_state)
+        return new_server, metrics
+
+    return jax.jit(round_fn, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# Host-side Paillier parity mode (the reference's actual mechanism)
+# ---------------------------------------------------------------------------
+
+class PaillierClient:
+    """Object-level parity with the reference's `Client`
+    (secure_fed_model.py:101-154): owns a model replica and a private
+    shard; trains locally, encrypts the first `int(L * percent)` weight
+    tensors scalar-by-scalar, decrypts aggregates, and adopts them."""
+
+    def __init__(self, model: core.Module,
+                 optimizer: optax.GradientTransformation, loss_fn: LossFn,
+                 images: np.ndarray, labels: np.ndarray, client_id: int,
+                 percent: float, public_key: PaillierPublicKey,
+                 private_key: PaillierPrivateKey, *,
+                 local_epochs: int = 5, batch_size: int = 32, seed: int = 0):
+        self.model = model
+        self.percent = percent
+        self.public_key = public_key
+        self.private_key = private_key
+        self.images = images
+        self.labels = labels
+        self.client_id = client_id
+        variables = model.init(jax.random.key(seed))
+        self.params = variables.params
+        self.model_state = variables.state
+        self._trainer = jax.jit(make_local_trainer(
+            model, optimizer, loss_fn, local_epochs=local_epochs,
+            batch_size=batch_size))
+        self._rng = jax.random.fold_in(jax.random.key(seed + 1), client_id)
+
+    def _flat_weights(self):
+        """All model weights — params AND mutable state (BN moving stats),
+        like Keras get_weights() (the reference exchanges and averages the
+        full list, secure_fed_model.py:115,160-168) — as float64 ndarrays
+        in model layer order. Returns (ordered leaves, restore fn)."""
+        p_leaves, p_def = jax.tree.flatten(self.params)
+        s_leaves, s_def = jax.tree.flatten(self.model_state)
+        paths = (masking.leaf_paths(self.params)
+                 + masking.leaf_paths(self.model_state))
+        order = masking.ranked_indices(paths, self.model.layer_names)
+        combined = [np.asarray(x, np.float64)
+                    for x in jax.device_get(p_leaves + s_leaves)]
+        ordered = [combined[i] for i in order]
+
+        def restore(ordered_tensors):
+            flat = [None] * len(combined)
+            for slot, t in zip(order, ordered_tensors):
+                flat[slot] = jnp.asarray(np.asarray(t), jnp.float32)
+            params = jax.tree.unflatten(p_def, flat[:len(p_leaves)])
+            state = jax.tree.unflatten(s_def, flat[len(p_leaves):])
+            return params, state
+
+        return ordered, restore
+
+    def _num_encrypted(self) -> int:
+        n = len(jax.tree.leaves(self.params)) + len(
+            jax.tree.leaves(self.model_state))
+        return int(n * self.percent)
+
+    def client_fit(self):
+        """Local epochs, then (possibly partially encrypted) weights out
+        (secure_fed_model.py:131-141)."""
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.model_state, stats = self._trainer(
+            self.params, self.model_state, jnp.asarray(self.images),
+            jnp.asarray(self.labels), sub)
+        return self.enc_model(), jax.device_get(stats)
+
+    def enc_model(self):
+        """Flat list of weight tensors in model layer order; the first
+        `int(L*percent)` are object arrays of EncryptedNumber
+        (secure_fed_model.py:115-121)."""
+        leaves, _ = self._flat_weights()
+        n_enc = self._num_encrypted()
+        enc = np.vectorize(self.public_key.encrypt, otypes=[object])
+        return [enc(leaf) if i < n_enc else leaf
+                for i, leaf in enumerate(leaves)]
+
+    def dec_model(self, tensors):
+        n_enc = self._num_encrypted()
+        dec = np.vectorize(self.private_key.decrypt, otypes=[np.float64])
+        return [dec(t) if i < n_enc else t for i, t in enumerate(tensors)]
+
+    def client_update(self, aggregated):
+        """Decrypt + adopt the aggregate — params and moving statistics
+        both (secure_fed_model.py:143-149)."""
+        plain = self.dec_model(aggregated)
+        _, restore = self._flat_weights()
+        self.params, self.model_state = restore(plain)
+
+    def evaluate(self, images: np.ndarray, labels: np.ndarray, loss_fn: LossFn):
+        """loss / binary accuracy / AUROC on a held-out set
+        (secure_fed_model.py:152-154 with the C16 AUROC metric)."""
+        from idc_models_tpu.train import metrics as metrics_lib
+
+        logits, _ = self.model.apply(self.params, self.model_state,
+                                     jnp.asarray(images), train=False)
+        logits = logits.astype(jnp.float32)
+        return {
+            "loss": float(loss_fn(logits, jnp.asarray(labels))),
+            "accuracy": float(metrics_lib.binary_accuracy(
+                logits, jnp.asarray(labels))),
+            "auroc": float(metrics_lib.auroc(
+                jax.nn.sigmoid(logits), jnp.asarray(labels))),
+        }
+
+
+class PaillierServer:
+    """Parity with the reference's stateless `Server.aggregate`
+    (secure_fed_model.py:156-168): elementwise unweighted mean per tensor,
+    operating transparently on EncryptedNumber object arrays (homomorphic
+    add + scalar divide) and plain ndarrays alike."""
+
+    @staticmethod
+    def aggregate(client_weights):
+        n = len(client_weights)
+        out = []
+        for tensors in zip(*client_weights):
+            acc = tensors[0]
+            for t in tensors[1:]:
+                acc = acc + t
+            out.append(acc / n)
+        return out

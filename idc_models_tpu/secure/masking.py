@@ -1,0 +1,130 @@
+"""Pairwise one-time-mask secure aggregation primitives (TPU fast path).
+
+The reference's secure aggregation is Paillier homomorphic encryption of a
+fraction of the weight tensors (secure_fed_model.py:109-129): the server
+averages ciphertexts it cannot read. Pure-Python bignum crypto does not map
+to XLA, so the TPU-native design (SURVEY.md D4) is Bonawitz-style pairwise
+masking: every ordered client pair (i, j) shares a PRG seed; client i adds
+`+mask_ij` for j > i and `-mask_ij` for j < i to its update before the
+`psum`. Each device's contribution is indistinguishable from random to the
+aggregator, but the masks cancel *exactly* in the sum.
+
+Exact cancellation requires integer arithmetic (fp addition of large masks
+would destroy precision): updates are quantized to int32 fixed-point,
+masks are uniform int32, and addition wraps mod 2^32 (two's-complement),
+so `psum` of masked updates == `psum` of plain quantized updates bit-for-bit.
+
+The reference's `percent` knob — encrypt the first `int(num_tensors *
+percent)` weight tensors (secure_fed_model.py:115-121) — maps to a boolean
+selection pytree over the same flatten order (`first_fraction_selection`).
+
+Seed agreement: the reference generates one global keypair visible to all
+parties (quirk Q9); the analogous simplification here is deriving the
+pairwise seed from a shared base key via `fold_in(fold_in(key, lo),
+hi)` — both endpoints of a pair compute the same seed with no exchange. A
+deployment would replace `pair_key` with a Diffie-Hellman-agreed seed; the
+cancellation algebra is unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_SCALE_BITS = 20  # fixed-point fractional bits; range +-2048 in int32
+
+
+def quantize(x: jax.Array, scale_bits: int = DEFAULT_SCALE_BITS) -> jax.Array:
+    """fp32 -> int32 fixed point (round-to-nearest)."""
+    return jnp.round(x.astype(jnp.float32) * (2.0 ** scale_bits)).astype(
+        jnp.int32)
+
+
+def dequantize(q: jax.Array, scale_bits: int = DEFAULT_SCALE_BITS,
+               *, count: jax.Array | float = 1.0) -> jax.Array:
+    """int32 fixed point -> fp32, dividing by `count` (for the mean)."""
+    return q.astype(jnp.float32) / (2.0 ** scale_bits) / count
+
+
+def pair_key(base: jax.Array, i: jax.Array, j: jax.Array) -> jax.Array:
+    """The shared PRG key for the unordered pair {i, j}: both endpoints
+    compute fold_in(fold_in(base, min), max) and get the same key."""
+    lo = jnp.minimum(i, j)
+    hi = jnp.maximum(i, j)
+    return jax.random.fold_in(jax.random.fold_in(base, lo), hi)
+
+
+def pairwise_mask(base: jax.Array, my_id: jax.Array, n_clients: int,
+                  shape, round_index: jax.Array | int = 0) -> jax.Array:
+    """Client `my_id`'s total mask: sum over peers j of sign(i,j)*PRG(i,j).
+
+    Signs are antisymmetric (+ for j > i, - for j < i) and the PRG stream
+    for a pair is identical at both endpoints, so summing all clients'
+    masks gives exactly zero mod 2^32. `round_index` is folded in so masks
+    are one-time per round.
+    """
+    base = jax.random.fold_in(base, round_index)
+    total = jnp.zeros(shape, jnp.int32)
+    iinfo = jnp.iinfo(jnp.int32)
+    for j in range(n_clients):
+        k = pair_key(base, my_id, jnp.int32(j))
+        m = jax.random.randint(k, shape, iinfo.min, iinfo.max,
+                               dtype=jnp.int32)
+        sign = jnp.where(jnp.int32(j) > my_id, jnp.int32(1),
+                         jnp.where(jnp.int32(j) < my_id, jnp.int32(-1),
+                                   jnp.int32(0)))
+        total = total + sign * m
+    return total
+
+
+# Keras get_weights() enumerates each layer's variables in creation order:
+# kernel before bias (Conv2D/Dense), gamma(scale) -> beta(bias) -> moving
+# mean -> moving var (BatchNorm). jax's dict flatten is alphabetical, so
+# ordered selection must re-rank within a layer too.
+_WITHIN_LAYER_RANK = {"kernel": 0, "depthwise_kernel": 0, "scale": 0,
+                      "bias": 1, "mean": 2, "var": 3}
+
+
+def first_fraction_selection(tree, percent: float,
+                             layer_order: tuple[str, ...] | None = None):
+    """Boolean pytree: True for the first int(L * percent) tensors — the
+    reference's partial-encryption selection (secure_fed_model.py:115-121
+    slices `self.weights[:num_enc]`, i.e. Keras get_weights() order).
+
+    With `layer_order` (a Module's `layer_names`), "first" follows the
+    model's layer order with Keras within-layer variable order — matching
+    the reference's get_weights() enumeration for Sequential models.
+    Without it, jax's (alphabetical) flatten order is used; that is a
+    well-defined deterministic order but NOT the reference's, so callers
+    wanting parity must pass the order.
+    """
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = [tuple(k.key for k in p) for p, _ in paths_and_leaves]
+    n_enc = int(len(paths) * percent)
+    ranked = ranked_indices(paths, layer_order)
+    flags = [False] * len(paths)
+    for i in ranked[:n_enc]:
+        flags[i] = True
+    return jax.tree.unflatten(treedef, flags)
+
+
+def ranked_indices(paths: list[tuple[str, ...]],
+                   layer_order: tuple[str, ...] | None) -> list[int]:
+    """Permutation of range(len(paths)) ranking leaf paths in model layer
+    order (Keras get_weights() enumeration); identity without an order."""
+    if not layer_order:
+        return list(range(len(paths)))
+    order_index = {name: i for i, name in enumerate(layer_order)}
+
+    def rank(path):
+        li = order_index.get(path[0], len(layer_order))
+        wi = _WITHIN_LAYER_RANK.get(path[-1], 1)
+        return (li, wi, path)
+
+    return sorted(range(len(paths)), key=lambda i: rank(paths[i]))
+
+
+def leaf_paths(tree) -> list[tuple[str, ...]]:
+    """Key paths of a pytree's leaves in jax flatten order."""
+    paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [tuple(k.key for k in p) for p, _ in paths_and_leaves]

@@ -1,0 +1,156 @@
+"""FedAvg over an 8-client virtual mesh (reference D3/C9-C11 parity).
+
+Covers the SURVEY.md §4 plan: FedAvg on identical shards equals centralized
+training for one round; loss decreases over rounds; weighted aggregation
+semantics; federated evaluation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.data.idc import ArrayDataset
+from idc_models_tpu.data.partition import partition_clients
+from idc_models_tpu.federated import (
+    initialize_server, make_fedavg_round, make_federated_eval,
+    seed_server_with,
+)
+from idc_models_tpu.models import small_cnn
+from idc_models_tpu.train import rmsprop
+from idc_models_tpu.train.losses import binary_cross_entropy
+
+N_CLIENTS = 8
+
+
+def _client_data(n_per_client=32, seed=0, identical=False):
+    if identical:
+        imgs, labels = synthetic.make_idc_like(n_per_client, size=10, seed=seed)
+        return (np.broadcast_to(imgs, (N_CLIENTS,) + imgs.shape).copy(),
+                np.broadcast_to(labels, (N_CLIENTS,) + labels.shape).copy())
+    imgs, labels = synthetic.make_idc_like(n_per_client * N_CLIENTS, size=10,
+                                           seed=seed)
+    ds = ArrayDataset(imgs, labels)
+    return partition_clients(ds, N_CLIENTS, iid=True, seed=seed)
+
+
+def test_fedavg_loss_decreases(devices):
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    server = initialize_server(model, jax.random.key(0))
+    round_fn = make_fedavg_round(model, opt, binary_cross_entropy, mesh,
+                                 local_epochs=2, batch_size=16)
+    imgs, labels = _client_data()
+    weights = np.full((N_CLIENTS,), imgs.shape[1], np.float32)
+
+    losses = []
+    key = jax.random.key(1)
+    for r in range(8):
+        key, sub = jax.random.split(key)
+        server, m = round_fn(server, imgs, labels, weights, sub)
+        losses.append(float(m["loss"]))
+    assert int(server.round) == 8
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def _no_dropout_model():
+    """A deterministic (dropout-free) model so per-client rng folds cannot
+    introduce trajectory differences in the exactness tests."""
+    from idc_models_tpu.models import core
+
+    return core.sequential(
+        [
+            core.conv2d(3, 8, 3, stride=2, name="conv1"),
+            core.relu(),
+            core.flatten(),
+            core.dense(8 * 5 * 5, 1, name="head"),
+        ],
+        name="tiny",
+    )
+
+
+def test_identical_shards_equal_local_training(devices):
+    """Every client holds the same shard and a deterministic model: the
+    averaged trajectory must EXACTLY reproduce a single client's trajectory
+    (FedAvg == centralized for identical clients, SURVEY.md §4)."""
+    mesh8 = meshlib.client_mesh(N_CLIENTS)
+    mesh1 = meshlib.client_mesh(1)
+    model = _no_dropout_model()
+    opt = rmsprop(1e-3)
+    loss = binary_cross_entropy
+    imgs, labels = _client_data(identical=True)
+
+    def run(mesh, n):
+        server = initialize_server(model, jax.random.key(0))
+        # full-batch, 1 epoch: per-client shuffles are permutations of one
+        # batch, so ordering cannot differ either.
+        rnd = make_fedavg_round(model, opt, loss, mesh, local_epochs=1,
+                                batch_size=imgs.shape[1])
+        w = np.ones((n,), np.float32)
+        server, m = rnd(server, imgs[:n], labels[:n], w, jax.random.key(3))
+        return jax.device_get(server.params), m
+
+    p8, m8 = run(mesh8, N_CLIENTS)
+    p1, m1 = run(mesh1, 1)
+    for a, b in zip(jax.tree.leaves(p8), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(m8["loss"]), float(m1["loss"]),
+                               rtol=1e-5)
+
+
+def test_weight_concentration_selects_client(devices):
+    """weights=[1,0,...]: the aggregate must equal client 0's local result."""
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    mesh1 = meshlib.client_mesh(1)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    imgs, labels = _client_data(seed=5)
+    rng = jax.random.key(9)
+
+    server0 = initialize_server(model, jax.random.key(0))
+    rnd8 = make_fedavg_round(model, opt, binary_cross_entropy, mesh,
+                             local_epochs=1, batch_size=imgs.shape[1])
+    w = np.zeros((N_CLIENTS,), np.float32)
+    w[0] = 1.0
+    s8, _ = rnd8(server0, imgs, labels, w, rng)
+
+    server0b = initialize_server(model, jax.random.key(0))
+    rnd1 = make_fedavg_round(model, opt, binary_cross_entropy, mesh1,
+                             local_epochs=1, batch_size=imgs.shape[1])
+    s1, _ = rnd1(server0b, imgs[:1], labels[:1], np.ones((1,), np.float32),
+                 rng)
+    for a, b in zip(jax.tree.leaves(jax.device_get(s8.params)),
+                    jax.tree.leaves(jax.device_get(s1.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_federated_eval(devices):
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    model = small_cnn(10, 3, 1)
+    server = initialize_server(model, jax.random.key(0))
+    eval_fn = make_federated_eval(model, binary_cross_entropy, mesh)
+    imgs, labels = _client_data(seed=7)
+    weights = np.full((N_CLIENTS,), imgs.shape[1], np.float32)
+    m = eval_fn(server, imgs, labels, weights)
+    assert np.isfinite(float(m["loss"]))
+    assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+    # weighted mean across clients == direct eval on the pooled examples
+    logits, _ = model.apply(server.params, server.model_state,
+                            jnp.asarray(imgs.reshape(-1, *imgs.shape[2:])),
+                            train=False)
+    pooled_loss = float(binary_cross_entropy(logits, labels.reshape(-1)))
+    np.testing.assert_allclose(float(m["loss"]), pooled_loss, rtol=1e-5)
+
+
+def test_seed_server_with(devices):
+    model = small_cnn(10, 3, 1)
+    server = initialize_server(model, jax.random.key(0))
+    pretrained = model.init(jax.random.key(123))
+    seeded = seed_server_with(server, pretrained.params, pretrained.state)
+    a = jax.tree.leaves(seeded.params)
+    b = jax.tree.leaves(pretrained.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)

@@ -1,0 +1,228 @@
+"""Secure aggregation: mask cancellation, Paillier round-trips, and the
+secure FedAvg round (SURVEY.md §4: "masks cancel: psum of masked == psum
+of plain; Paillier enc→agg→dec == plain mean")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu import collectives
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.data.idc import ArrayDataset
+from idc_models_tpu.data.partition import partition_clients
+from idc_models_tpu.federated import initialize_server, make_fedavg_round
+from idc_models_tpu.models import small_cnn
+from idc_models_tpu.secure import (
+    dequantize, first_fraction_selection, make_secure_fedavg_round,
+    pairwise_mask, quantize,
+)
+from idc_models_tpu.secure.fedavg import PaillierClient, PaillierServer
+from idc_models_tpu.secure.paillier import generate_paillier_keypair
+from idc_models_tpu.train import rmsprop
+from idc_models_tpu.train.losses import binary_cross_entropy
+
+N_CLIENTS = 8
+
+
+def test_masks_cancel_exactly():
+    """Sum over all clients of the pairwise masks is exactly zero."""
+    key = jax.random.key(7)
+    shape = (33, 5)
+    total = jnp.zeros(shape, jnp.int32)
+    for i in range(N_CLIENTS):
+        total = total + pairwise_mask(key, jnp.int32(i), N_CLIENTS, shape)
+    np.testing.assert_array_equal(np.asarray(total), 0)
+
+
+def test_masked_psum_equals_plain_psum():
+    """psum of masked quantized updates == psum of plain ones, bit-exact,
+    while each individual masked contribution is (pseudo)random."""
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    key = jax.random.key(3)
+    vals = np.random.default_rng(0).normal(size=(N_CLIENTS, 17)).astype(
+        np.float32)
+
+    def body(x):
+        cid = collectives.axis_index(meshlib.CLIENT_AXIS)
+        q = quantize(x[0])
+        m = pairwise_mask(key, cid, N_CLIENTS, q.shape)
+        masked_sum = collectives.psum(q + m, meshlib.CLIENT_AXIS)
+        plain_sum = collectives.psum(q, meshlib.CLIENT_AXIS)
+        return masked_sum, plain_sum, (q + m)[None]
+
+    from jax.sharding import PartitionSpec as P
+    f = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(meshlib.CLIENT_AXIS),
+        out_specs=(P(), P(), P(meshlib.CLIENT_AXIS)), check_vma=False))
+    masked_sum, plain_sum, contributions = f(vals)
+    np.testing.assert_array_equal(np.asarray(masked_sum),
+                                  np.asarray(plain_sum))
+    # each device's masked contribution differs from its plain quantized
+    # update (i.e. the aggregator never sees plaintext)
+    q_plain = np.asarray(quantize(jnp.asarray(vals)))
+    assert not np.array_equal(np.asarray(contributions), q_plain)
+    # and the dequantized mean matches the true mean to quantization error
+    mean = np.asarray(dequantize(masked_sum, count=N_CLIENTS))
+    np.testing.assert_allclose(mean, vals.mean(0), atol=2e-6)
+
+
+def test_quantize_roundtrip():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(100,)) * 5)
+    back = dequantize(quantize(x))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-6)
+
+
+def test_first_fraction_selection():
+    tree = {"a": 1, "b": {"c": 2, "d": 3}, "e": 4}
+    sel = first_fraction_selection(tree, 0.5)
+    flags = jax.tree.leaves(sel)
+    assert flags == [True, True, False, False]  # int(4*0.5)=2
+    assert jax.tree.leaves(first_fraction_selection(tree, 0.0)) == [False] * 4
+    assert jax.tree.leaves(first_fraction_selection(tree, 1.0)) == [True] * 4
+
+
+def test_first_fraction_selection_layer_order():
+    """With a model's layer_names, "first N tensors" follows Keras
+    get_weights() order (layer creation order, kernel before bias), not
+    jax's alphabetical flatten (secure_fed_model.py:115-121 parity)."""
+    model = small_cnn(10, 3, 1)
+    params = model.init(jax.random.key(0)).params
+    # small_cnn layer order: conv1 -> fc1 -> head; get_weights() order is
+    # conv1/kernel, conv1/bias, fc1/kernel, fc1/bias, head/kernel, head/bias.
+    sel = first_fraction_selection(params, 0.5, model.layer_names)
+    assert sel == {
+        "conv1": {"kernel": True, "bias": True},
+        "fc1": {"kernel": True, "bias": False},
+        "head": {"kernel": False, "bias": False},
+    }
+    # alphabetical order would instead have protected conv1/bias,
+    # conv1/kernel, fc1/bias — a different set
+    sel_flat = first_fraction_selection(params, 0.5)
+    assert sel_flat["fc1"] == {"kernel": False, "bias": True}
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    return generate_paillier_keypair(n_length=512)
+
+
+class TestPaillier:
+    def test_roundtrip(self, keypair):
+        pub, priv = keypair
+        for v in [0.0, 1.5, -2.75, 1e-8, -1e8, 123456.789]:
+            assert priv.decrypt(pub.encrypt(v)) == pytest.approx(v, rel=1e-12)
+
+    def test_homomorphic_add(self, keypair):
+        pub, priv = keypair
+        a, b = 3.25, -1.125
+        s = pub.encrypt(a) + pub.encrypt(b)
+        assert priv.decrypt(s) == pytest.approx(a + b, rel=1e-12)
+
+    def test_scalar_mul_div(self, keypair):
+        pub, priv = keypair
+        c = pub.encrypt(7.5) * 0.125
+        assert priv.decrypt(c) == pytest.approx(0.9375, rel=1e-9)
+        d = pub.encrypt(10.0) / 8
+        assert priv.decrypt(d) == pytest.approx(1.25, rel=1e-9)
+
+    def test_ciphertext_mean_equals_plain_mean(self, keypair):
+        pub, priv = keypair
+        vals = [0.5, -1.5, 2.25, 3.0]
+        enc = [pub.encrypt(v) for v in vals]
+        acc = enc[0]
+        for e in enc[1:]:
+            acc = acc + e
+        mean = acc / len(vals)
+        assert priv.decrypt(mean) == pytest.approx(
+            sum(vals) / len(vals), rel=1e-9)
+
+
+def _client_data(n_per_client=32, seed=0):
+    imgs, labels = synthetic.make_idc_like(n_per_client * N_CLIENTS, size=10,
+                                           seed=seed)
+    return partition_clients(ArrayDataset(imgs, labels), N_CLIENTS, iid=True,
+                             seed=seed)
+
+
+def test_secure_round_matches_plain_round(devices):
+    """percent=1.0 secure round == plain unweighted FedAvg round up to
+    quantization error (same rng, same local training)."""
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    imgs, labels = _client_data()
+    rng = jax.random.key(11)
+
+    server_a = initialize_server(model, jax.random.key(0))
+    secure_rnd = make_secure_fedavg_round(
+        model, opt, binary_cross_entropy, mesh, percent=1.0,
+        local_epochs=1, batch_size=16)
+    sa, ma = secure_rnd(server_a, imgs, labels, rng)
+
+    server_b = initialize_server(model, jax.random.key(0))
+    plain_rnd = make_fedavg_round(model, opt, binary_cross_entropy, mesh,
+                                  local_epochs=1, batch_size=16)
+    sb, mb = plain_rnd(server_b, imgs, labels,
+                       np.ones((N_CLIENTS,), np.float32), rng)
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(sa.params)),
+                    jax.tree.leaves(jax.device_get(sb.params))):
+        np.testing.assert_allclose(a, b, atol=3e-6)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]),
+                               rtol=1e-5)
+
+
+def test_secure_fedavg_loss_decreases(devices):
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    imgs, labels = _client_data(seed=4)
+    secure_rnd = make_secure_fedavg_round(
+        model, opt, binary_cross_entropy, mesh, percent=0.5,
+        local_epochs=2, batch_size=16)
+    server = initialize_server(model, jax.random.key(0))
+    key = jax.random.key(5)
+    losses = []
+    for _ in range(10):
+        key, sub = jax.random.split(key)
+        server, m = secure_rnd(server, imgs, labels, sub)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.95, losses
+
+
+def test_paillier_clients_full_protocol(keypair):
+    """The host-side parity protocol end-to-end with 3 clients on tiny
+    shards: fit -> encrypt -> aggregate(ciphertext) -> decrypt -> update;
+    the aggregate equals the plain mean of the clients' weights."""
+    pub, priv = keypair
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    imgs, labels = synthetic.make_idc_like(24, size=10, seed=9)
+    clients = [
+        PaillierClient(model, opt, binary_cross_entropy,
+                       imgs[i::3], labels[i::3], i, percent=0.4,
+                       public_key=pub, private_key=priv,
+                       local_epochs=1, batch_size=8, seed=0)
+        for i in range(3)
+    ]
+    packages = []
+    for c in clients:
+        pkg, _ = c.client_fit()
+        packages.append(pkg)
+    expected = [
+        np.mean([np.asarray(x, np.float64)
+                 for x in [jax.tree.leaves(c.params)[i] for c in clients]],
+                axis=0)
+        for i in range(len(jax.tree.leaves(clients[0].params)))
+    ]
+    agg = PaillierServer.aggregate(packages)
+    for c in clients:
+        c.client_update(agg)
+    for c in clients:
+        got = [np.asarray(x) for x in jax.tree.leaves(c.params)]
+        for g, e in zip(got, expected):
+            np.testing.assert_allclose(g, e, rtol=1e-5, atol=1e-7)
+    m = clients[0].evaluate(imgs, labels, binary_cross_entropy)
+    assert np.isfinite(m["loss"]) and 0 <= m["accuracy"] <= 1
