@@ -1,0 +1,95 @@
+"""Benchmark: IDC patches/sec/chip on the VGG16 fine-tune step.
+
+The north-star metric from BASELINE.json — the TPU generalization of the
+reference's fine-tune Timer (dist_model_tf_vgg.py:156: TRAIN_SIZE x
+epochs / wall-clock). The reference publishes no numbers (BASELINE.md),
+so `vs_baseline` is the ratio against a recorded earlier measurement in
+BENCH_BASELINE.json when present, else 1.0 (this run defines the
+baseline).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "patches/sec/chip", "vs_baseline": N}
+
+Runs on whatever jax.devices() provides (one real TPU chip under the
+driver; CPU elsewhere). Uses the real production train step: bfloat16
+compute (MXU), fine-tune trainability mask, donated state.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.vgg import vgg16, fine_tune_mask
+    from idc_models_tpu.train import (
+        TrainState, jit_data_parallel, make_train_step, replicate, rmsprop,
+        shard_batch,
+    )
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform  # "tpu"/"axon" on chip, else "cpu"
+    on_accelerator = platform != "cpu"
+    per_chip_batch = 128 if on_accelerator else 16
+    batch = per_chip_batch * n_dev
+    warmup, steps = 3, (20 if on_accelerator else 3)
+
+    mesh = meshlib.data_mesh()
+    model = vgg16(num_outputs=1)
+    variables = model.init(jax.random.key(0))
+    opt = rmsprop(1e-4, trainable_mask=fine_tune_mask(variables.params, 15))
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    step = jit_data_parallel(
+        make_train_step(model, opt, binary_cross_entropy,
+                        compute_dtype=jnp.bfloat16), mesh)
+
+    rng = np.random.default_rng(0)
+    imgs = rng.random((batch, 50, 50, 3)).astype(np.float32)
+    labels = (rng.random(batch) > 0.5).astype(np.int32)
+    state = replicate(mesh, state)
+    x, y = shard_batch(mesh, imgs, labels)
+
+    # Block on the full state, not just the loss: the loss only needs the
+    # forward pass, so blocking on it would exclude backward + update.
+    key = jax.random.key(1)
+    for i in range(warmup):
+        key, sub = jax.random.split(key)
+        state, m = step(state, x, y, sub)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        key, sub = jax.random.split(key)
+        state, m = step(state, x, y, sub)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    patches_per_sec_per_chip = steps * batch / dt / n_dev
+    baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
+    vs = 1.0
+    if baseline_path.exists():
+        base = json.loads(baseline_path.read_text()).get("value")
+        if base:
+            vs = patches_per_sec_per_chip / base
+    print(json.dumps({
+        "metric": "IDC patches/sec/chip (VGG16 fine-tune, bf16)",
+        "value": round(patches_per_sec_per_chip, 2),
+        "unit": "patches/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

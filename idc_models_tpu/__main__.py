@@ -1,0 +1,4 @@
+from idc_models_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,391 @@
+"""Command-line entry points — one subcommand per reference script.
+
+Parity target (SURVEY.md C19): the reference's five scripts take
+positional sys.argv (path; fed adds NUM_ROUNDS + iid|noniid; secure adds
+NUM_ROUNDS + percent). Here: proper argparse with the presets from
+`configs.py` as defaults and every hyperparameter overridable.
+
+    python -m idc_models_tpu vgg --path runs/vgg --data-dir .../balanced_IDC_30k
+    python -m idc_models_tpu fed --path runs/fed --rounds 10 --noniid
+    python -m idc_models_tpu secure-fed --rounds 5 --percent 0.5
+
+Data resolution: --data-dir (a `<label>/*.png` tree) if given, else
+`<path>/data/balanced_IDC_30k` if present (the reference's layout,
+dist_model_tf_vgg.py:105), else a synthetic stand-in sized by
+--synthetic-examples so every preset smoke-runs anywhere. Virtual devices
+for laptop/test runs come from --host-devices N (the TPU-pod stand-in).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def main(argv: list[str] | None = None) -> int:
+    ns = _parse(argv)
+    if ns.host_devices:
+        from idc_models_tpu import mesh as meshlib
+
+        meshlib.force_host_devices(ns.host_devices)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    runner = {"vgg": _run_dist, "mobile": _run_dist, "dense": _run_dist,
+              "fed": _run_fed, "secure_fed": _run_secure}[ns.preset_key]
+    runner(ns)
+    return 0
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(prog="idc_models_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="preset_key", required=True)
+
+    def common(sp):
+        sp.add_argument("--path", default=None,
+                        help="artifact root (plots under <path>/logs, "
+                             "checkpoints under <path>/pretrained, jsonl "
+                             "log) — the reference's argv[1]")
+        sp.add_argument("--data-dir", default=None,
+                        help="directory tree <label>/*.png")
+        sp.add_argument("--synthetic-examples", type=int, default=512,
+                        help="synthetic dataset size when no real data")
+        sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument("--host-devices", type=int, default=0,
+                        help="force N virtual CPU devices (TPU-pod "
+                             "stand-in for local runs)")
+        sp.add_argument("--batch-size", type=int, default=None)
+        sp.add_argument("--lr", type=float, default=None)
+
+    for key in ("vgg", "mobile", "dense"):
+        sp = sub.add_parser(key, help=f"{key} two-phase DP training")
+        common(sp)
+        sp.add_argument("--epochs", type=int, default=None)
+        sp.add_argument("--fine-tune-epochs", type=int, default=None)
+        sp.add_argument("--fine-tune-at", type=int, default=None)
+
+    sp = sub.add_parser("fed", help="federated averaging (FedAvg)")
+    common(sp)
+    sp.add_argument("--rounds", type=int, default=None)
+    sp.add_argument("--iid", dest="iid", action="store_true", default=None)
+    sp.add_argument("--noniid", dest="iid", action="store_false")
+    sp.add_argument("--num-clients", type=int, default=None)
+    sp.add_argument("--local-epochs", type=int, default=None)
+    sp.add_argument("--pretrain-epochs", type=int, default=None)
+
+    sp = sub.add_parser("secure-fed", aliases=["secure_fed"],
+                        help="secure-aggregation FedAvg")
+    common(sp)
+    sp.add_argument("--rounds", type=int, default=None)
+    sp.add_argument("--percent", type=float, default=None)
+    sp.add_argument("--num-clients", type=int, default=None)
+    sp.add_argument("--local-epochs", type=int, default=None)
+    sp.add_argument("--paillier", action="store_true", default=None,
+                    help="host-side Paillier parity mode instead of "
+                             "pairwise masks")
+
+    ns = p.parse_args(argv)
+    ns.preset_key = ns.preset_key.replace("-", "_")
+    return ns
+
+
+def _apply_overrides(preset, ns, fields):
+    kw = {}
+    for f in fields:
+        v = getattr(ns, f, None)
+        if v is not None:
+            kw[f] = v
+    return dataclasses.replace(preset, **kw) if kw else preset
+
+
+def _logger(ns):
+    from idc_models_tpu.observe import JsonlLogger
+
+    if ns.path is None:
+        return None
+    return JsonlLogger(Path(ns.path) / "logs" / "run.jsonl")
+
+
+def _load_idc(ns, image_size, limit):
+    """--data-dir > <path>/data/balanced_IDC_30k > synthetic."""
+    from idc_models_tpu.data import synthetic
+    from idc_models_tpu.data.idc import ArrayDataset, load_directory
+
+    root = ns.data_dir
+    if root is None and ns.path is not None:
+        cand = Path(ns.path) / "data" / "balanced_IDC_30k"
+        if cand.exists():
+            root = cand
+    if root is not None:
+        return load_directory(root, image_size=image_size, limit=limit,
+                              seed=ns.seed)
+    print(f"[idc_models_tpu] no IDC data found; using "
+          f"{ns.synthetic_examples} synthetic {image_size}x{image_size} "
+          f"patches", file=sys.stderr)
+    imgs, labels = synthetic.make_idc_like(ns.synthetic_examples,
+                                           size=image_size, seed=ns.seed)
+    return ArrayDataset(imgs, labels)
+
+
+def _run_dist(ns):
+    import jax
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.configs import get_preset
+    from idc_models_tpu.data.cifar10 import load_cifar10
+    from idc_models_tpu.data.idc import train_val_test_split
+    from idc_models_tpu.train import TwoPhaseConfig, evaluate, two_phase_fit
+
+    preset = _apply_overrides(
+        get_preset(ns.preset_key), ns,
+        ["batch_size", "lr", "epochs", "fine_tune_epochs", "fine_tune_at"])
+    mesh = meshlib.data_mesh()
+    n_dev = mesh.devices.size
+    global_batch = (preset.batch_size * n_dev if preset.per_replica_batch
+                    else preset.batch_size)
+    print(f"Number of devices: {n_dev}")
+
+    if preset.dataset == "cifar10":
+        ds = load_cifar10(ns.path, split="train",
+                          synthetic_size=ns.synthetic_examples, seed=ns.seed)
+        test = load_cifar10(ns.path, split="test",
+                            synthetic_size=max(ns.synthetic_examples // 5, 64),
+                            seed=ns.seed)
+        train, val, _ = train_val_test_split(ds, (0.9, 0.1, 0.0),
+                                             seed=ns.seed)
+    else:
+        ds = _load_idc(ns, preset.image_size, preset.dataset_limit)
+        train, val, test = train_val_test_split(ds, seed=ns.seed)
+
+    logger = _logger(ns)
+    result = two_phase_fit(
+        preset.model, preset.num_outputs, train, val, mesh,
+        TwoPhaseConfig(lr=preset.lr, epochs=preset.epochs,
+                       fine_tune_epochs=preset.fine_tune_epochs,
+                       batch_size=global_batch,
+                       fine_tune_at=preset.fine_tune_at, seed=ns.seed),
+        artifact_path=ns.path, logger=logger)
+    test_metrics = evaluate(result.model, result.state, test,
+                            _loss_for(preset.num_outputs), mesh,
+                            batch_size=global_batch,
+                            with_auroc=preset.num_outputs == 1)
+    print("test:", " ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
+    if logger:
+        logger.log(event="test", **test_metrics)
+        logger.close()
+
+
+def _loss_for(num_outputs):
+    from idc_models_tpu.train.losses import (
+        binary_cross_entropy, sparse_categorical_cross_entropy,
+    )
+
+    return (binary_cross_entropy if num_outputs == 1
+            else sparse_categorical_cross_entropy)
+
+
+def _run_fed(ns):
+    import jax
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.configs import get_preset
+    from idc_models_tpu.data.partition import (
+        partition_clients, train_test_client_split,
+    )
+    from idc_models_tpu.federated import (
+        initialize_server, make_fedavg_round, make_federated_eval,
+        seed_server_with,
+    )
+    from idc_models_tpu.models import registry
+    from idc_models_tpu.observe import Timer
+    from idc_models_tpu.train import (
+        TrainState, TwoPhaseConfig, checkpoint_exists, restore_checkpoint,
+        rmsprop, save_checkpoint, two_phase_fit,
+    )
+
+    preset = _apply_overrides(
+        get_preset("fed"), ns,
+        ["batch_size", "lr", "rounds", "iid", "num_clients", "local_epochs",
+         "pretrain_epochs"])
+    n_dev = len(jax.devices())
+    n_clients = min(preset.num_clients, n_dev)
+    if n_clients < preset.num_clients:
+        print(f"[idc_models_tpu] clamping num_clients "
+              f"{preset.num_clients} -> {n_clients} (devices)",
+              file=sys.stderr)
+    ds = _load_idc(ns, preset.image_size, preset.dataset_limit)
+    logger = _logger(ns)
+
+    # Pretrain (C8): checkpoint-gated two-phase VGG16 on the pooled data.
+    spec = registry.get_model(preset.model)
+    mesh_dp = meshlib.data_mesh()
+    from idc_models_tpu.data.idc import train_val_test_split
+
+    train, val, _ = train_val_test_split(ds, seed=ns.seed)
+    ckpt = (Path(ns.path) / "pretrained" / "cp.ckpt" if ns.path else None)
+    model = spec.build(preset.num_outputs, 3)
+    if ckpt is not None and checkpoint_exists(ckpt):
+        variables = model.init(jax.random.key(ns.seed))
+        target = {"params": variables.params, "state": variables.state}
+        restored = restore_checkpoint(ckpt, target)
+        params, model_state = restored["params"], restored["state"]
+        print(f"restored pretrained weights from {ckpt}")
+    else:
+        result = two_phase_fit(
+            preset.model, preset.num_outputs, train, val, mesh_dp,
+            TwoPhaseConfig(lr=preset.lr, epochs=preset.pretrain_epochs,
+                           fine_tune_epochs=0,
+                           batch_size=preset.batch_size,
+                           fine_tune_at=preset.fine_tune_at, seed=ns.seed),
+            artifact_path=ns.path, logger=logger)
+        params, model_state = result.state.params, result.state.model_state
+        if ckpt is not None:
+            save_checkpoint(ckpt, {"params": jax.device_get(params),
+                                   "state": jax.device_get(model_state)})
+
+    # Federate: clients fine-tune above fine_tune_at at lr/10
+    # (fed_model.py:140-147,208).
+    mesh = meshlib.client_mesh(n_clients)
+    imgs, labels = partition_clients(ds, n_clients, iid=bool(preset.iid),
+                                     seed=ns.seed)
+    train_ids, test_ids = train_test_client_split(
+        n_clients, preset.test_client_fraction, seed=ns.seed)
+    opt = rmsprop(preset.lr / 10.0,
+                  trainable_mask=spec.fine_tune_mask(params,
+                                                     preset.fine_tune_at))
+    server = seed_server_with(
+        initialize_server(model, jax.random.key(ns.seed)),
+        params, model_state)
+    round_fn = make_fedavg_round(model, opt, _loss_for(preset.num_outputs),
+                                 mesh, local_epochs=preset.local_epochs,
+                                 batch_size=preset.batch_size)
+    eval_fn = make_federated_eval(model, _loss_for(preset.num_outputs), mesh)
+    # train clients carry weight = examples; test clients weight 0
+    w_train = np.zeros((n_clients,), np.float32)
+    w_train[train_ids] = imgs.shape[1]
+    w_test = np.zeros((n_clients,), np.float32)
+    w_test[test_ids] = imgs.shape[1]
+    key = jax.random.key(ns.seed + 1)
+    print("round, train_loss, train_acc, test_loss, test_acc")
+    with Timer("Federated training", logger=logger):
+        for r in range(preset.rounds):
+            key, sub = jax.random.split(key)
+            server, tm = round_fn(server, imgs, labels, w_train, sub)
+            em = eval_fn(server, imgs, labels, w_test)
+            print(f"{r}, {float(tm['loss']):.4f}, "
+                  f"{float(tm['accuracy']):.4f}, {float(em['loss']):.4f}, "
+                  f"{float(em['accuracy']):.4f}")
+            if logger:
+                logger.log(event="round", round=r,
+                           train_loss=tm["loss"], train_acc=tm["accuracy"],
+                           test_loss=em["loss"], test_acc=em["accuracy"])
+    if logger:
+        logger.close()
+
+
+def _run_secure(ns):
+    import jax
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.configs import get_preset
+    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.models import registry
+    from idc_models_tpu.observe import Timer
+    from idc_models_tpu.train import Evaluator, rmsprop
+    from idc_models_tpu.federated import initialize_server
+    from idc_models_tpu.secure import make_secure_fedavg_round
+
+    preset = _apply_overrides(
+        get_preset("secure_fed"), ns,
+        ["batch_size", "lr", "rounds", "percent", "num_clients",
+         "local_epochs", "paillier"])
+    n_dev = len(jax.devices())
+    n_clients = min(preset.num_clients, n_dev)
+    ds = _load_idc(ns, preset.image_size, None)
+    n_client_total = min(preset.client_examples, int(len(ds) * 0.8))
+    client_ds = ds.take(n_client_total)
+    test_ds = ds.skip(n_client_total)
+    logger = _logger(ns)
+
+    spec = registry.get_model(preset.model)
+    model = spec.build(preset.num_outputs, 3)
+    loss_fn = _loss_for(preset.num_outputs)
+    opt = rmsprop(preset.lr)
+
+    if preset.paillier:
+        _run_secure_paillier(preset, n_clients, client_ds, test_ds, model,
+                             opt, loss_fn, logger, ns)
+        return
+
+    # strided shard per client (secure_fed_model.py:206-210), stacked for
+    # the client mesh
+    shards = [client_ds.shard(n_clients, i) for i in range(n_clients)]
+    size = min(len(s) for s in shards)
+    imgs = np.stack([s.images[:size] for s in shards])
+    labels = np.stack([s.labels[:size] for s in shards])
+
+    mesh = meshlib.client_mesh(n_clients)
+    server = initialize_server(model, jax.random.key(ns.seed))
+    round_fn = make_secure_fedavg_round(
+        model, opt, loss_fn, mesh, percent=preset.percent,
+        local_epochs=preset.local_epochs, batch_size=preset.batch_size)
+    evaluator = Evaluator(model, loss_fn, mesh, batch_size=preset.batch_size,
+                          with_auroc=True)
+    key = jax.random.key(ns.seed + 1)
+    with Timer("Secure fed model", logger=logger):
+        for r in range(preset.rounds):
+            key, sub = jax.random.split(key)
+            server, tm = round_fn(server, imgs, labels, sub)
+            from idc_models_tpu.train import TrainState
+
+            eval_state = TrainState(step=server.round, params=server.params,
+                                    model_state=server.model_state,
+                                    opt_state=None)
+            em = evaluator(eval_state, test_ds)
+            print(f"round {r}: train_loss={float(tm['loss']):.4f} "
+                  f"test_loss={em['loss']:.4f} acc={em['accuracy']:.4f} "
+                  f"auroc={em['auroc']:.4f}")
+            if logger:
+                logger.log(event="round", round=r, train_loss=tm["loss"],
+                           **{f"test_{k}": v for k, v in em.items()})
+    if logger:
+        logger.close()
+
+
+def _run_secure_paillier(preset, n_clients, client_ds, test_ds, model, opt,
+                         loss_fn, logger, ns):
+    from idc_models_tpu.observe import Timer
+    from idc_models_tpu.secure.fedavg import PaillierClient, PaillierServer
+    from idc_models_tpu.secure.paillier import generate_paillier_keypair
+
+    pub, priv = generate_paillier_keypair(512)
+    clients = []
+    for i in range(n_clients):
+        shard = client_ds.shard(n_clients, i)
+        clients.append(PaillierClient(
+            model, opt, loss_fn, shard.images, shard.labels, i,
+            preset.percent, pub, priv, local_epochs=preset.local_epochs,
+            batch_size=preset.batch_size, seed=ns.seed))
+    with Timer("Secure fed model", logger=logger):
+        for r in range(preset.rounds):
+            packages = []
+            for c in clients:
+                with Timer(f"Client {c.client_id} training"):
+                    pkg, _ = c.client_fit()
+                packages.append(pkg)
+            agg = PaillierServer.aggregate(packages)
+            for c in clients:
+                c.client_update(agg)
+            m = clients[0].evaluate(test_ds.images, test_ds.labels, loss_fn)
+            print(f"round {r}: " + " ".join(f"{k}={v:.4f}"
+                                            for k, v in m.items()))
+            if logger:
+                logger.log(event="round", round=r, **m)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,7 +123,7 @@ def make_local_trainer(
                 loss_of, has_aux=True)(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            acc = _auto_accuracy(logits, y)
+            acc = metrics_lib.auto_accuracy(logits, y)
             return (params, new_ms, opt_state), (loss, acc)
 
         def epoch(carry, epoch_rng):
@@ -232,7 +232,7 @@ def make_federated_eval(model: core.Module, loss_fn: LossFn, mesh: Mesh, *,
         logits = logits.astype(jnp.float32)
         m = {
             "loss": loss_fn(logits, labels),
-            "accuracy": _auto_accuracy(logits, labels),
+            "accuracy": metrics_lib.auto_accuracy(logits, labels),
         }
         return collectives.weighted_pmean(m, weight, meshlib.CLIENT_AXIS)
 
@@ -252,8 +252,3 @@ def make_federated_eval(model: core.Module, loss_fn: LossFn, mesh: Mesh, *,
 
     return eval_fn
 
-
-def _auto_accuracy(logits, labels):
-    if logits.ndim == 2 and logits.shape[-1] > 1:
-        return metrics_lib.accuracy(logits, labels)
-    return metrics_lib.binary_accuracy(logits, labels)

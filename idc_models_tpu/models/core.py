@@ -353,8 +353,13 @@ def classifier(backbone: Module, feature_dim: int, num_outputs: int,
         y, _ = head.apply(params["head"], {}, h, train=train)
         return y, {"backbone": bb_state}
 
+    # Propagate the backbone's internal layer order as dotted paths so
+    # ordered-tensor consumers (secure `percent` selection) see the true
+    # get_weights()-style enumeration, not just the two top-level keys.
+    bb_names = (tuple(f"backbone.{n}" for n in backbone.layer_names)
+                if backbone.layer_names else ("backbone",))
     return Module(init, apply, name or f"{backbone.name}_classifier",
-                  layer_names=("backbone", "head"))
+                  layer_names=bb_names + ("head",))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,3 @@
+from idc_models_tpu.observe.timer import Timer, profile_trace  # noqa: F401
+from idc_models_tpu.observe.logging import JsonlLogger  # noqa: F401
+from idc_models_tpu.observe.plots import plot_history  # noqa: F401

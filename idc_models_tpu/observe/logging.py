@@ -1,0 +1,56 @@
+"""Structured jsonl run logs.
+
+The reference's observability is raw history-dict prints and a per-round
+CSV-ish line (SURVEY.md §5, fed_model.py:229, dist_model_tf_vgg.py:100-101).
+The framework keeps those human-readable prints at the call sites and adds
+an append-only jsonl stream — one timestamped record per step/epoch/round —
+so runs are machine-comparable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+
+class JsonlLogger:
+    """Append-only jsonl writer; every record gets a wall-clock timestamp.
+
+    Records with numpy/jax scalar values are coerced to Python floats so
+    the file is plain JSON.
+    """
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(self.path, "a", buffering=1)
+
+    def log(self, **record) -> None:
+        rec = {"ts": time.time()}
+        for k, v in record.items():
+            rec[k] = _jsonable(v)
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "JsonlLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _jsonable(v):
+    if hasattr(v, "item") and getattr(v, "ndim", None) in (0, None):
+        try:
+            return v.item()
+        except Exception:
+            pass
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v

@@ -1,0 +1,50 @@
+"""Named wall-clock spans + TPU profiler hooks.
+
+Parity: the reference's `Timer` context manager is copy-pasted into all
+five scripts and prints "{name} took {t} seconds" around every expensive
+phase (SURVEY.md C17, e.g. dist_model_tf_dense.py:31-44, usage
+dist_model_tf_vgg.py:135,156). Here it is one class, optionally feeding a
+structured jsonl log, plus a `jax.profiler` trace context for real TPU
+profiling (the reference has no profiler integration — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class Timer:
+    """`with Timer("Pre-training for 10 epochs"):` — prints the reference's
+    exact line; `.seconds` holds the measurement afterwards."""
+
+    def __init__(self, name: str, *, logger=None, quiet: bool = False):
+        self.name = name
+        self.logger = logger
+        self.quiet = quiet
+        self.seconds: float | None = None
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.seconds = time.perf_counter() - self._t0
+        if not self.quiet:
+            print(f"{self.name} took {self.seconds} seconds")
+        if self.logger is not None:
+            self.logger.log(event="timer", name=self.name,
+                            seconds=self.seconds)
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str | None):
+    """jax.profiler trace over the span (TensorBoard-viewable); no-op when
+    `logdir` is None so call sites can be unconditional."""
+    if logdir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(logdir)):
+        yield

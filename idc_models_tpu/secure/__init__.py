@@ -1,4 +1,5 @@
 from idc_models_tpu.secure.masking import (  # noqa: F401
+    choose_scale_bits,
     dequantize,
     first_fraction_selection,
     pairwise_mask,

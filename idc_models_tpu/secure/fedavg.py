@@ -61,7 +61,8 @@ def make_secure_fedavg_round(
     percent: float,
     local_epochs: int = 5,
     batch_size: int = 32,
-    scale_bits: int = masking.DEFAULT_SCALE_BITS,
+    scale_bits: int | None = None,
+    clip_abs: float = masking.DEFAULT_CLIP_ABS,
     compute_dtype=jnp.float32,
 ):
     """Build the jitted one-round secure-FedAvg program.
@@ -69,9 +70,16 @@ def make_secure_fedavg_round(
     Returns ``round_fn(server_state, images [C,S,...], labels [C,S], rng)
     -> (server_state, metrics)``. The aggregate is the unweighted mean
     (reference parity, quirk Q7); `percent` of the parameter tensors (in
-    flatten order) go through the masked integer path.
+    model layer order) go through the masked integer path.
+
+    `scale_bits` defaults to the largest fixed-point precision whose
+    cross-client sum of clipped (+-clip_abs) values cannot overflow int32
+    (`masking.choose_scale_bits`) — overflow would silently corrupt the
+    aggregate, so the headroom is budgeted, not assumed.
     """
     n_clients = mesh.shape[meshlib.CLIENT_AXIS]
+    if scale_bits is None:
+        scale_bits = masking.choose_scale_bits(n_clients, clip_abs)
     local_train = make_local_trainer(
         model, optimizer, loss_fn, local_epochs=local_epochs,
         batch_size=batch_size, compute_dtype=compute_dtype)
@@ -97,7 +105,7 @@ def make_secure_fedavg_round(
         agg_leaves = []
         for t_index, (leaf, protected) in enumerate(zip(leaves, flags)):
             if protected:
-                q = masking.quantize(leaf, scale_bits)
+                q = masking.quantize(leaf, scale_bits, clip_abs=clip_abs)
                 tensor_key = jax.random.fold_in(mask_key, t_index)
                 m = masking.pairwise_mask(tensor_key, cid, n_clients,
                                           leaf.shape)

@@ -28,16 +28,39 @@ cancellation algebra is unchanged.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-DEFAULT_SCALE_BITS = 20  # fixed-point fractional bits; range +-2048 in int32
+DEFAULT_SCALE_BITS = 20  # fixed-point fractional bits
+DEFAULT_CLIP_ABS = 64.0  # quantization clipping range for weights
 
 
-def quantize(x: jax.Array, scale_bits: int = DEFAULT_SCALE_BITS) -> jax.Array:
-    """fp32 -> int32 fixed point (round-to-nearest)."""
-    return jnp.round(x.astype(jnp.float32) * (2.0 ** scale_bits)).astype(
-        jnp.int32)
+def choose_scale_bits(n_clients: int,
+                      clip_abs: float = DEFAULT_CLIP_ABS) -> int:
+    """Largest scale_bits such that the un-masked sum over `n_clients`
+    values of magnitude <= clip_abs cannot overflow int32 — i.e.
+    2^scale * clip_abs * n_clients <= 2^31. (Mask wraparound is mod-2^32
+    by design and cancels; it is the *unwrapped* sum of quantized values
+    that must stay in range for dequantize to be correct.)"""
+    bits = 31 - math.ceil(math.log2(max(n_clients, 1) * clip_abs))
+    if bits < 1:
+        raise ValueError(
+            f"no int32 headroom for {n_clients} clients at clip {clip_abs}")
+    return min(bits, DEFAULT_SCALE_BITS)
+
+
+def quantize(x: jax.Array, scale_bits: int = DEFAULT_SCALE_BITS, *,
+             clip_abs: float | None = DEFAULT_CLIP_ABS) -> jax.Array:
+    """fp32 -> int32 fixed point (round-to-nearest), clipped to
+    +-clip_abs so the value always fits its headroom budget (see
+    `choose_scale_bits`) instead of silently wrapping."""
+    x = x.astype(jnp.float32)
+    if clip_abs is not None:
+        x = jnp.clip(x, -clip_abs, clip_abs)
+    return jnp.round(x * (2.0 ** scale_bits)).astype(jnp.int32)
 
 
 def dequantize(q: jax.Array, scale_bits: int = DEFAULT_SCALE_BITS,
@@ -62,19 +85,24 @@ def pairwise_mask(base: jax.Array, my_id: jax.Array, n_clients: int,
     for a pair is identical at both endpoints, so summing all clients'
     masks gives exactly zero mod 2^32. `round_index` is folded in so masks
     are one-time per round.
+
+    Implemented as a `fori_loop` so the traced program is O(1) in client
+    count (one PRG op, n iterations at runtime) instead of unrolling
+    n_clients full-tensor streams per protected tensor.
     """
     base = jax.random.fold_in(base, round_index)
-    total = jnp.zeros(shape, jnp.int32)
     iinfo = jnp.iinfo(jnp.int32)
-    for j in range(n_clients):
-        k = pair_key(base, my_id, jnp.int32(j))
+    my_id = jnp.asarray(my_id, jnp.int32)
+
+    def body(j, total):
+        j = jnp.asarray(j, jnp.int32)
+        k = pair_key(base, my_id, j)
         m = jax.random.randint(k, shape, iinfo.min, iinfo.max,
                                dtype=jnp.int32)
-        sign = jnp.where(jnp.int32(j) > my_id, jnp.int32(1),
-                         jnp.where(jnp.int32(j) < my_id, jnp.int32(-1),
-                                   jnp.int32(0)))
-        total = total + sign * m
-    return total
+        sign = jnp.sign(j - my_id)
+        return total + sign * m
+
+    return lax.fori_loop(0, n_clients, body, jnp.zeros(shape, jnp.int32))
 
 
 # Keras get_weights() enumerates each layer's variables in creation order:
@@ -111,13 +139,24 @@ def first_fraction_selection(tree, percent: float,
 def ranked_indices(paths: list[tuple[str, ...]],
                    layer_order: tuple[str, ...] | None) -> list[int]:
     """Permutation of range(len(paths)) ranking leaf paths in model layer
-    order (Keras get_weights() enumeration); identity without an order."""
+    order (Keras get_weights() enumeration); identity without an order.
+
+    `layer_order` entries may be dotted paths ("backbone.block1_conv1") as
+    produced by `core.classifier`; a leaf is assigned the longest matching
+    prefix of its own dotted path, so nested composites rank by their true
+    layer order rather than collapsing to the top-level key.
+    """
     if not layer_order:
         return list(range(len(paths)))
     order_index = {name: i for i, name in enumerate(layer_order)}
 
     def rank(path):
-        li = order_index.get(path[0], len(layer_order))
+        li = len(layer_order)
+        for k in range(len(path) - 1, 0, -1):
+            hit = order_index.get(".".join(path[:k]))
+            if hit is not None:
+                li = hit
+                break
         wi = _WITHIN_LAYER_RANK.get(path[-1], 1)
         return (li, wi, path)
 

@@ -7,3 +7,17 @@ from idc_models_tpu.train.step import (  # noqa: F401
     replicate,
     shard_batch,
 )
+from idc_models_tpu.train.loop import (  # noqa: F401
+    Evaluator,
+    TwoPhaseConfig,
+    TwoPhaseResult,
+    evaluate,
+    fit,
+    two_phase_fit,
+)
+from idc_models_tpu.train.checkpoint import (  # noqa: F401
+    checkpoint_exists,
+    load_or_train,
+    restore_checkpoint,
+    save_checkpoint,
+)

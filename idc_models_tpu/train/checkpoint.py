@@ -1,0 +1,65 @@
+"""Checkpoint / resume via orbax.
+
+Parity target (SURVEY.md C8, §5): the reference checkpoints only the
+pretrainer's weights with Keras `ModelCheckpoint(save_weights_only=True)`
+to `<path>/pretrained/cp.ckpt` (fed_model.py:100-105), reloads them on
+restart (fed_model.py:136-138), and gates on existence — with the
+`sys.path.exists` crash bug Q5 (fed_model.py:175; `os.path` intended).
+Nothing checkpoints the distributed or federated loops.
+
+Here every loop state is one pytree (TrainState / ServerState), so a
+single orbax save/restore covers params, BatchNorm statistics, optimizer
+state, and the step/round counter — checkpoint-resume is uniform across
+plain DP training, the two-phase schedule, and federated rounds. The
+existence gate is implemented correctly (fixing Q5).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.Checkpointer(ocp.StandardCheckpointHandler())
+
+
+def checkpoint_exists(path: str | os.PathLike) -> bool:
+    """The reference's intent at fed_model.py:175 (`os.path.exists`, not
+    the buggy `sys.path.exists`)."""
+    return Path(path).exists()
+
+
+def save_checkpoint(path: str | os.PathLike, state: Any, *,
+                    force: bool = True) -> str:
+    """Save a pytree (TrainState, ServerState, bare params...) to `path`."""
+    path = Path(path).absolute()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    _checkpointer().save(path, state, force=force)
+    return str(path)
+
+
+def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
+    """Restore into the structure/shardings of `target` (an abstract or
+    concrete pytree of the same shape as what was saved)."""
+    path = Path(path).absolute()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(
+            x, "sharding", None)) if hasattr(x, "shape") else x,
+        target)
+    return _checkpointer().restore(path, abstract)
+
+
+def load_or_train(path: str | os.PathLike, target: Any, train_fn):
+    """The pretrainer gate (C8): restore `path` if it exists, else run
+    `train_fn() -> state`, save it, and return it."""
+    if checkpoint_exists(path):
+        return restore_checkpoint(path, target), True
+    state = train_fn()
+    save_checkpoint(path, state)
+    return state, False

@@ -1,0 +1,274 @@
+"""Training orchestration: epoch loops, evaluation, and the two-phase
+transfer-learning schedule.
+
+Parity target (SURVEY.md C7, dist_model_tf_vgg.py:130-160): compile with
+RMSprop + from-logits loss -> `evaluate` the un-trained floor on a few
+validation batches -> fit N epochs with the backbone frozen -> unfreeze
+above `fine_tune_at`, recompile at lr/10 -> fit the remaining epochs
+continuing the epoch counter. The reference hides the loop inside
+`model.fit`; here it is explicit: host loader -> HBM prefetch -> jitted
+DP train step -> per-epoch validation metrics -> Keras-style history
+dicts, with named Timers (C17), jsonl records, and the training-curve
+plot artifact (C18).
+
+Freeze/unfreeze is an optimizer mask (core.trainability_mask via the
+registry's mask builders) instead of the reference's recompile dance
+(quirk Q6); recompiling at lr/10 maps to a fresh optimizer (and fresh
+optimizer state, matching Keras recompile) over the same params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from idc_models_tpu.data.idc import ArrayDataset
+from idc_models_tpu.data.pipeline import Loader, pad_to_multiple, prefetch_to_mesh
+from idc_models_tpu.models import core, registry
+from idc_models_tpu.observe import Timer, plot_history
+from idc_models_tpu.train import metrics as metrics_lib
+from idc_models_tpu.train.state import TrainState, create_train_state, rmsprop
+from idc_models_tpu.train.step import (
+    jit_data_parallel, make_eval_step, make_train_step, replicate, shard_batch,
+)
+
+History = dict[str, list[float]]
+
+
+class Evaluator:
+    """Holds one jitted eval step so repeated (per-epoch) evaluation does
+    not recompile. Call with (state, ds) -> metrics dict.
+
+    `steps` limits evaluation to the first `steps` batches — the
+    reference's `validation_steps=20` floor sample (quirk Q3,
+    dist_model_tf_vgg.py:15,134); None means the exact full set (padded
+    final batch, every example counted once).
+    """
+
+    def __init__(self, model: core.Module, loss_fn, mesh: Mesh, *,
+                 batch_size: int = 32, compute_dtype=jnp.float32,
+                 with_auroc: bool = False):
+        self.mesh = mesh
+        self.loss_fn = loss_fn
+        self.batch_size = batch_size
+        self.with_auroc = with_auroc
+        self._step = jit_data_parallel(
+            make_eval_step(model, loss_fn, compute_dtype=compute_dtype),
+            mesh, donate_state=False)
+
+    def __call__(self, state: TrainState, ds: ArrayDataset, *,
+                 steps: int | None = None) -> dict[str, float]:
+        n_dev = self.mesh.devices.size
+        state = replicate(self.mesh, state)
+        logits_parts, labels_parts = [], []
+        loader = Loader(ds, self.batch_size, shuffle=False,
+                        drop_remainder=False)
+        for i, (x, y) in enumerate(loader.epoch(0)):
+            if steps is not None and i >= steps:
+                break
+            x, y, mask = pad_to_multiple(x, y, n_dev)
+            m = self._step(state, *shard_batch(self.mesh, x, y))
+            logits_parts.append(np.asarray(m["logits"])[mask])
+            labels_parts.append(y[mask])
+        logits = jnp.asarray(np.concatenate(logits_parts))
+        labels = jnp.asarray(np.concatenate(labels_parts))
+        out = {
+            "loss": float(self.loss_fn(logits, labels)),
+            "accuracy": float(metrics_lib.auto_accuracy(logits, labels)),
+        }
+        if self.with_auroc:
+            out["auroc"] = float(metrics_lib.auroc(
+                jax.nn.sigmoid(logits.reshape(-1)), labels))
+        return out
+
+
+def evaluate(model: core.Module, state: TrainState, ds: ArrayDataset,
+             loss_fn, mesh: Mesh, *, batch_size: int = 32,
+             steps: int | None = None, compute_dtype=jnp.float32,
+             with_auroc: bool = False) -> dict[str, float]:
+    """One-shot evaluation (builds a throwaway Evaluator)."""
+    ev = Evaluator(model, loss_fn, mesh, batch_size=batch_size,
+                   compute_dtype=compute_dtype, with_auroc=with_auroc)
+    return ev(state, ds, steps=steps)
+
+
+def fit(model: core.Module, optimizer: optax.GradientTransformation,
+        loss_fn, state: TrainState, train_ds: ArrayDataset,
+        val_ds: ArrayDataset | None, mesh: Mesh, *, epochs: int,
+        batch_size: int = 32, initial_epoch: int = 0, seed: int = 0,
+        logger=None, verbose: bool = True,
+        compute_dtype=jnp.float32) -> tuple[TrainState, History]:
+    """Keras-`fit`-shaped epoch loop over the jitted DP train step.
+
+    Returns the final state and a Keras-style history dict
+    ({"loss", "accuracy", "val_loss", "val_accuracy"} per epoch).
+    `initial_epoch` continues a previous schedule's epoch numbering
+    (dist_model_tf_vgg.py:159 `initial_epoch=history.epoch[-1]`).
+    """
+    step_fn = jit_data_parallel(
+        make_train_step(model, optimizer, loss_fn,
+                        compute_dtype=compute_dtype), mesh)
+    state = replicate(mesh, state)
+    loader = Loader(train_ds, batch_size, shuffle=True, seed=seed)
+    evaluator = (Evaluator(model, loss_fn, mesh, batch_size=batch_size,
+                           compute_dtype=compute_dtype)
+                 if val_ds is not None else None)
+    history: History = {"loss": [], "accuracy": [],
+                        "val_loss": [], "val_accuracy": []}
+    key = jax.random.key(seed)
+    for epoch in range(initial_epoch, epochs):
+        losses, accs = [], []
+        for x, y in prefetch_to_mesh(loader.epoch(epoch), mesh):
+            key, sub = jax.random.split(key)
+            state, m = step_fn(state, x, y, sub)
+            losses.append(m["loss"])
+            accs.append(m["accuracy"])
+        ep = {
+            "loss": float(jnp.mean(jnp.stack(losses))),
+            "accuracy": float(jnp.mean(jnp.stack(accs))),
+        }
+        if evaluator is not None:
+            vm = evaluator(state, val_ds)
+            ep["val_loss"] = vm["loss"]
+            ep["val_accuracy"] = vm["accuracy"]
+        for k, v in ep.items():
+            history[k].append(v)
+        if verbose:
+            msg = " ".join(f"{k}={v:.4f}" for k, v in ep.items())
+            print(f"epoch {epoch + 1}/{epochs} {msg}")
+        if logger is not None:
+            logger.log(event="epoch", epoch=epoch, **ep)
+    return state, history
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoPhaseConfig:
+    """The reference's training hyperparameters in one place (its
+    module-level constants, e.g. dist_model_tf_vgg.py:8-17)."""
+
+    lr: float = 1e-3
+    epochs: int = 10               # phase-1 (frozen backbone) epochs
+    fine_tune_epochs: int = 10     # additional phase-2 epochs
+    batch_size: int = 32
+    fine_tune_at: int | None = None  # None -> registry default
+    eval_steps: int | None = 20    # baseline-floor sample size (quirk Q3)
+    seed: int = 0
+    compute_dtype: Any = jnp.float32
+
+
+@dataclasses.dataclass
+class TwoPhaseResult:
+    state: TrainState
+    model: core.Module             # the phase-2 model (for inference)
+    history: History
+    history_fine: History
+    baseline: dict[str, float]
+    pretrain_seconds: float
+    fine_tune_seconds: float
+
+
+def _build_model(spec: registry.ModelSpec, num_outputs: int,
+                 in_channels: int, bn_frozen_below: int) -> core.Module:
+    """Build with BN-freeze config when the model supports it (BN-bearing
+    backbones must run frozen BN in inference mode — SURVEY.md §7
+    'hard parts')."""
+    params = inspect.signature(spec.build).parameters
+    if "bn_frozen_below" in params:
+        return spec.build(num_outputs, in_channels,
+                          bn_frozen_below=bn_frozen_below)
+    return spec.build(num_outputs, in_channels)
+
+
+_FREEZE_ALL = 10_000  # larger than any Keras layer index
+
+
+def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
+                  val_ds: ArrayDataset, mesh: Mesh,
+                  config: TwoPhaseConfig = TwoPhaseConfig(), *,
+                  in_channels: int = 3, loss_fn=None,
+                  pretrained_params=None, pretrained_state=None,
+                  artifact_path: str | None = None,
+                  logger=None) -> TwoPhaseResult:
+    """The reference's full two-phase transfer-learning program (C7).
+
+    Phase 1: head-only training at `lr` with the backbone frozen
+    (dist_model_tf_vgg.py:122,130-138). Phase 2: layers with Keras index
+    >= fine_tune_at unfrozen, fresh RMSprop at lr/10, epoch counter
+    continued (dist_model_tf_vgg.py:141-160). Saves the C18 plot artifact
+    under `artifact_path` when given.
+    """
+    from idc_models_tpu.train.losses import (
+        binary_cross_entropy, sparse_categorical_cross_entropy,
+    )
+
+    if loss_fn is None:
+        loss_fn = (binary_cross_entropy if num_outputs == 1
+                   else sparse_categorical_cross_entropy)
+    spec = registry.get_model(model_name)
+    fine_tune_at = (config.fine_tune_at if config.fine_tune_at is not None
+                    else spec.default_fine_tune_at)
+
+    model1 = _build_model(spec, num_outputs, in_channels, _FREEZE_ALL)
+    model2 = _build_model(spec, num_outputs, in_channels, fine_tune_at)
+
+    init_rng = jax.random.key(config.seed)
+    variables = model1.init(init_rng)
+    params = pretrained_params if pretrained_params is not None else variables.params
+    model_state = (pretrained_state if pretrained_state is not None
+                   else variables.state)
+
+    # Phase 1: head-only mask at lr
+    opt1 = rmsprop(config.lr, trainable_mask=spec.head_only_mask(params))
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       model_state=model_state, opt_state=opt1.init(params))
+
+    baseline = evaluate(model1, state, val_ds, loss_fn, mesh,
+                        batch_size=config.batch_size,
+                        steps=config.eval_steps,
+                        compute_dtype=config.compute_dtype)
+    print(f"initial loss: {baseline['loss']:.2f}")
+    print(f"initial accuracy: {baseline['accuracy']:.2f}")
+
+    with Timer(f"Pre-training for {config.epochs} epochs",
+               logger=logger) as t1:
+        state, history = fit(
+            model1, opt1, loss_fn, state, train_ds, val_ds, mesh,
+            epochs=config.epochs, batch_size=config.batch_size,
+            seed=config.seed, logger=logger,
+            compute_dtype=config.compute_dtype)
+
+    # Phase 2: "recompile" = fresh optimizer (and state) at lr/10 with the
+    # fine-tune mask; BN below fine_tune_at stays in inference mode.
+    mask2 = spec.fine_tune_mask(state.params, fine_tune_at)
+    opt2 = rmsprop(config.lr / 10.0, trainable_mask=mask2)
+    state = TrainState(step=state.step, params=state.params,
+                       model_state=state.model_state,
+                       opt_state=opt2.init(state.params))
+
+    total_epochs = config.epochs + config.fine_tune_epochs
+    with Timer(f"Fine tuning for {config.fine_tune_epochs} epochs",
+               logger=logger) as t2:
+        state, history_fine = fit(
+            model2, opt2, loss_fn, state, train_ds, val_ds, mesh,
+            epochs=total_epochs, batch_size=config.batch_size,
+            initial_epoch=config.epochs, seed=config.seed + 1,
+            logger=logger, compute_dtype=config.compute_dtype)
+
+    print(history)
+    print(history_fine)
+    if artifact_path is not None:
+        plot_history(artifact_path, history, history_fine,
+                     mesh.devices.size, initial_epochs=config.epochs)
+
+    return TwoPhaseResult(
+        state=state, model=model2, history=history,
+        history_fine=history_fine, baseline=baseline,
+        pretrain_seconds=t1.seconds, fine_tune_seconds=t2.seconds)
+

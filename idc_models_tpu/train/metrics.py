@@ -17,6 +17,15 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
 
 
+def auto_accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Dispatch on logits shape: [B,C>1] multiclass, else binary — the
+    Keras `metrics=['accuracy']` auto-selection (dist_model_tf_vgg.py:132
+    vs dist_model_tf_dense.py:144)."""
+    if logits.ndim == 2 and logits.shape[-1] > 1:
+        return accuracy(logits, labels)
+    return binary_accuracy(logits, labels)
+
+
 def binary_accuracy(logits: jax.Array, labels: jax.Array,
                     threshold: float = 0.0) -> jax.Array:
     """Binary accuracy on logits (threshold 0 == probability 0.5)."""

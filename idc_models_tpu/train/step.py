@@ -56,7 +56,7 @@ def make_train_step(model: core.Module, optimizer: optax.GradientTransformation,
             model_state=new_model_state,
             opt_state=new_opt_state,
         )
-        m = {"loss": loss, "accuracy": _auto_accuracy(logits, labels)}
+        m = {"loss": loss, "accuracy": metrics_lib.auto_accuracy(logits, labels)}
         return out, m
 
     return train_step
@@ -73,17 +73,12 @@ def make_eval_step(model: core.Module, loss_fn: LossFn, *,
         logits = logits.astype(jnp.float32)
         return {
             "loss": loss_fn(logits, labels),
-            "accuracy": _auto_accuracy(logits, labels),
+            "accuracy": metrics_lib.auto_accuracy(logits, labels),
             "logits": logits,
         }
 
     return eval_step
 
-
-def _auto_accuracy(logits, labels):
-    if logits.ndim == 2 and logits.shape[-1] > 1:
-        return metrics_lib.accuracy(logits, labels)
-    return metrics_lib.binary_accuracy(logits, labels)
 
 
 # ---------------------------------------------------------------------------
@@ -91,15 +86,17 @@ def _auto_accuracy(logits, labels):
 # ---------------------------------------------------------------------------
 
 def jit_data_parallel(step_fn, mesh: Mesh, *, donate_state: bool = True,
-                      extra_batch_args: int = 0):
+                      extra_batch_args: int = 0, axis: str | None = None):
     """Jit `step_fn(state, images, labels, *rest)` with DP shardings.
 
     State replicated; images/labels (and `extra_batch_args` further
-    positional args) sharded on their leading axis over the "data" mesh
-    axis. This is the whole MirroredStrategy replacement for D1.
+    positional args) sharded on their leading axis over `axis` (default:
+    the mesh's "data" axis, or its only axis when 1-D — so eval works on
+    a "client" mesh too). This is the whole MirroredStrategy replacement
+    for D1.
     """
     repl = meshlib.replicated(mesh)
-    batch = meshlib.sharding(mesh, meshlib.DATA_AXIS)
+    batch = meshlib.sharding(mesh, _batch_axis(mesh, axis))
     n_batch = 2 + extra_batch_args
     in_shardings = (repl,) + (batch,) * n_batch
     return jax.jit(
@@ -118,11 +115,22 @@ def _wants_rng(fn) -> bool:
         return False
 
 
-def shard_batch(mesh: Mesh, *arrays):
-    """Device_put host arrays sharded over the "data" axis of `mesh`."""
-    sh = meshlib.sharding(mesh, meshlib.DATA_AXIS)
+def shard_batch(mesh: Mesh, *arrays, axis: str | None = None):
+    """Device_put host arrays sharded over the batch axis of `mesh`."""
+    sh = meshlib.sharding(mesh, _batch_axis(mesh, axis))
     out = tuple(jax.device_put(a, sh) for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+def _batch_axis(mesh: Mesh, axis: str | None) -> str:
+    if axis is not None:
+        return axis
+    if meshlib.DATA_AXIS in mesh.axis_names:
+        return meshlib.DATA_AXIS
+    if len(mesh.axis_names) == 1:
+        return mesh.axis_names[0]
+    raise ValueError(f"cannot infer batch axis from mesh axes "
+                     f"{mesh.axis_names}; pass axis=...")
 
 
 def replicate(mesh: Mesh, tree):

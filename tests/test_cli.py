@@ -1,0 +1,47 @@
+"""CLI arg parsing + preset table (C19 parity). Heavy preset runs are
+exercised by the driver / manual smoke; here we pin the flag surface."""
+
+import pytest
+
+from idc_models_tpu.cli import _parse
+from idc_models_tpu.configs import PRESETS, get_preset
+
+
+def test_presets_match_reference_constants():
+    vgg = get_preset("vgg")
+    assert (vgg.lr, vgg.batch_size, vgg.fine_tune_at) == (1e-3, 32, 15)
+    mob = get_preset("mobile")
+    assert (mob.lr, mob.fine_tune_at) == (1e-4, 100)
+    dense = get_preset("dense")
+    assert (dense.num_outputs, dense.per_replica_batch,
+            dense.fine_tune_at) == (10, True, 150)
+    fed = get_preset("fed")
+    assert (fed.num_clients, fed.fine_tune_at) == (10, 15)
+    sec = get_preset("secure-fed")
+    assert (sec.image_size, sec.local_epochs) == (10, 5)
+    assert set(PRESETS) == {"vgg", "mobile", "dense", "fed", "secure_fed"}
+
+
+def test_parse_dist_flags():
+    ns = _parse(["vgg", "--path", "/tmp/x", "--epochs", "3",
+                 "--fine-tune-at", "11", "--host-devices", "8"])
+    assert ns.preset_key == "vgg" and ns.epochs == 3
+    assert ns.fine_tune_at == 11 and ns.host_devices == 8
+
+
+def test_parse_fed_flags():
+    ns = _parse(["fed", "--rounds", "5", "--noniid", "--num-clients", "4"])
+    assert ns.rounds == 5 and ns.iid is False and ns.num_clients == 4
+    ns2 = _parse(["fed"])
+    assert ns2.iid is None  # preset default (IID) applies
+
+
+def test_parse_secure_flags():
+    ns = _parse(["secure-fed", "--percent", "0.25", "--paillier"])
+    assert ns.preset_key == "secure_fed"
+    assert ns.percent == 0.25 and ns.paillier is True
+
+
+def test_unknown_preset_raises():
+    with pytest.raises(KeyError):
+        get_preset("nope")

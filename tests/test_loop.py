@@ -1,0 +1,133 @@
+"""Two-phase training loop, evaluator, checkpointing, observability
+(reference C7/C8/C17/C18 parity)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.data.idc import ArrayDataset
+from idc_models_tpu.models import small_cnn
+from idc_models_tpu.observe import JsonlLogger, Timer, plot_history
+from idc_models_tpu.train import (
+    TrainState, TwoPhaseConfig, create_train_state, checkpoint_exists,
+    evaluate, fit, load_or_train, restore_checkpoint, rmsprop,
+    save_checkpoint, two_phase_fit,
+)
+from idc_models_tpu.train.losses import binary_cross_entropy
+
+
+def _data(n=192, seed=0):
+    imgs, labels = synthetic.make_idc_like(n, size=10, seed=seed)
+    return ArrayDataset(imgs, labels)
+
+
+def test_fit_history_and_loss(devices):
+    mesh = meshlib.data_mesh(8)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    train_ds, val_ds = _data(160), _data(64, seed=1)
+    state, hist = fit(model, opt, binary_cross_entropy, state, train_ds,
+                      val_ds, mesh, epochs=3, batch_size=32, verbose=False)
+    assert len(hist["loss"]) == 3
+    assert len(hist["val_accuracy"]) == 3
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert int(state.step) == 3 * (160 // 32)
+
+
+def test_evaluate_exact_vs_steps(devices):
+    mesh = meshlib.data_mesh(8)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    ds = _data(100)  # not a multiple of 8: exercises padding
+    m = evaluate(model, state, ds, binary_cross_entropy, mesh,
+                 batch_size=32, with_auroc=True)
+    assert np.isfinite(m["loss"]) and 0 <= m["auroc"] <= 1
+    # direct computation over all 100 examples must match exactly
+    logits, _ = model.apply(state.params, state.model_state,
+                            jnp.asarray(ds.images), train=False)
+    np.testing.assert_allclose(
+        m["loss"], float(binary_cross_entropy(logits,
+                                              jnp.asarray(ds.labels))),
+        rtol=1e-5)
+    m_steps = evaluate(model, state, ds, binary_cross_entropy, mesh,
+                       batch_size=32, steps=2)
+    assert np.isfinite(m_steps["loss"])  # 64-example floor sample (Q3)
+
+
+def test_two_phase_fit(devices, tmp_path):
+    mesh = meshlib.data_mesh(8)
+    train_ds, val_ds = _data(128), _data(64, seed=1)
+    log_path = tmp_path / "run.jsonl"
+    with JsonlLogger(log_path) as logger:
+        result = two_phase_fit(
+            "small_cnn", 1, train_ds, val_ds, mesh,
+            TwoPhaseConfig(lr=1e-3, epochs=2, fine_tune_epochs=2,
+                           batch_size=32, eval_steps=2),
+            artifact_path=str(tmp_path), logger=logger)
+    assert len(result.history["loss"]) == 2
+    assert len(result.history_fine["loss"]) == 2
+    assert result.pretrain_seconds > 0 and result.fine_tune_seconds > 0
+    assert np.isfinite(result.baseline["loss"])
+    # C18 artifact
+    assert (tmp_path / "logs" / "plot_dev8.png").exists()
+    # jsonl has epoch + timer records
+    records = [json.loads(l) for l in open(log_path)]
+    events = {r["event"] for r in records}
+    assert {"epoch", "timer"} <= events
+
+
+def test_checkpoint_roundtrip(devices, tmp_path):
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    path = tmp_path / "ckpt"
+    assert not checkpoint_exists(path)
+    save_checkpoint(path, state)
+    assert checkpoint_exists(path)
+    target = create_train_state(model, opt, jax.random.key(9))
+    restored = restore_checkpoint(path, target)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_or_train_gate(devices, tmp_path):
+    """The C8 pretrainer gate: trains once, then restores (fixing Q5)."""
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    path = tmp_path / "pretrained"
+    calls = []
+
+    def train_fn():
+        calls.append(1)
+        return create_train_state(model, opt, jax.random.key(0))
+
+    target = create_train_state(model, opt, jax.random.key(1))
+    s1, was_restored = load_or_train(path, target, train_fn)
+    assert not was_restored and len(calls) == 1
+    s2, was_restored = load_or_train(path, target, train_fn)
+    assert was_restored and len(calls) == 1
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_timer_prints_reference_format(capsys):
+    with Timer("Pre-training for 10 epochs") as t:
+        pass
+    out = capsys.readouterr().out
+    assert out.startswith("Pre-training for 10 epochs took ")
+    assert out.rstrip().endswith("seconds")
+    assert t.seconds is not None and t.seconds >= 0
+
+
+def test_plot_history_no_fine(tmp_path):
+    hist = {"accuracy": [0.5, 0.6], "val_accuracy": [0.4, 0.5],
+            "loss": [0.7, 0.6], "val_loss": [0.8, 0.7]}
+    out = plot_history(tmp_path, hist, None, 4)
+    assert os.path.exists(out) and out.endswith("plot_dev4.png")

@@ -74,6 +74,21 @@ def test_quantize_roundtrip():
     np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-6)
 
 
+def test_quantize_clips_instead_of_wrapping():
+    from idc_models_tpu.secure import choose_scale_bits
+
+    big = jnp.asarray([1e9, -1e9, 10.0])
+    q = quantize(big, 20, clip_abs=64.0)
+    back = dequantize(q, 20)
+    np.testing.assert_allclose(np.asarray(back), [64.0, -64.0, 10.0],
+                               atol=1e-5)
+    # headroom budget: sum of n clipped values must fit int32
+    for n in (2, 8, 32, 1024):
+        bits = choose_scale_bits(n, 64.0)
+        assert (2.0 ** bits) * 64.0 * n <= 2 ** 31
+    assert choose_scale_bits(8, 64.0) <= 22
+
+
 def test_first_fraction_selection():
     tree = {"a": 1, "b": {"c": 2, "d": 3}, "e": 4}
     sel = first_fraction_selection(tree, 0.5)
@@ -101,6 +116,28 @@ def test_first_fraction_selection_layer_order():
     # conv1/kernel, fc1/bias — a different set
     sel_flat = first_fraction_selection(params, 0.5)
     assert sel_flat["fc1"] == {"kernel": False, "bias": True}
+
+
+def test_first_fraction_selection_nested_classifier():
+    """classifier() models rank backbone layers in creation order via
+    dotted layer_names (not alphabetically), head last."""
+    from idc_models_tpu.models import core
+
+    backbone = core.sequential(
+        [core.conv2d(3, 4, 3, name="z_first"),   # alphabetically LAST
+         core.conv2d(4, 4, 3, name="a_second")],  # alphabetically FIRST
+        name="bb")
+    model = core.classifier(backbone, 4, 1)
+    assert model.layer_names == ("backbone.z_first", "backbone.a_second",
+                                 "head")
+    params = model.init(jax.random.key(0)).params
+    # first 3 of 6 tensors: z_first kernel+bias, a_second kernel
+    sel = first_fraction_selection(params, 0.5, model.layer_names)
+    assert sel == {
+        "backbone": {"z_first": {"kernel": True, "bias": True},
+                     "a_second": {"kernel": True, "bias": False}},
+        "head": {"kernel": False, "bias": False},
+    }
 
 
 @pytest.fixture(scope="module")
