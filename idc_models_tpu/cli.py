@@ -149,6 +149,9 @@ def _run_dist(ns):
                     else preset.batch_size)
     print(f"Number of devices: {n_dev}")
 
+    # Synthetic fallback must yield at least one full global batch after
+    # the train split, or the Loader rightly refuses to run.
+    ns.synthetic_examples = max(ns.synthetic_examples, 2 * global_batch)
     if preset.dataset == "cifar10":
         ds = load_cifar10(ns.path, split="train",
                           synthetic_size=ns.synthetic_examples, seed=ns.seed)
@@ -306,9 +309,11 @@ def _run_secure(ns):
     n_dev = len(jax.devices())
     n_clients = min(preset.num_clients, n_dev)
     ds = _load_idc(ns, preset.image_size, None)
+    # take/skip split sized by the preset (24000/6000 in the reference,
+    # secure_fed_model.py:219-220), scaled down when the dataset is smaller
     n_client_total = min(preset.client_examples, int(len(ds) * 0.8))
     client_ds = ds.take(n_client_total)
-    test_ds = ds.skip(n_client_total)
+    test_ds = ds.skip(n_client_total).take(preset.test_examples)
     logger = _logger(ns)
 
     spec = registry.get_model(preset.model)

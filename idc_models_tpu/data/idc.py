@@ -71,21 +71,44 @@ def _decode_one(path: str, size: int) -> np.ndarray:
     from PIL import Image
 
     with Image.open(path) as im:
-        im = im.convert("RGB")
-        if im.size != (size, size):
-            im = im.resize((size, size), Image.BILINEAR)
-        return np.asarray(im, np.float32) / 255.0
+        arr = np.asarray(im.convert("RGB"), np.float32) / 255.0
+    if arr.shape[:2] != (size, size):
+        arr = _resize_bilinear(arr, size)
+    return arr
+
+
+def _resize_bilinear(arr: np.ndarray, size: int) -> np.ndarray:
+    """Naive bilinear with half-pixel centers — the semantics of the
+    reference's `tf.image.resize` default (antialias=False,
+    dist_model_tf_vgg.py:42) and bit-compatible with the native C++
+    loader's resize, so backends are interchangeable. (PIL's BILINEAR
+    antialiases on downscale and would diverge.)"""
+    h, w = arr.shape[:2]
+    fy = np.maximum((np.arange(size) + 0.5) * (h / size) - 0.5, 0.0)
+    fx = np.maximum((np.arange(size) + 0.5) * (w / size) - 0.5, 0.0)
+    y0 = fy.astype(np.int32)
+    x0 = fx.astype(np.int32)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (fy - y0).astype(np.float32)[:, None, None]
+    wx = (fx - x0).astype(np.float32)[None, :, None]
+    top = arr[y0][:, x0] * (1 - wx) + arr[y0][:, x1] * wx
+    bot = arr[y1][:, x0] * (1 - wx) + arr[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
 
 
 def load_directory(root: str | os.PathLike, *, image_size: int = 50,
                    limit: int | None = None, seed: int = 0,
-                   workers: int = 16) -> ArrayDataset:
+                   workers: int = 16, backend: str = "auto") -> ArrayDataset:
     """Load the `<root>/<label>/*.png` tree into an ArrayDataset.
 
     The file list is deterministically shuffled with `seed` before an
     optional `limit` is applied (the reference's balanced_IDC_30k subset is
     a pre-balanced directory; `limit` supports the same "first N of a
     shuffled list" usage without per-epoch reshuffle leakage).
+
+    `backend`: "native" (C++/libpng threaded decoder), "pil" (Python
+    thread pool), or "auto" (native when buildable, else pil).
     """
     pairs = list_labeled_files(root)
     if not pairs:
@@ -95,11 +118,22 @@ def load_directory(root: str | os.PathLike, *, image_size: int = 50,
     pairs = [pairs[i] for i in order]
     if limit is not None:
         pairs = pairs[:limit]
+    labels = np.asarray([l for _, l in pairs], np.int32)
+
+    if backend not in ("auto", "native", "pil"):
+        raise ValueError(f"backend must be auto|native|pil, got {backend!r}")
+    if backend in ("auto", "native"):
+        from idc_models_tpu.data import native
+
+        if native.available():
+            images = native.decode_batch([p for p, _ in pairs], image_size,
+                                         threads=workers)
+            return ArrayDataset(images, labels)
+        if backend == "native":
+            raise RuntimeError(native.build_error())
     with ThreadPoolExecutor(max_workers=workers) as pool:
         imgs = list(pool.map(lambda p: _decode_one(p[0], image_size), pairs))
-    images = np.stack(imgs)
-    labels = np.asarray([l for _, l in pairs], np.int32)
-    return ArrayDataset(images, labels)
+    return ArrayDataset(np.stack(imgs), labels)
 
 
 def train_val_test_split(ds: ArrayDataset,

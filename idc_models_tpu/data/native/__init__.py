@@ -1,0 +1,99 @@
+"""ctypes binding for the native (C++/libpng) image loader.
+
+Lazily builds `loader.cpp` into `_native_loader.so` beside this file the
+first time it is needed (and whenever the source is newer), then exposes
+
+    decode_batch(paths, size, threads=0) -> np.ndarray [n, size, size, 3]
+
+`available()` reports whether the native path can be used; callers fall
+back to the PIL thread pool (idc.py) when it cannot (no toolchain, no
+libpng). The framework keeps the decode loop entirely outside Python —
+the reference gets this from tf.data's C++ runtime (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_DIR = Path(__file__).parent
+_SRC = _DIR / "loader.cpp"
+_SO = _DIR / "_native_loader.so"
+_ABI = 1
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_error: str | None = None
+
+
+def _build_cmd() -> list[str]:
+    return ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC),
+            "-lpng", "-lz", "-lpthread", "-o", str(_SO)]
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+                subprocess.run(_build_cmd(), check=True, capture_output=True,
+                               text=True)
+            lib = ctypes.CDLL(str(_SO))
+            if lib.idc_loader_abi_version() != _ABI:
+                raise OSError("stale native loader ABI; rebuild")
+            lib.idc_decode_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ]
+            lib.idc_decode_batch.restype = ctypes.c_int
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError, AttributeError) as e:
+            # AttributeError: a stale .so predating the ABI-version export
+            detail = getattr(e, "stderr", "") or str(e)
+            _build_error = f"native loader unavailable: {detail}"
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    _load()
+    return _build_error
+
+
+def decode_batch(paths: list[str], size: int, *,
+                 threads: int = 0) -> np.ndarray:
+    """Decode PNGs to a float32 [n, size, size, 3] batch in [0, 1].
+
+    Failed files decode to zeros (matching the batch-robustness the
+    tf.data pipeline gets from ignore_errors-style handling); a ValueError
+    is raised instead if *every* file fails.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(_build_error or "native loader unavailable")
+    n = len(paths)
+    out = np.empty((n, size, size, 3), np.float32)
+    if n == 0:
+        return out
+    arr = (ctypes.c_char_p * n)(*[os.fsencode(p) for p in paths])
+    failures = lib.idc_decode_batch(
+        arr, n, size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        threads)
+    if failures >= n:
+        raise ValueError(f"all {n} files failed to decode (first: {paths[0]})")
+    if failures:
+        import warnings
+
+        warnings.warn(f"{failures}/{n} files failed to decode; their "
+                      f"slots are zero images", stacklevel=2)
+    return out

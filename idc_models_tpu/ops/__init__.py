@@ -1,0 +1,5 @@
+from idc_models_tpu.ops.secure_masking_kernel import (  # noqa: F401
+    fused_masked_quantize,
+    masked_quantize_reference,
+    pair_seeds_and_signs,
+)

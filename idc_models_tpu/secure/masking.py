@@ -41,11 +41,16 @@ DEFAULT_CLIP_ABS = 64.0  # quantization clipping range for weights
 def choose_scale_bits(n_clients: int,
                       clip_abs: float = DEFAULT_CLIP_ABS) -> int:
     """Largest scale_bits such that the un-masked sum over `n_clients`
-    values of magnitude <= clip_abs cannot overflow int32 — i.e.
-    2^scale * clip_abs * n_clients <= 2^31. (Mask wraparound is mod-2^32
-    by design and cancels; it is the *unwrapped* sum of quantized values
-    that must stay in range for dequantize to be correct.)"""
-    bits = 31 - math.ceil(math.log2(max(n_clients, 1) * clip_abs))
+    values of magnitude <= clip_abs cannot overflow int32 — strictly
+    2^scale * clip_abs * n_clients <= 2^31 - 1 (2^31 itself wraps to
+    INT32_MIN and sign-flips a fully saturated element). Mask wraparound
+    is mod-2^32 by design and cancels; it is the *unwrapped* sum of
+    quantized values that must stay in range for dequantize to be
+    correct."""
+    n = max(n_clients, 1)
+    bits = 31 - math.ceil(math.log2(n * clip_abs))
+    while bits > 0 and (2.0 ** bits) * clip_abs * n > 2**31 - 1:
+        bits -= 1
     if bits < 1:
         raise ValueError(
             f"no int32 headroom for {n_clients} clients at clip {clip_abs}")
@@ -152,7 +157,9 @@ def ranked_indices(paths: list[tuple[str, ...]],
 
     def rank(path):
         li = len(layer_order)
-        for k in range(len(path) - 1, 0, -1):
+        # longest-prefix match, INCLUDING the full path (a length-1 path's
+        # only prefix is itself)
+        for k in range(len(path), 0, -1):
             hit = order_index.get(".".join(path[:k]))
             if hit is not None:
                 li = hit

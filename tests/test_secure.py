@@ -82,11 +82,12 @@ def test_quantize_clips_instead_of_wrapping():
     back = dequantize(q, 20)
     np.testing.assert_allclose(np.asarray(back), [64.0, -64.0, 10.0],
                                atol=1e-5)
-    # headroom budget: sum of n clipped values must fit int32
+    # headroom budget: sum of n fully saturated values must fit int32
+    # STRICTLY (2^31 exactly would wrap to INT32_MIN)
     for n in (2, 8, 32, 1024):
         bits = choose_scale_bits(n, 64.0)
-        assert (2.0 ** bits) * 64.0 * n <= 2 ** 31
-    assert choose_scale_bits(8, 64.0) <= 22
+        assert (2.0 ** bits) * 64.0 * n <= 2 ** 31 - 1
+    assert choose_scale_bits(8, 64.0) <= 21
 
 
 def test_first_fraction_selection():
