@@ -67,6 +67,10 @@ def _parse(argv):
         sp.add_argument("--epochs", type=int, default=None)
         sp.add_argument("--fine-tune-epochs", type=int, default=None)
         sp.add_argument("--fine-tune-at", type=int, default=None)
+        sp.add_argument("--central-storage", action="store_true",
+                        help="host-resident parameter store, broadcast "
+                             "per step (the reference's use_mirror=False "
+                             "CentralStorageStrategy toggle)")
 
     sp = sub.add_parser("fed", help="federated averaging (FedAvg)")
     common(sp)
@@ -170,7 +174,8 @@ def _run_dist(ns):
         TwoPhaseConfig(lr=preset.lr, epochs=preset.epochs,
                        fine_tune_epochs=preset.fine_tune_epochs,
                        batch_size=global_batch,
-                       fine_tune_at=preset.fine_tune_at, seed=ns.seed),
+                       fine_tune_at=preset.fine_tune_at, seed=ns.seed,
+                       central_storage=ns.central_storage),
         artifact_path=ns.path, logger=logger)
     test_metrics = evaluate(result.model, result.state, test,
                             _loss_for(preset.num_outputs), mesh,

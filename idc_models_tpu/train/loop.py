@@ -103,7 +103,7 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         loss_fn, state: TrainState, train_ds: ArrayDataset,
         val_ds: ArrayDataset | None, mesh: Mesh, *, epochs: int,
         batch_size: int = 32, initial_epoch: int = 0, seed: int = 0,
-        logger=None, verbose: bool = True,
+        logger=None, verbose: bool = True, central_storage: bool = False,
         compute_dtype=jnp.float32) -> tuple[TrainState, History]:
     """Keras-`fit`-shaped epoch loop over the jitted DP train step.
 
@@ -111,11 +111,26 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
     ({"loss", "accuracy", "val_loss", "val_accuracy"} per epoch).
     `initial_epoch` continues a previous schedule's epoch numbering
     (dist_model_tf_vgg.py:159 `initial_epoch=history.epoch[-1]`).
+
+    `central_storage=True` is the parity toggle for the reference's
+    `CentralStorageStrategy` variant (D2, dist_model_tf_dense.py:18,21-24):
+    the master copy of the state lives in HOST memory between steps and is
+    broadcast to the devices each step, with the updated state fetched
+    back — numerically identical to the mirrored mode, paying a host
+    round-trip per step exactly like variables-on-CPU compute-on-device.
     """
-    step_fn = jit_data_parallel(
+    base_step = jit_data_parallel(
         make_train_step(model, optimizer, loss_fn,
                         compute_dtype=compute_dtype), mesh)
-    state = replicate(mesh, state)
+    if central_storage:
+        state = jax.device_get(state)
+
+        def step_fn(host_state, x, y, rng):
+            out, m = base_step(replicate(mesh, host_state), x, y, rng)
+            return jax.device_get(out), m
+    else:
+        step_fn = base_step
+        state = replicate(mesh, state)
     loader = Loader(train_ds, batch_size, shuffle=True, seed=seed)
     evaluator = (Evaluator(model, loss_fn, mesh, batch_size=batch_size,
                            compute_dtype=compute_dtype)
@@ -161,6 +176,7 @@ class TwoPhaseConfig:
     eval_steps: int | None = 20    # baseline-floor sample size (quirk Q3)
     seed: int = 0
     compute_dtype: Any = jnp.float32
+    central_storage: bool = False  # D2: host-resident params per step
 
 
 @dataclasses.dataclass
@@ -242,6 +258,7 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
             model1, opt1, loss_fn, state, train_ds, val_ds, mesh,
             epochs=config.epochs, batch_size=config.batch_size,
             seed=config.seed, logger=logger,
+            central_storage=config.central_storage,
             compute_dtype=config.compute_dtype)
 
     # Phase 2: "recompile" = fresh optimizer (and state) at lr/10 with the
@@ -259,7 +276,8 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
             model2, opt2, loss_fn, state, train_ds, val_ds, mesh,
             epochs=total_epochs, batch_size=config.batch_size,
             initial_epoch=config.epochs, seed=config.seed + 1,
-            logger=logger, compute_dtype=config.compute_dtype)
+            logger=logger, central_storage=config.central_storage,
+            compute_dtype=config.compute_dtype)
 
     print(history)
     print(history_fine)

@@ -40,6 +40,28 @@ def test_fit_history_and_loss(devices):
     assert int(state.step) == 3 * (160 // 32)
 
 
+def test_central_storage_equals_mirrored(devices):
+    """D2 parity toggle: host-resident params per step must be numerically
+    identical to the mirrored (replicated) mode."""
+    mesh = meshlib.data_mesh(8)
+    model = small_cnn(10, 3, 1)
+    train_ds = _data(64)
+
+    def run(central):
+        opt = rmsprop(1e-3)
+        state = create_train_state(model, opt, jax.random.key(0))
+        state, hist = fit(model, opt, binary_cross_entropy, state, train_ds,
+                          None, mesh, epochs=2, batch_size=32,
+                          central_storage=central, verbose=False)
+        return jax.device_get(state.params), hist["loss"]
+
+    p_c, l_c = run(True)
+    p_m, l_m = run(False)
+    np.testing.assert_allclose(l_c, l_m, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_c), jax.tree.leaves(p_m)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
 def test_evaluate_exact_vs_steps(devices):
     mesh = meshlib.data_mesh(8)
     model = small_cnn(10, 3, 1)
