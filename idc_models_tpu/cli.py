@@ -60,6 +60,9 @@ def _parse(argv):
                              "stand-in for local runs)")
         sp.add_argument("--batch-size", type=int, default=None)
         sp.add_argument("--lr", type=float, default=None)
+        sp.add_argument("--profile-dir", default=None,
+                        help="write a jax.profiler trace of the training "
+                             "phase here (TensorBoard-viewable)")
 
     for key in ("vgg", "mobile", "dense"):
         sp = sub.add_parser(key, help=f"{key} two-phase DP training")
@@ -168,15 +171,18 @@ def _run_dist(ns):
         ds = _load_idc(ns, preset.image_size, preset.dataset_limit)
         train, val, test = train_val_test_split(ds, seed=ns.seed)
 
+    from idc_models_tpu.observe import profile_trace
+
     logger = _logger(ns)
-    result = two_phase_fit(
-        preset.model, preset.num_outputs, train, val, mesh,
-        TwoPhaseConfig(lr=preset.lr, epochs=preset.epochs,
-                       fine_tune_epochs=preset.fine_tune_epochs,
-                       batch_size=global_batch,
-                       fine_tune_at=preset.fine_tune_at, seed=ns.seed,
-                       central_storage=ns.central_storage),
-        artifact_path=ns.path, logger=logger)
+    with profile_trace(ns.profile_dir):
+        result = two_phase_fit(
+            preset.model, preset.num_outputs, train, val, mesh,
+            TwoPhaseConfig(lr=preset.lr, epochs=preset.epochs,
+                           fine_tune_epochs=preset.fine_tune_epochs,
+                           batch_size=global_batch,
+                           fine_tune_at=preset.fine_tune_at, seed=ns.seed,
+                           central_storage=ns.central_storage),
+            artifact_path=ns.path, logger=logger)
     test_metrics = evaluate(result.model, result.state, test,
                             _loss_for(preset.num_outputs), mesh,
                             batch_size=global_batch,
@@ -209,9 +215,9 @@ def _run_fed(ns):
         seed_server_with,
     )
     from idc_models_tpu.models import registry
-    from idc_models_tpu.observe import Timer
+    from idc_models_tpu.observe import Timer, profile_trace
     from idc_models_tpu.train import (
-        TrainState, TwoPhaseConfig, checkpoint_exists, restore_checkpoint,
+        TwoPhaseConfig, checkpoint_exists, restore_checkpoint,
         rmsprop, save_checkpoint, two_phase_fit,
     )
 
@@ -268,6 +274,15 @@ def _run_fed(ns):
     server = seed_server_with(
         initialize_server(model, jax.random.key(ns.seed)),
         params, model_state)
+    # Round-loop checkpoint/resume: the reference checkpoints only the
+    # pretrainer (SURVEY.md §5); here the federated loop resumes too.
+    server_ckpt = Path(ns.path) / "fed_server" if ns.path else None
+    if server_ckpt is not None and checkpoint_exists(server_ckpt):
+        server = restore_checkpoint(server_ckpt, jax.device_get(server))
+        print(f"resuming federated training from round {int(server.round)}")
+    # restored/pretrained arrays may live on a single device; the round
+    # program wants them replicated over the client mesh
+    server = jax.device_put(server, meshlib.replicated(mesh))
     round_fn = make_fedavg_round(model, opt, _loss_for(preset.num_outputs),
                                  mesh, local_epochs=preset.local_epochs,
                                  batch_size=preset.batch_size)
@@ -277,11 +292,13 @@ def _run_fed(ns):
     w_train[train_ids] = imgs.shape[1]
     w_test = np.zeros((n_clients,), np.float32)
     w_test[test_ids] = imgs.shape[1]
-    key = jax.random.key(ns.seed + 1)
     print("round, train_loss, train_acc, test_loss, test_acc")
-    with Timer("Federated training", logger=logger):
-        for r in range(preset.rounds):
-            key, sub = jax.random.split(key)
+    with Timer("Federated training", logger=logger), \
+            profile_trace(ns.profile_dir):
+        for r in range(int(server.round), preset.rounds):
+            # fold the round index so resumed runs reproduce the exact
+            # rng stream a straight-through run would have used
+            sub = jax.random.fold_in(jax.random.key(ns.seed + 1), r)
             server, tm = round_fn(server, imgs, labels, w_train, sub)
             em = eval_fn(server, imgs, labels, w_test)
             print(f"{r}, {float(tm['loss']):.4f}, "
@@ -291,6 +308,8 @@ def _run_fed(ns):
                 logger.log(event="round", round=r,
                            train_loss=tm["loss"], train_acc=tm["accuracy"],
                            test_loss=em["loss"], test_acc=em["accuracy"])
+            if server_ckpt is not None:
+                save_checkpoint(server_ckpt, jax.device_get(server))
     if logger:
         logger.close()
 
@@ -345,8 +364,11 @@ def _run_secure(ns):
         local_epochs=preset.local_epochs, batch_size=preset.batch_size)
     evaluator = Evaluator(model, loss_fn, mesh, batch_size=preset.batch_size,
                           with_auroc=True)
+    from idc_models_tpu.observe import profile_trace
+
     key = jax.random.key(ns.seed + 1)
-    with Timer("Secure fed model", logger=logger):
+    with Timer("Secure fed model", logger=logger), \
+            profile_trace(ns.profile_dir):
         for r in range(preset.rounds):
             key, sub = jax.random.split(key)
             server, tm = round_fn(server, imgs, labels, sub)

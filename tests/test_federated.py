@@ -145,6 +145,31 @@ def test_federated_eval(devices):
     np.testing.assert_allclose(float(m["loss"]), pooled_loss, rtol=1e-5)
 
 
+def test_server_state_checkpoint_roundtrip(devices, tmp_path):
+    """Federated round-loop resume: ServerState (including the round
+    counter) survives an orbax save/restore (SURVEY.md §5: checkpoint all
+    loops, not just the pretrainer)."""
+    from idc_models_tpu.train import restore_checkpoint, save_checkpoint
+
+    model = small_cnn(10, 3, 1)
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    server = initialize_server(model, jax.random.key(0))
+    rnd = make_fedavg_round(model, rmsprop(1e-3), binary_cross_entropy,
+                            mesh, local_epochs=1, batch_size=16)
+    imgs, labels = _client_data()
+    w = np.ones((N_CLIENTS,), np.float32)
+    server, _ = rnd(server, imgs, labels, w, jax.random.key(1))
+    server, _ = rnd(server, imgs, labels, w, jax.random.key(2))
+
+    path = tmp_path / "fed_server"
+    save_checkpoint(path, jax.device_get(server))
+    target = initialize_server(model, jax.random.key(9))
+    restored = restore_checkpoint(path, target)
+    assert int(restored.round) == 2
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(server)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_seed_server_with(devices):
     model = small_cnn(10, 3, 1)
     server = initialize_server(model, jax.random.key(0))
