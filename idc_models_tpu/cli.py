@@ -31,10 +31,7 @@ def main(argv: list[str] | None = None) -> int:
     if ns.host_devices:
         from idc_models_tpu import mesh as meshlib
 
-        meshlib.force_host_devices(ns.host_devices)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        meshlib.force_cpu_pod(ns.host_devices)
     runner = {"vgg": _run_dist, "mobile": _run_dist, "dense": _run_dist,
               "fed": _run_fed, "secure_fed": _run_secure}[ns.preset_key]
     runner(ns)

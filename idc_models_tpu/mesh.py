@@ -36,9 +36,24 @@ def force_host_devices(n: int) -> None:
     code simulates clients inside one process (fed_model.py:184).
     """
     flags = os.environ.get("XLA_FLAGS", "")
-    opt = f"--xla_force_host_platform_device_count={n}"
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = f"{flags} {opt}".strip()
+    kept = [f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    kept.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
+
+
+def force_cpu_pod(n: int) -> None:
+    """Force this process onto `n` virtual CPU devices.
+
+    Must run before the first device query (backend creation). The ambient
+    environment may point JAX_PLATFORMS at a real TPU chip and that env var
+    is read too early to override from Python, so the platform is also
+    flipped through jax.config — the XLA_FLAGS below are still honored
+    because the CPU backend is only created on first use.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    force_host_devices(n)
+    jax.config.update("jax_platforms", "cpu")
 
 
 def make_mesh(

@@ -6,22 +6,11 @@ collection time before this file executes (pytest guarantees conftest.py
 is imported before test modules).
 """
 
-import os
+from idc_models_tpu import mesh as _meshlib
 
-# Force CPU: the ambient environment may point JAX_PLATFORMS at a real
-# (single) TPU chip; tests need the 8-device virtual pod instead. jax may
-# already be preloaded into the interpreter, so set the platform through
-# jax.config (env vars would be read too late) — the XLA_FLAGS below are
-# still honored because the CPU backend is only created on first use.
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-from idc_models_tpu import mesh as _meshlib  # noqa: E402
-
-_meshlib.force_host_devices(8)
+_meshlib.force_cpu_pod(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
