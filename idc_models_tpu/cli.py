@@ -28,12 +28,13 @@ import numpy as np
 
 def main(argv: list[str] | None = None) -> int:
     ns = _parse(argv)
-    if ns.host_devices:
+    if getattr(ns, "host_devices", 0):
         from idc_models_tpu import mesh as meshlib
 
-        meshlib.force_cpu_pod(ns.host_devices)
+        meshlib.force_cpu_pod(ns.host_devices)  # warns if ineffective
     runner = {"vgg": _run_dist, "mobile": _run_dist, "dense": _run_dist,
-              "fed": _run_fed, "secure_fed": _run_secure}[ns.preset_key]
+              "fed": _run_fed, "secure_fed": _run_secure,
+              "convert_weights": _run_convert}[ns.preset_key]
     runner(ns)
     return 0
 
@@ -61,9 +62,17 @@ def _parse(argv):
                         help="write a jax.profiler trace of the training "
                              "phase here (TensorBoard-viewable)")
 
+    def pretrained_flag(sp):
+        sp.add_argument("--pretrained-weights", default=None,
+                        help="backbone weight artifact (.npz from "
+                             "convert-weights, or a Keras .h5) — the "
+                             "no-egress analogue of weights='imagenet' "
+                             "(dist_model_tf_vgg.py:119)")
+
     for key in ("vgg", "mobile", "dense"):
         sp = sub.add_parser(key, help=f"{key} two-phase DP training")
         common(sp)
+        pretrained_flag(sp)
         sp.add_argument("--epochs", type=int, default=None)
         sp.add_argument("--fine-tune-epochs", type=int, default=None)
         sp.add_argument("--fine-tune-at", type=int, default=None)
@@ -74,6 +83,7 @@ def _parse(argv):
 
     sp = sub.add_parser("fed", help="federated averaging (FedAvg)")
     common(sp)
+    pretrained_flag(sp)
     sp.add_argument("--rounds", type=int, default=None)
     sp.add_argument("--iid", dest="iid", action="store_true", default=None)
     sp.add_argument("--noniid", dest="iid", action="store_false")
@@ -91,6 +101,17 @@ def _parse(argv):
     sp.add_argument("--paillier", action="store_true", default=None,
                     help="host-side Paillier parity mode instead of "
                              "pairwise masks")
+
+    sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
+                        help="one-time offline conversion of a Keras "
+                             "save_weights .h5 into the framework's .npz "
+                             "pytree artifact")
+    sp.add_argument("input", help="Keras .h5 weights file")
+    sp.add_argument("output", help="destination .npz")
+    sp.add_argument("--model", default=None,
+                    choices=("vgg16", "mobilenet_v2", "densenet201"),
+                    help="validate converted tensors against this "
+                         "backbone's shapes")
 
     ns = p.parse_args(argv)
     ns.preset_key = ns.preset_key.replace("-", "_")
@@ -133,6 +154,55 @@ def _load_idc(ns, image_size, limit):
     imgs, labels = synthetic.make_idc_like(ns.synthetic_examples,
                                            size=image_size, seed=ns.seed)
     return ArrayDataset(imgs, labels)
+
+
+def _run_convert(ns):
+    """Keras .h5 -> framework .npz (SURVEY.md §7 'hard parts': one-time
+    offline ImageNet weight conversion, no TF at runtime)."""
+    import numpy as np
+
+    from idc_models_tpu.models.pretrained import (
+        _flatten, load_pretrained_file, save_npz,
+    )
+
+    params, state = load_pretrained_file(ns.input)
+    if ns.model:
+        import jax
+
+        from idc_models_tpu.models import registry
+
+        spec = registry.get_model(ns.model)
+
+        # shapes only — no need to materialize a DenseNet-sized init
+        def _init_shapes():
+            v = spec.build(1, 3).init(jax.random.key(0))
+            return {"params": v.params, "state": v.state}
+
+        shapes = jax.eval_shape(_init_shapes)
+
+        def check(loaded, target, what):
+            flat_t = _flatten(target)
+            mis = [k for k, v in _flatten(loaded).items()
+                   if k not in flat_t
+                   or tuple(np.shape(v)) != tuple(flat_t[k].shape)]
+            n = len(_flatten(loaded)) - len(mis)
+            print(f"validated {what} against {ns.model}: {n} tensors "
+                  f"match, {len(mis)} mismatches")
+            for m in mis[:10]:
+                print(" ", m)
+            return len(mis)
+
+        bad = check(params, shapes["params"]["backbone"], "params")
+        if state:
+            bad += check(state, shapes["state"].get("backbone", {}),
+                         "state")
+        if bad:
+            print(f"[idc_models_tpu] WARNING: {bad} tensors will not load "
+                  f"into {ns.model}", file=sys.stderr)
+    tree = {"params": params, "state": state} if state else {"params": params}
+    save_npz(ns.output, tree)
+    print(f"wrote {ns.output} ({len(params)} layers, "
+          f"{len(_flatten(params))} tensors)")
 
 
 def _run_dist(ns):
@@ -179,6 +249,7 @@ def _run_dist(ns):
                            batch_size=global_batch,
                            fine_tune_at=preset.fine_tune_at, seed=ns.seed,
                            central_storage=ns.central_storage),
+            pretrained_weights=ns.pretrained_weights,
             artifact_path=ns.path, logger=logger)
     test_metrics = evaluate(result.model, result.state, test,
                             _loss_for(preset.num_outputs), mesh,
@@ -245,6 +316,10 @@ def _run_fed(ns):
         restored = restore_checkpoint(ckpt, target)
         params, model_state = restored["params"], restored["state"]
         print(f"restored pretrained weights from {ckpt}")
+        if ns.pretrained_weights:
+            print(f"[idc_models_tpu] --pretrained-weights ignored: "
+                  f"checkpoint {ckpt} takes precedence (delete it to "
+                  f"re-pretrain from the artifact)", file=sys.stderr)
     else:
         result = two_phase_fit(
             preset.model, preset.num_outputs, train, val, mesh_dp,
@@ -252,6 +327,7 @@ def _run_fed(ns):
                            fine_tune_epochs=0,
                            batch_size=preset.batch_size,
                            fine_tune_at=preset.fine_tune_at, seed=ns.seed),
+            pretrained_weights=ns.pretrained_weights,
             artifact_path=ns.path, logger=logger)
         params, model_state = result.state.params, result.state.model_state
         if ckpt is not None:

@@ -54,6 +54,17 @@ def force_cpu_pod(n: int) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     force_host_devices(n)
     jax.config.update("jax_platforms", "cpu")
+    # Initialize the backend now and confirm the pod actually materialized:
+    # if a backend was already live, the platform flip above was silently
+    # ignored and callers would otherwise run on whatever was there.
+    devs = jax.devices()
+    if devs[0].platform != "cpu" or len(devs) < n:
+        import warnings
+
+        warnings.warn(
+            f"force_cpu_pod({n}) ineffective: a jax backend was already "
+            f"initialized ({len(devs)} {devs[0].platform} device(s)); "
+            f"call it before any jax use", stacklevel=2)
 
 
 def make_mesh(

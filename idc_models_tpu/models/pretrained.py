@@ -117,29 +117,63 @@ def load_keras_h5(path: str | Path):
     return params, state
 
 
-def maybe_load_pretrained(params, weights_path: str | Path | None, *,
-                          subtree: str = "backbone"):
-    """Merge a weight artifact into `params[subtree]` if it exists.
+def load_pretrained_file(path: str | Path):
+    """Load a weight artifact -> (params_tree, state_tree).
 
-    Accepts .npz (framework format). Returns possibly-updated params;
-    warns (not fails) when the artifact is absent — the no-egress analogue
-    of the reference's weights='imagenet' download.
+    ``.h5``/``.hdf5`` are read as Keras `save_weights` files (the layout
+    keras.applications downloads for weights='imagenet',
+    dist_model_tf_vgg.py:119); anything else is the framework's flat npz
+    pytree, either params-only or the {"params": ..., "state": ...}
+    wrapper written by `convert-weights`.
+    """
+    p = Path(path)
+    if p.suffix.lower() in (".h5", ".hdf5"):
+        return load_keras_h5(p)
+    loaded = load_npz(p)
+    if loaded and set(loaded) <= {"params", "state"}:
+        return loaded.get("params", {}), loaded.get("state", {})
+    return loaded, {}
+
+
+def maybe_load_pretrained(params, weights_path: str | Path | None, *,
+                          state=None, subtree: str = "backbone"):
+    """Merge a weight artifact into `params[subtree]` (and, for BN-bearing
+    backbones, moving stats into `state[subtree]`) if it exists.
+
+    Accepts .npz (framework format) or Keras .h5. Returns
+    ``(params, state)`` possibly updated; warns (not fails) when the
+    artifact is absent — the no-egress analogue of the reference's
+    weights='imagenet' download.
     """
     if weights_path is None:
-        return params
+        return params, state
     p = Path(weights_path)
     if not p.exists():
         warnings.warn(f"pretrained weights {p} not found; using random "
                       f"initialization", stacklevel=2)
-        return params
-    loaded = load_npz(p)
-    target = params[subtree] if subtree else params
-    merged, n, mis = merge_pretrained(target, loaded)
-    if mis:
-        warnings.warn(f"pretrained merge: {len(mis)} mismatches "
-                      f"(first: {mis[:3]})", stacklevel=2)
-    out = dict(params)
-    if subtree:
+        return params, state
+    loaded_p, loaded_s = load_pretrained_file(p)
+
+    def graft(tree, loaded, what):
+        if tree is None or not loaded:
+            return tree, 0
+        target = tree[subtree] if subtree else tree
+        merged, n, mis = merge_pretrained(target, loaded)
+        if mis:
+            warnings.warn(f"pretrained {what} merge: {len(mis)} mismatches "
+                          f"(first: {mis[:3]})", stacklevel=2)
+        if not subtree:
+            return merged, n
+        out = dict(tree)
         out[subtree] = merged
-        return out
-    return merged
+        return out, n
+
+    params, n_p = graft(params, loaded_p, "params")
+    state, n_s = graft(state, loaded_s, "state")
+    if n_p + n_s == 0:
+        warnings.warn(f"pretrained weights {p}: no tensors matched — "
+                      f"continuing from random initialization", stacklevel=2)
+    else:
+        print(f"loaded pretrained weights from {p} "
+              f"({n_p} param tensors, {n_s} state tensors)")
+    return params, state

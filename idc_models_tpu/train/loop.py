@@ -210,6 +210,7 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
                   config: TwoPhaseConfig = TwoPhaseConfig(), *,
                   in_channels: int = 3, loss_fn=None,
                   pretrained_params=None, pretrained_state=None,
+                  pretrained_weights: str | None = None,
                   artifact_path: str | None = None,
                   logger=None) -> TwoPhaseResult:
     """The reference's full two-phase transfer-learning program (C7).
@@ -239,6 +240,13 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
     params = pretrained_params if pretrained_params is not None else variables.params
     model_state = (pretrained_state if pretrained_state is not None
                    else variables.state)
+    if pretrained_weights is not None:
+        # ImageNet-backbone start (dist_model_tf_vgg.py:119-121): graft a
+        # converted weight artifact onto the fresh init before phase 1.
+        from idc_models_tpu.models.pretrained import maybe_load_pretrained
+
+        params, model_state = maybe_load_pretrained(
+            params, pretrained_weights, state=model_state)
 
     # Phase 1: head-only mask at lr
     opt1 = rmsprop(config.lr, trainable_mask=spec.head_only_mask(params))

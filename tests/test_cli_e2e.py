@@ -1,0 +1,116 @@
+"""End-to-end CLI smoke tests: every subcommand runs in-process on the
+virtual 8-device mesh with tiny sizes.
+
+The reference's product surface is its five entry points
+(dist_model_tf_vgg.py:103, dist_model_tf_mobile.py:103,
+dist_model_tf_dense.py:118, fed_model.py:168, secure_fed_model.py:212);
+these tests drive the equivalent presets through `cli.main` exactly as a
+user would, including the fed checkpoint gate + round resume and the
+Paillier parity mode.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from idc_models_tpu import cli
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:.*synthetic.*:UserWarning")
+
+
+def _run(args, capsys):
+    assert cli.main(args) == 0
+    return capsys.readouterr().out
+
+
+def test_cli_vgg_two_phase(tmp_path, capsys):
+    out = _run(["vgg", "--path", str(tmp_path), "--host-devices", "8",
+                "--synthetic-examples", "64", "--batch-size", "8",
+                "--epochs", "1", "--fine-tune-epochs", "1"], capsys)
+    assert "Number of devices: 8" in out
+    assert "initial loss" in out            # the evaluate floor (quirk Q3)
+    assert "epoch 1/1" in out               # phase 1
+    assert "epoch 2/2" in out               # phase 2 continues the counter
+    assert "test:" in out
+    assert (tmp_path / "logs" / "plot_dev8.png").exists()   # C18 artifact
+    assert (tmp_path / "logs" / "run.jsonl").exists()
+
+
+def test_cli_vgg_pretrained_weights(tmp_path, capsys):
+    """The --pretrained-weights flag demonstrably reaches the init: the
+    run reports the load and starts from a different baseline."""
+    from idc_models_tpu.models import pretrained
+    from idc_models_tpu.models.vgg import vgg16
+
+    variables = vgg16(1).init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    noisy = jax.tree.map(
+        lambda x: np.asarray(x) + rng.normal(0, 0.1, np.shape(x))
+        .astype(np.float32), variables.params["backbone"])
+    npz = tmp_path / "bb.npz"
+    pretrained.save_npz(npz, noisy)
+
+    args = ["vgg", "--host-devices", "8", "--synthetic-examples", "64",
+            "--batch-size", "8", "--epochs", "1", "--fine-tune-epochs", "0"]
+    base = _run(args, capsys)
+    warm = _run(args + ["--pretrained-weights", str(npz)], capsys)
+    assert "loaded pretrained weights" in warm
+    assert "loaded pretrained weights" not in base
+
+    def floor(out):
+        line = [ln for ln in out.splitlines() if "initial loss" in ln][0]
+        return float(line.split(":")[1])
+
+    assert floor(base) != floor(warm)
+
+
+def test_cli_mobile(capsys):
+    out = _run(["mobile", "--host-devices", "8", "--synthetic-examples",
+                "64", "--batch-size", "8", "--epochs", "1",
+                "--fine-tune-epochs", "0"], capsys)
+    assert "epoch 1/1" in out and "test:" in out
+
+
+def test_cli_dense_cifar(capsys):
+    out = _run(["dense", "--host-devices", "8", "--synthetic-examples",
+                "64", "--batch-size", "4", "--epochs", "1",
+                "--fine-tune-epochs", "0"], capsys)
+    assert "epoch 1/1" in out and "test:" in out
+
+
+def test_cli_fed_checkpoint_gate_and_resume(tmp_path, capsys):
+    args = ["fed", "--path", str(tmp_path), "--host-devices", "8",
+            "--synthetic-examples", "64", "--batch-size", "8",
+            "--rounds", "2", "--num-clients", "8", "--local-epochs", "1",
+            "--pretrain-epochs", "1", "--iid"]
+    first = _run(args, capsys)
+    assert "round, train_loss, train_acc, test_loss, test_acc" in first
+    assert first.count("\n0, ") + first.count("\n1, ") == 2
+    assert (tmp_path / "pretrained" / "cp.ckpt").exists()
+
+    # Second run: pretrain gate skips training (fed_model.py:175, fixed
+    # quirk Q5) and the round loop resumes past the completed rounds.
+    second = _run(args + ["--rounds", "3"], capsys)
+    assert "restored pretrained weights" in second
+    assert "resuming federated training from round 2" in second
+    assert "\n2, " in second and "\n1, " not in second
+
+
+def test_cli_secure_fed_masked(capsys):
+    out = _run(["secure-fed", "--host-devices", "8",
+                "--synthetic-examples", "256", "--batch-size", "8",
+                "--rounds", "2", "--num-clients", "8",
+                "--local-epochs", "1", "--percent", "0.5"], capsys)
+    assert "round 0:" in out and "round 1:" in out
+    assert "auroc=" in out                   # C16 metric on the eval path
+
+
+def test_cli_secure_fed_paillier(capsys):
+    out = _run(["secure-fed", "--host-devices", "8",
+                "--synthetic-examples", "128", "--batch-size", "8",
+                "--rounds", "1", "--num-clients", "2",
+                "--local-epochs", "1", "--percent", "0.25", "--paillier"],
+               capsys)
+    assert "round 0:" in out
+    assert "Client 0 training took" in out   # C17 per-client Timers
