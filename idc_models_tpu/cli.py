@@ -339,6 +339,11 @@ def _run_fed(ns):
     mesh = meshlib.client_mesh(n_clients)
     imgs, labels = partition_clients(ds, n_clients, iid=bool(preset.iid),
                                      seed=ns.seed)
+    n_per_client = imgs.shape[1]
+    # upload the stacked client shards to HBM once — not once per round
+    cshard = meshlib.sharding(mesh, meshlib.CLIENT_AXIS)
+    imgs = jax.device_put(imgs, cshard)
+    labels = jax.device_put(labels, cshard)
     train_ids, test_ids = train_test_client_split(
         n_clients, preset.test_client_fraction, seed=ns.seed)
     opt = rmsprop(preset.lr / 10.0,
@@ -362,9 +367,9 @@ def _run_fed(ns):
     eval_fn = make_federated_eval(model, _loss_for(preset.num_outputs), mesh)
     # train clients carry weight = examples; test clients weight 0
     w_train = np.zeros((n_clients,), np.float32)
-    w_train[train_ids] = imgs.shape[1]
+    w_train[train_ids] = n_per_client
     w_test = np.zeros((n_clients,), np.float32)
-    w_test[test_ids] = imgs.shape[1]
+    w_test[test_ids] = n_per_client
     print("round, train_loss, train_acc, test_loss, test_acc")
     with Timer("Federated training", logger=logger), \
             profile_trace(ns.profile_dir):
@@ -431,6 +436,10 @@ def _run_secure(ns):
     labels = np.stack([s.labels[:size] for s in shards])
 
     mesh = meshlib.client_mesh(n_clients)
+    # upload the stacked client shards to HBM once — not once per round
+    cshard = meshlib.sharding(mesh, meshlib.CLIENT_AXIS)
+    imgs = jax.device_put(imgs, cshard)
+    labels = jax.device_put(labels, cshard)
     server = initialize_server(model, jax.random.key(ns.seed))
     round_fn = make_secure_fedavg_round(
         model, opt, loss_fn, mesh, percent=preset.percent,
