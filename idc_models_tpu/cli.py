@@ -101,6 +101,10 @@ def _parse(argv):
     sp.add_argument("--paillier", action="store_true", default=None,
                     help="host-side Paillier parity mode instead of "
                              "pairwise masks")
+    sp.add_argument("--mask-impl", default="threefry",
+                    choices=("threefry", "pallas"),
+                    help="PRG for the pairwise masks: XLA threefry "
+                         "(default) or the fused Pallas kernel")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -424,6 +428,9 @@ def _run_secure(ns):
     opt = rmsprop(preset.lr)
 
     if preset.paillier:
+        if getattr(ns, "mask_impl", "threefry") != "threefry":
+            print("[idc_models_tpu] --mask-impl has no effect with "
+                  "--paillier (host-side Paillier path)", file=sys.stderr)
         _run_secure_paillier(preset, n_clients, client_ds, test_ds, model,
                              opt, loss_fn, logger, ns)
         return
@@ -443,7 +450,8 @@ def _run_secure(ns):
     server = initialize_server(model, jax.random.key(ns.seed))
     round_fn = make_secure_fedavg_round(
         model, opt, loss_fn, mesh, percent=preset.percent,
-        local_epochs=preset.local_epochs, batch_size=preset.batch_size)
+        local_epochs=preset.local_epochs, batch_size=preset.batch_size,
+        mask_impl=getattr(ns, "mask_impl", "threefry"))
     evaluator = Evaluator(model, loss_fn, mesh, batch_size=preset.batch_size,
                           with_auroc=True)
     from idc_models_tpu.observe import profile_trace

@@ -159,8 +159,10 @@ def densenet201_backbone(in_channels: int = 3, *,
         h = jax.nn.relu(run("bn", h))
         return h, new_state
 
-    m = core.Module(init, apply, "densenet201")
-    return m
+    # layer_names in Keras creation order (see mobilenet.py) so secure
+    # percent-selection keeps get_weights() order for this backbone
+    return core.Module(init, apply, "densenet201",
+                       layer_names=tuple(KERAS_LAYER_INDEX))
 
 
 DENSENET201_FEATURES = 1920

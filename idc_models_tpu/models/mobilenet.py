@@ -171,7 +171,11 @@ def mobilenet_v2_backbone(in_channels: int = 3, *,
         h = jnp.minimum(jax.nn.relu(run("Conv_1_bn", h)), 6.0)
         return h, new_state
 
-    return core.Module(init, apply, "mobilenet_v2")
+    # layer_names in Keras creation order (_build_index inserts names in
+    # ascending Keras-index order) so secure percent-selection follows
+    # get_weights() order for this backbone too (secure_fed_model.py:115-121)
+    return core.Module(init, apply, "mobilenet_v2",
+                       layer_names=tuple(KERAS_LAYER_INDEX))
 
 
 def mobilenet_v2(num_outputs: int = 1, in_channels: int = 3, *,

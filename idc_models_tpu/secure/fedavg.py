@@ -64,6 +64,7 @@ def make_secure_fedavg_round(
     scale_bits: int | None = None,
     clip_abs: float = masking.DEFAULT_CLIP_ABS,
     compute_dtype=jnp.float32,
+    mask_impl: str = "threefry",
 ):
     """Build the jitted one-round secure-FedAvg program.
 
@@ -72,11 +73,27 @@ def make_secure_fedavg_round(
     (reference parity, quirk Q7); `percent` of the parameter tensors (in
     model layer order) go through the masked integer path.
 
+    The round boundary packs the protected tensors into ONE flat int32
+    buffer (single masked psum) and everything else — unprotected params
+    and model state — into ONE flat f32 buffer (single pmean): exactly
+    two weight collectives per round regardless of model depth.
+
+    `mask_impl` selects how the flat protected buffer is quantized+masked:
+    ``"threefry"`` (default) is XLA's threefry PRG via
+    `masking.pairwise_mask`; ``"pallas"`` is the fused single-pass Pallas
+    kernel (`ops.secure_masking_kernel.fused_masked_quantize`, hash-PRG,
+    interpret mode off-TPU). Both cancel exactly under psum; they produce
+    different (each internally consistent) mask streams, so all clients
+    of one aggregation must use the same impl — guaranteed here since the
+    whole round is one program.
+
     `scale_bits` defaults to the largest fixed-point precision whose
     cross-client sum of clipped (+-clip_abs) values cannot overflow int32
     (`masking.choose_scale_bits`) — overflow would silently corrupt the
     aggregate, so the headroom is budgeted, not assumed.
     """
+    if mask_impl not in ("threefry", "pallas"):
+        raise ValueError(f"unknown mask_impl {mask_impl!r}")
     n_clients = mesh.shape[meshlib.CLIENT_AXIS]
     if scale_bits is None:
         scale_bits = masking.choose_scale_bits(n_clients, clip_abs)
@@ -93,30 +110,57 @@ def make_secure_fedavg_round(
         new_params, new_model_state, (losses, accs) = local_train(
             params, model_state, imgs, labels, rng)
 
-        # Round boundary: masked psum for the protected prefix of tensors,
-        # plain pmean for the rest and for model state. "First fraction"
-        # follows the model's layer order (Keras get_weights() enumeration,
-        # secure_fed_model.py:115-121), not jax's alphabetical flatten.
+        # Round boundary. "First fraction" follows the model's layer order
+        # (Keras get_weights() enumeration, secure_fed_model.py:115-121),
+        # not jax's alphabetical flatten.
         protect = masking.first_fraction_selection(new_params, percent,
                                                    model.layer_names)
         leaves, treedef = jax.tree.flatten(new_params)
         flags = jax.tree.leaves(protect)
+        state_leaves, state_def = jax.tree.flatten(new_model_state)
 
-        agg_leaves = []
-        for t_index, (leaf, protected) in enumerate(zip(leaves, flags)):
-            if protected:
-                q = masking.quantize(leaf, scale_bits, clip_abs=clip_abs)
-                tensor_key = jax.random.fold_in(mask_key, t_index)
-                m = masking.pairwise_mask(tensor_key, cid, n_clients,
-                                          leaf.shape)
-                summed = collectives.psum(q + m, meshlib.CLIENT_AXIS)
-                agg_leaves.append(
-                    masking.dequantize(summed, scale_bits, count=n_clients))
+        prot = [x for x, f in zip(leaves, flags) if f]
+        plain = [x for x, f in zip(leaves, flags) if not f]
+
+        # -- protected: one quantize+mask pass, ONE psum ----------------
+        prot_agg: list = []
+        if prot:
+            flat, meta = masking.pack_leaves(prot)
+            if mask_impl == "pallas":
+                from idc_models_tpu.ops import secure_masking_kernel as smk
+
+                seed = jax.random.bits(mask_key, (), jnp.uint32)
+                seeds, signs = smk.pair_seeds_and_signs(seed, cid, n_clients)
+                masked = smk.fused_masked_quantize(
+                    flat, seeds, signs, scale_bits=scale_bits,
+                    clip_abs=clip_abs,
+                    # compile via Mosaic only on TPU-class backends (the
+                    # real chip's platform is "axon"); interpret elsewhere
+                    # (CPU test pods, GPU) instead of crashing in lowering
+                    interpret=jax.default_backend() not in ("tpu", "axon"))
             else:
-                agg_leaves.append(
-                    collectives.pmean(leaf, meshlib.CLIENT_AXIS))
+                q = masking.quantize(flat, scale_bits, clip_abs=clip_abs)
+                m = masking.pairwise_mask(mask_key, cid, n_clients,
+                                          flat.shape)
+                masked = q + m
+            summed = collectives.psum(masked, meshlib.CLIENT_AXIS)
+            deq = masking.dequantize(summed, scale_bits, count=n_clients)
+            prot_agg = masking.unpack_leaves(deq, meta)
+
+        # -- everything else (unprotected params + state): ONE pmean ----
+        plain_agg: list = []
+        state_agg = state_leaves
+        if plain or state_leaves:
+            flat, meta = masking.pack_leaves(plain + state_leaves)
+            mean = collectives.pmean(flat, meshlib.CLIENT_AXIS)
+            unpacked = masking.unpack_leaves(mean, meta)
+            plain_agg = unpacked[:len(plain)]
+            state_agg = unpacked[len(plain):]
+
+        prot_it, plain_it = iter(prot_agg), iter(plain_agg)
+        agg_leaves = [next(prot_it) if f else next(plain_it) for f in flags]
         agg_params = jax.tree.unflatten(treedef, agg_leaves)
-        agg_state = collectives.pmean(new_model_state, meshlib.CLIENT_AXIS)
+        agg_state = jax.tree.unflatten(state_def, state_agg)
         metrics = collectives.pmean(
             {"loss": jnp.mean(losses), "accuracy": jnp.mean(accs)},
             meshlib.CLIENT_AXIS)

@@ -32,6 +32,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 DEFAULT_SCALE_BITS = 20  # fixed-point fractional bits
@@ -174,3 +175,29 @@ def leaf_paths(tree) -> list[tuple[str, ...]]:
     """Key paths of a pytree's leaves in jax flatten order."""
     paths_and_leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
     return [tuple(k.key for k in p) for p, _ in paths_and_leaves]
+
+
+def pack_leaves(leaves, dtype=jnp.float32):
+    """Concatenate arrays into ONE flat vector (+ static split metadata).
+
+    The round boundary uses this to turn per-tensor collectives into a
+    single psum/pmean over one buffer — O(1) collectives per round
+    instead of O(tensors), and one PRG stream covers every protected
+    element. Returns (flat, meta); `unpack_leaves(flat, meta)` inverts.
+    """
+    shapes = [tuple(x.shape) for x in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    dtypes = [x.dtype for x in leaves]
+    if not leaves:
+        return jnp.zeros((0,), dtype), (sizes, shapes, dtypes)
+    flat = jnp.concatenate([x.reshape(-1).astype(dtype) for x in leaves])
+    return flat, (sizes, shapes, dtypes)
+
+
+def unpack_leaves(flat, meta):
+    sizes, shapes, dtypes = meta
+    out, off = [], 0
+    for size, shape, dt in zip(sizes, shapes, dtypes):
+        out.append(flat[off:off + size].reshape(shape).astype(dt))
+        off += size
+    return out

