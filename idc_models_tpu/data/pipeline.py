@@ -96,8 +96,8 @@ def prefetch_to_mesh(batches: Iterator, mesh: Mesh, *, axis=meshlib.DATA_AXIS,
     def producer():
         try:
             for batch in batches:
-                if not put(jax.tree.map(lambda a: jax.device_put(a, sh),
-                                        batch)):
+                if not put(jax.tree.map(
+                        lambda a: meshlib.put_with_sharding(a, sh), batch)):
                     return
         except BaseException as e:  # surface errors to the consumer
             put(e)

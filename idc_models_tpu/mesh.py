@@ -121,6 +121,25 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def put_with_sharding(a, sh: NamedSharding):
+    """Host array -> device(s) under `sh`, multi-process safe.
+
+    `jax.device_put` onto a sharding that spans other processes' devices
+    runs a cross-process value-equality collective (and requires every
+    process to hold the full array); production multi-host wants each
+    host to feed only its local shards anyway. `make_array_from_callback`
+    does exactly that: this process materializes only the index slices
+    belonging to its addressable devices.
+    """
+    if isinstance(a, jax.Array) and a.sharding == sh:
+        return a  # already placed — don't round-trip through host
+    if sh.is_fully_addressable:
+        return jax.device_put(a, sh)
+    arr = np.asarray(a)
+    return jax.make_array_from_callback(arr.shape, sh,
+                                        lambda idx: arr[idx])
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -115,10 +115,13 @@ def _wants_rng(fn) -> bool:
         return False
 
 
+put_with_sharding = meshlib.put_with_sharding
+
+
 def shard_batch(mesh: Mesh, *arrays, axis: str | None = None):
-    """Device_put host arrays sharded over the batch axis of `mesh`."""
+    """Put host arrays on `mesh` sharded over the batch axis."""
     sh = meshlib.sharding(mesh, _batch_axis(mesh, axis))
-    out = tuple(jax.device_put(a, sh) for a in arrays)
+    out = tuple(put_with_sharding(a, sh) for a in arrays)
     return out if len(out) > 1 else out[0]
 
 
@@ -134,5 +137,8 @@ def _batch_axis(mesh: Mesh, axis: str | None) -> str:
 
 
 def replicate(mesh: Mesh, tree):
-    """Device_put a pytree fully replicated over `mesh`."""
-    return jax.device_put(tree, meshlib.replicated(mesh))
+    """Put a pytree on `mesh` fully replicated (multi-process safe)."""
+    sh = meshlib.replicated(mesh)
+    if sh.is_fully_addressable:
+        return jax.device_put(tree, sh)
+    return jax.tree.map(lambda a: put_with_sharding(a, sh), tree)

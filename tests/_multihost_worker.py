@@ -1,0 +1,75 @@
+"""Worker for the 2-process multi-host (DCN stand-in) test.
+
+Each OS process plays one "host" of a pod: 4 virtual CPU devices each,
+joined through `mesh.initialize_multihost` (jax.distributed). The test
+driver (test_multihost.py) launches two of these and checks both report
+the same post-step parameter digest — i.e. the data-parallel allreduce
+really spanned the process boundary.
+
+Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id>
+"""
+
+import sys
+
+
+def main() -> int:
+    coordinator, num_procs, proc_id = (sys.argv[1], int(sys.argv[2]),
+                                       int(sys.argv[3]))
+    repo = __file__.rsplit("/tests/", 1)[0]
+    sys.path.insert(0, repo)
+
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from idc_models_tpu import mesh as meshlib
+
+    meshlib.force_host_devices(4)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    meshlib.initialize_multihost(coordinator=coordinator,
+                                 num_processes=num_procs,
+                                 process_id=proc_id)
+    assert jax.process_count() == num_procs, jax.process_count()
+    assert len(jax.devices()) == 4 * num_procs, jax.devices()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idc_models_tpu.data import synthetic
+    from idc_models_tpu.models import small_cnn
+    from idc_models_tpu.train import (
+        create_train_state, jit_data_parallel, make_train_step, replicate,
+        rmsprop, shard_batch,
+    )
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    mesh = meshlib.data_mesh()   # spans BOTH processes (8 devices)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    step = jit_data_parallel(
+        make_train_step(model, opt, binary_cross_entropy), mesh)
+    # identical global batch on every process; device_put slices out each
+    # process's addressable shards
+    imgs, labels = synthetic.make_idc_like(64, size=10, seed=0)
+    state = replicate(mesh, state)
+    x, y = shard_batch(mesh, imgs, labels)
+    key = jax.random.key(1)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        state, m = step(state, x, y, sub)
+
+    loss = float(m["loss"])
+    digest = float(jnp.sum(jax.tree.leaves(state.params)[0]
+                           .astype(jnp.float32)))
+    assert np.isfinite(loss)
+    print(f"RESULT proc={proc_id} loss={loss:.8f} digest={digest:.8f}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Multi-host (DCN) path: 2 OS processes x 4 virtual CPU devices join via
+`mesh.initialize_multihost` (jax.distributed) and run a data-parallel
+train step whose gradient allreduce crosses the process boundary.
+
+This is the testable stand-in for a multi-host TPU pod (SURVEY.md D5:
+ICI within a host, DCN across hosts) — the reference never exercises
+multi-node at all (SURVEY.md §4), so this is a capability the framework
+adds and must prove.
+"""
+
+import re
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+WORKER = Path(__file__).parent / "_multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_step_agrees():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), coordinator, "2", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    results = {}
+    for out in outs:
+        m = re.search(r"RESULT proc=(\d+) loss=([-\d.]+) digest=([-\d.]+)",
+                      out)
+        assert m, out
+        results[int(m.group(1))] = (m.group(2), m.group(3))
+    assert set(results) == {0, 1}
+    # the allreduce spanned processes: both replicas hold identical state
+    assert results[0] == results[1], results
