@@ -76,6 +76,9 @@ def _parse(argv):
         sp.add_argument("--epochs", type=int, default=None)
         sp.add_argument("--fine-tune-epochs", type=int, default=None)
         sp.add_argument("--fine-tune-at", type=int, default=None)
+        sp.add_argument("--repeats", type=int, default=None,
+                        help="dataset passes per epoch (the dense "
+                             "preset's repeat(2))")
         sp.add_argument("--central-storage", action="store_true",
                         help="host-resident parameter store, broadcast "
                              "per step (the reference's use_mirror=False "
@@ -220,7 +223,8 @@ def _run_dist(ns):
 
     preset = _apply_overrides(
         get_preset(ns.preset_key), ns,
-        ["batch_size", "lr", "epochs", "fine_tune_epochs", "fine_tune_at"])
+        ["batch_size", "lr", "epochs", "fine_tune_epochs", "fine_tune_at",
+         "repeats"])
     mesh = meshlib.data_mesh()
     n_dev = mesh.devices.size
     global_batch = (preset.batch_size * n_dev if preset.per_replica_batch
@@ -251,7 +255,8 @@ def _run_dist(ns):
             TwoPhaseConfig(lr=preset.lr, epochs=preset.epochs,
                            fine_tune_epochs=preset.fine_tune_epochs,
                            batch_size=global_batch,
-                           fine_tune_at=preset.fine_tune_at, seed=ns.seed,
+                           fine_tune_at=preset.fine_tune_at,
+                           repeats=preset.repeats, seed=ns.seed,
                            central_storage=ns.central_storage),
             pretrained_weights=ns.pretrained_weights,
             artifact_path=ns.path, logger=logger)

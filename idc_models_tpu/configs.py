@@ -29,6 +29,8 @@ class DistPreset:
     per_replica_batch: bool      # dense scales batch by replica count
     fine_tune_at: int
     dataset_limit: int | None    # balanced-subset size
+    repeats: int = 1             # dataset passes per epoch (dense=2,
+    #                              dist_model_tf_dense.py:122-123 repeat(2))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,13 +86,14 @@ PRESETS = {
         image_size=50, lr=1e-4, epochs=10, fine_tune_epochs=10,
         batch_size=32, per_replica_batch=False, fine_tune_at=100,
         dataset_limit=24257),
-    # dist_model_tf_dense.py:26-28,131-158 — DenseNet201 on CIFAR-10,
-    # B=256/replica, lr 1e-4, ft@150, sparse CE (fixing quirk Q4)
+    # dist_model_tf_dense.py:26-28,122-123,131-158 — DenseNet201 on
+    # CIFAR-10, B=256/replica, lr 1e-4, ft@150, sparse CE (fixing quirk
+    # Q4), train set repeat(2) per epoch
     "dense": DistPreset(
         name="dense", model="densenet201", dataset="cifar10", num_outputs=10,
         image_size=32, lr=1e-4, epochs=10, fine_tune_epochs=10,
         batch_size=256, per_replica_batch=True, fine_tune_at=150,
-        dataset_limit=None),
+        dataset_limit=None, repeats=2),
     "fed": FedPreset(),
     "secure_fed": SecureFedPreset(),
 }

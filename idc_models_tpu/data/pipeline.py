@@ -30,38 +30,52 @@ class Loader:
     - `drop_remainder`: required under data parallelism so every step's
       global batch divides the mesh; the reference gets this implicitly
       from fixed-size take/skip splits
+    - `repeat`: passes over the dataset per epoch — the reference's
+      CIFAR pipeline appends `.repeat(2)` after batching
+      (dist_model_tf_dense.py:122-123), so each fit "epoch" sees the
+      train set twice; with shuffle on, every pass gets a fresh
+      permutation (tf.data reshuffles each iteration)
     """
 
     def __init__(self, ds: ArrayDataset, batch_size: int, *,
                  shuffle: bool = True, seed: int = 0,
-                 drop_remainder: bool = True):
+                 drop_remainder: bool = True, repeat: int = 1):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if len(ds) < batch_size and drop_remainder:
             raise ValueError(
                 f"dataset of {len(ds)} examples yields zero batches of "
                 f"size {batch_size} with drop_remainder")
+        if repeat < 1:
+            raise ValueError(f"repeat must be >= 1, got {repeat}")
         self.ds = ds
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
         self.drop_remainder = drop_remainder
+        self.repeat = repeat
 
     def __len__(self) -> int:
         n = len(self.ds)
-        return n // self.batch_size if self.drop_remainder else -(-n // self.batch_size)
+        per_pass = (n // self.batch_size if self.drop_remainder
+                    else -(-n // self.batch_size))
+        return per_pass * self.repeat
 
     def epoch(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         n = len(self.ds)
-        if self.shuffle:
-            order = np.random.default_rng((self.seed, epoch)).permutation(n)
-        else:
-            order = np.arange(n)
         stop = (n // self.batch_size * self.batch_size
                 if self.drop_remainder else n)
-        for i in range(0, stop, self.batch_size):
-            idx = order[i:i + self.batch_size]
-            yield self.ds.images[idx], self.ds.labels[idx]
+        for rep in range(self.repeat):
+            if self.shuffle:
+                # rep folded into the seed only for the extra passes keeps
+                # the repeat=1 stream identical to what it always was
+                key = (self.seed, epoch) if rep == 0 else (self.seed, epoch, rep)
+                order = np.random.default_rng(key).permutation(n)
+            else:
+                order = np.arange(n)
+            for i in range(0, stop, self.batch_size):
+                idx = order[i:i + self.batch_size]
+                yield self.ds.images[idx], self.ds.labels[idx]
 
     def __iter__(self):
         return self.epoch(0)

@@ -29,6 +29,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh
 
+from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.data.idc import ArrayDataset
 from idc_models_tpu.data.pipeline import Loader, pad_to_multiple, prefetch_to_mesh
 from idc_models_tpu.models import core, registry
@@ -62,6 +63,11 @@ class Evaluator:
         self._step = jit_data_parallel(
             make_eval_step(model, loss_fn, compute_dtype=compute_dtype),
             mesh, donate_state=False)
+        # multi-host: batch-sharded logits span other processes' devices
+        # and cannot be fetched directly; this identity jit re-places them
+        # fully replicated (XLA all-gather over ICI/DCN) first
+        self._gather = jax.jit(lambda x: x,
+                               out_shardings=meshlib.replicated(mesh))
 
     def __call__(self, state: TrainState, ds: ArrayDataset, *,
                  steps: int | None = None) -> dict[str, float]:
@@ -75,7 +81,10 @@ class Evaluator:
                 break
             x, y, mask = pad_to_multiple(x, y, n_dev)
             m = self._step(state, *shard_batch(self.mesh, x, y))
-            logits_parts.append(np.asarray(m["logits"])[mask])
+            logits = m["logits"]
+            if not logits.is_fully_addressable:
+                logits = self._gather(logits)
+            logits_parts.append(np.asarray(logits)[mask])
             labels_parts.append(y[mask])
         logits = jnp.asarray(np.concatenate(logits_parts))
         labels = jnp.asarray(np.concatenate(labels_parts))
@@ -104,7 +113,8 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         val_ds: ArrayDataset | None, mesh: Mesh, *, epochs: int,
         batch_size: int = 32, initial_epoch: int = 0, seed: int = 0,
         logger=None, verbose: bool = True, central_storage: bool = False,
-        compute_dtype=jnp.float32) -> tuple[TrainState, History]:
+        compute_dtype=jnp.float32,
+        repeats: int = 1) -> tuple[TrainState, History]:
     """Keras-`fit`-shaped epoch loop over the jitted DP train step.
 
     Returns the final state and a Keras-style history dict
@@ -123,6 +133,12 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         make_train_step(model, optimizer, loss_fn,
                         compute_dtype=compute_dtype), mesh)
     if central_storage:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "central_storage is a single-host parity mode (the "
+                "reference's CentralStorageStrategy, "
+                "dist_model_tf_dense.py:18, is single-host too); use the "
+                "default mirrored mode on multi-host pods")
         state = jax.device_get(state)
 
         def step_fn(host_state, x, y, rng):
@@ -131,7 +147,11 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
     else:
         step_fn = base_step
         state = replicate(mesh, state)
-    loader = Loader(train_ds, batch_size, shuffle=True, seed=seed)
+    # repeats>1 reproduces the reference CIFAR pipeline's `.repeat(2)`
+    # (dist_model_tf_dense.py:122-123): each epoch passes over the train
+    # set `repeats` times, freshly shuffled per pass.
+    loader = Loader(train_ds, batch_size, shuffle=True, seed=seed,
+                    repeat=repeats)
     evaluator = (Evaluator(model, loss_fn, mesh, batch_size=batch_size,
                            compute_dtype=compute_dtype)
                  if val_ds is not None else None)
@@ -174,6 +194,8 @@ class TwoPhaseConfig:
     batch_size: int = 32
     fine_tune_at: int | None = None  # None -> registry default
     eval_steps: int | None = 20    # baseline-floor sample size (quirk Q3)
+    repeats: int = 1               # dataset passes per epoch (dense: 2,
+    #                                dist_model_tf_dense.py:122-123)
     seed: int = 0
     compute_dtype: Any = jnp.float32
     central_storage: bool = False  # D2: host-resident params per step
@@ -267,7 +289,7 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
             epochs=config.epochs, batch_size=config.batch_size,
             seed=config.seed, logger=logger,
             central_storage=config.central_storage,
-            compute_dtype=config.compute_dtype)
+            compute_dtype=config.compute_dtype, repeats=config.repeats)
 
     # Phase 2: "recompile" = fresh optimizer (and state) at lr/10 with the
     # fine-tune mask; BN below fine_tune_at stays in inference mode.
@@ -285,7 +307,7 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
             epochs=total_epochs, batch_size=config.batch_size,
             initial_epoch=config.epochs, seed=config.seed + 1,
             logger=logger, central_storage=config.central_storage,
-            compute_dtype=config.compute_dtype)
+            compute_dtype=config.compute_dtype, repeats=config.repeats)
 
     print(history)
     print(history_fine)

@@ -115,13 +115,10 @@ def _wants_rng(fn) -> bool:
         return False
 
 
-put_with_sharding = meshlib.put_with_sharding
-
-
 def shard_batch(mesh: Mesh, *arrays, axis: str | None = None):
     """Put host arrays on `mesh` sharded over the batch axis."""
     sh = meshlib.sharding(mesh, _batch_axis(mesh, axis))
-    out = tuple(put_with_sharding(a, sh) for a in arrays)
+    out = tuple(meshlib.put_with_sharding(a, sh) for a in arrays)
     return out if len(out) > 1 else out[0]
 
 
@@ -141,4 +138,4 @@ def replicate(mesh: Mesh, tree):
     sh = meshlib.replicated(mesh)
     if sh.is_fully_addressable:
         return jax.device_put(tree, sh)
-    return jax.tree.map(lambda a: put_with_sharding(a, sh), tree)
+    return jax.tree.map(lambda a: meshlib.put_with_sharding(a, sh), tree)

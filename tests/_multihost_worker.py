@@ -66,7 +66,20 @@ def main() -> int:
     digest = float(jnp.sum(jax.tree.leaves(state.params)[0]
                            .astype(jnp.float32)))
     assert np.isfinite(loss)
-    print(f"RESULT proc={proc_id} loss={loss:.8f} digest={digest:.8f}",
+
+    # Evaluator across the process boundary: its eval step's logits are
+    # batch-sharded over both hosts; the replicated-gather path must make
+    # them fetchable so every host computes identical full-set metrics.
+    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.train import Evaluator
+
+    ev = Evaluator(model, binary_cross_entropy, mesh, batch_size=16,
+                   with_auroc=True)
+    em = ev(state, ArrayDataset(imgs, labels))
+    assert np.isfinite(em["loss"]) and 0.0 <= em["accuracy"] <= 1.0
+
+    print(f"RESULT proc={proc_id} loss={loss:.8f} digest={digest:.8f} "
+          f"eval_loss={em['loss']:.8f} eval_auroc={em['auroc']:.8f}",
           flush=True)
     return 0
 

@@ -14,7 +14,8 @@ def test_presets_match_reference_constants():
     assert (mob.lr, mob.fine_tune_at) == (1e-4, 100)
     dense = get_preset("dense")
     assert (dense.num_outputs, dense.per_replica_batch,
-            dense.fine_tune_at) == (10, True, 150)
+            dense.fine_tune_at, dense.repeats) == (10, True, 150, 2)
+    assert get_preset("vgg").repeats == 1
     fed = get_preset("fed")
     assert (fed.num_clients, fed.fine_tune_at) == (10, 15)
     sec = get_preset("secure-fed")

@@ -77,6 +77,29 @@ def test_loader_epochs_and_drop_remainder():
     np.testing.assert_array_equal(b0[0][0], b0r[0][0])
 
 
+def test_loader_repeat_two_passes():
+    # the dense preset's repeat(2) (dist_model_tf_dense.py:122-123): each
+    # epoch covers the set twice, each pass freshly shuffled
+    imgs, labels = synthetic.make_idc_like(64, size=8, seed=0)
+    labels = np.arange(64, dtype=np.int32)
+    ds = ArrayDataset(imgs, labels)
+    ld = Loader(ds, 16, seed=1, repeat=2)
+    assert len(ld) == 8
+    batches = list(ld.epoch(0))
+    assert len(batches) == 8
+    first_pass = np.concatenate([y for _, y in batches[:4]])
+    second_pass = np.concatenate([y for _, y in batches[4:]])
+    # each pass is a full permutation; the two passes are ordered differently
+    assert set(first_pass) == set(range(64)) == set(second_pass)
+    assert not np.array_equal(first_pass, second_pass)
+    # repeat=1 stream is unchanged by the feature (pass 0 seeds the same)
+    np.testing.assert_array_equal(
+        np.concatenate([y for _, y in Loader(ds, 16, seed=1).epoch(0)]),
+        first_pass)
+    with pytest.raises(ValueError, match="repeat"):
+        Loader(ds, 16, repeat=0)
+
+
 def test_prefetch_to_mesh_shards(devices):
     mesh = meshlib.data_mesh(8)
     imgs, labels = synthetic.make_idc_like(64, size=8, seed=0)
@@ -162,6 +185,46 @@ def test_cifar10_npz(tmp_path):
     ds = cifar10.load_cifar10(str(tmp_path), split="train")
     assert len(ds) == 8
     np.testing.assert_allclose(ds.images, x.astype(np.float32) / 255.0)
+
+
+def test_cifar10_pickle_batches(tmp_path):
+    """The cifar-10-batches-py branch: 5 train batches concatenated, CHW
+    row-major 3072-vectors transposed to NHWC, /255 scaling."""
+    import pickle
+
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+
+    def make_batch(path, n, label_base):
+        # per-image planes: channel c filled with a recoverable constant
+        data = np.zeros((n, 3072), np.uint8)
+        for i in range(n):
+            planes = np.stack([np.full((32, 32), 10 * (c + 1) + i, np.uint8)
+                               for c in range(3)])
+            data[i] = planes.reshape(-1)
+        with open(path, "wb") as f:
+            pickle.dump({b"data": data,
+                         b"labels": [(label_base + i) % 10 for i in range(n)]},
+                        f)
+
+    for b in range(1, 6):
+        make_batch(d / f"data_batch_{b}", 4, b)
+    make_batch(d / "test_batch", 6, 0)
+
+    train = cifar10.load_cifar10(str(tmp_path), split="train")
+    test = cifar10.load_cifar10(str(tmp_path), split="test")
+    assert train.images.shape == (20, 32, 32, 3)
+    assert test.images.shape == (6, 32, 32, 3)
+    assert train.images.dtype == np.float32
+    # image 0 of batch 1: channel c == (10*(c+1) + 0)/255 everywhere
+    for c in range(3):
+        np.testing.assert_allclose(train.images[0, :, :, c],
+                                   (10 * (c + 1)) / 255.0)
+    # batches concatenate in order: image 4 is batch 2's image 0
+    np.testing.assert_allclose(train.images[4, :, :, 0], 10 / 255.0)
+    np.testing.assert_array_equal(train.labels[:4], [1, 2, 3, 4])
+    np.testing.assert_array_equal(train.labels[4:8], [2, 3, 4, 5])
+    np.testing.assert_array_equal(test.labels, np.arange(6) % 10)
 
 
 def test_prefetch_abandoned_iterator_stops_producer(devices):

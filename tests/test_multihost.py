@@ -44,10 +44,11 @@ def test_two_process_dp_step_agrees():
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
     results = {}
     for out in outs:
-        m = re.search(r"RESULT proc=(\d+) loss=([-\d.]+) digest=([-\d.]+)",
-                      out)
+        m = re.search(r"RESULT proc=(\d+) loss=([-\d.]+) digest=([-\d.]+) "
+                      r"eval_loss=([-\d.]+) eval_auroc=([-\d.]+)", out)
         assert m, out
-        results[int(m.group(1))] = (m.group(2), m.group(3))
+        results[int(m.group(1))] = m.groups()[1:]
     assert set(results) == {0, 1}
-    # the allreduce spanned processes: both replicas hold identical state
+    # the allreduce (and the eval logits gather) spanned processes: both
+    # hosts hold identical state and computed identical full-set metrics
     assert results[0] == results[1], results
