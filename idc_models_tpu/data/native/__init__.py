@@ -24,16 +24,51 @@ import numpy as np
 _DIR = Path(__file__).parent
 _SRC = _DIR / "loader.cpp"
 _SO = _DIR / "_native_loader.so"
-_ABI = 1
+_ABI = 2
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _build_error: str | None = None
 
 
-def _build_cmd() -> list[str]:
-    return ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC),
-            "-lpng", "-lz", "-lpthread", "-o", str(_SO)]
+def _build() -> None:
+    """Compile to a per-process temp file and atomically rename into
+    place — never truncate a .so another process may have mapped, and
+    concurrent builders (e.g. multi-host workers sharing a checkout)
+    cannot corrupt each other's half-written output."""
+    tmp = _SO.with_name(f"{_SO.name}.{os.getpid()}.tmp")
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC),
+             "-lpng", "-lz", "-lpthread", "-o", str(tmp)],
+            check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def _open_checked() -> ctypes.CDLL:
+    lib = ctypes.CDLL(str(_SO))
+    try:
+        abi = lib.idc_loader_abi_version()
+    except AttributeError:
+        _dlclose(lib)
+        raise OSError("native loader predates the ABI-version export")
+    if abi != _ABI:
+        # dlclose before raising: dlopen caches by pathname, so a kept
+        # handle would shadow the rebuilt binary on the retry
+        _dlclose(lib)
+        raise OSError(f"native loader ABI {abi} != expected {_ABI}")
+    return lib
+
+
+def _dlclose(lib: ctypes.CDLL) -> None:
+    import _ctypes
+
+    try:
+        _ctypes.dlclose(lib._handle)
+    except OSError:
+        pass
 
 
 def _load() -> ctypes.CDLL | None:
@@ -43,19 +78,24 @@ def _load() -> ctypes.CDLL | None:
             return _lib
         try:
             if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-                subprocess.run(_build_cmd(), check=True, capture_output=True,
-                               text=True)
-            lib = ctypes.CDLL(str(_SO))
-            if lib.idc_loader_abi_version() != _ABI:
-                raise OSError("stale native loader ABI; rebuild")
+                _build()
+            try:
+                lib = _open_checked()
+            except (OSError, AttributeError):
+                # a stale binary that escaped the mtime test (coarse
+                # filesystem timestamps, copied checkouts, pre-ABI-export
+                # builds raising AttributeError): rebuild from the source
+                # sitting right next to it rather than giving up
+                _build()
+                lib = _open_checked()
             lib.idc_decode_batch.argtypes = [
                 ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
                 ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8),
             ]
             lib.idc_decode_batch.restype = ctypes.c_int
             _lib = lib
         except (OSError, subprocess.CalledProcessError, AttributeError) as e:
-            # AttributeError: a stale .so predating the ABI-version export
             detail = getattr(e, "stderr", "") or str(e)
             _build_error = f"native loader unavailable: {detail}"
         return _lib
@@ -71,13 +111,17 @@ def build_error() -> str | None:
 
 
 def decode_batch(paths: list[str], size: int, *,
-                 threads: int = 0) -> np.ndarray:
+                 threads: int = 0, on_error: str = "raise") -> np.ndarray:
     """Decode PNGs to a float32 [n, size, size, 3] batch in [0, 1].
 
-    Failed files decode to zeros (matching the batch-robustness the
-    tf.data pipeline gets from ignore_errors-style handling); a ValueError
-    is raised instead if *every* file fails.
+    `on_error="raise"` (default) raises ValueError naming the files that
+    failed to decode — the same loud behavior as the PIL backend, so
+    `backend="auto"` cannot silently train on zero images with real
+    labels attached. `on_error="zero"` keeps the lenient mode (failed
+    slots stay zero images, with a warning) for callers that opt in.
     """
+    if on_error not in ("raise", "zero"):
+        raise ValueError(f"on_error must be raise|zero, got {on_error!r}")
     lib = _load()
     if lib is None:
         raise RuntimeError(_build_error or "native loader unavailable")
@@ -86,12 +130,19 @@ def decode_batch(paths: list[str], size: int, *,
     if n == 0:
         return out
     arr = (ctypes.c_char_p * n)(*[os.fsencode(p) for p in paths])
+    status = np.empty(n, np.uint8)
     failures = lib.idc_decode_batch(
         arr, n, size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        threads)
-    if failures >= n:
-        raise ValueError(f"all {n} files failed to decode (first: {paths[0]})")
+        threads, status.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     if failures:
+        bad = [paths[i] for i in np.flatnonzero(status == 0)]
+        # even in lenient mode an entirely undecodable input must fail
+        # loudly — an all-zero dataset with real labels is never useful
+        if on_error == "raise" or failures >= n:
+            shown = ", ".join(bad[:5])
+            more = f" (+{len(bad) - 5} more)" if len(bad) > 5 else ""
+            raise ValueError(
+                f"{failures}/{n} files failed to decode: {shown}{more}")
         import warnings
 
         warnings.warn(f"{failures}/{n} files failed to decode; their "

@@ -78,8 +78,10 @@ extern "C" {
 // Decode `n` PNG files to float32 [0,1] NHWC batches of (size,size,3).
 // `out` must hold n*size*size*3 floats. Failed decodes leave their slot
 // zeroed and are counted in the return value (0 == all succeeded).
+// `status` (nullable) must hold n bytes; gets 1 per decoded file, 0 per
+// failure, so the caller can name the failing paths.
 int idc_decode_batch(const char** paths, int n, int size, float* out,
-                     int n_threads) {
+                     int n_threads, unsigned char* status) {
   if (n <= 0) return 0;
   if (n_threads <= 0) n_threads = std::thread::hardware_concurrency();
   if (n_threads > n) n_threads = n;
@@ -96,9 +98,11 @@ int idc_decode_batch(const char** paths, int n, int size, float* out,
       float* dst = out + stride * i;
       if (!decode_png_rgb(paths[i], &pixels, &w, &h) || w == 0 || h == 0) {
         std::memset(dst, 0, stride * sizeof(float));
+        if (status) status[i] = 0;
         failures.fetch_add(1);
         continue;
       }
+      if (status) status[i] = 1;
       if (static_cast<int>(w) == size && static_cast<int>(h) == size) {
         for (size_t p = 0; p < stride; ++p) dst[p] = pixels[p] / 255.0f;
       } else {
@@ -115,6 +119,6 @@ int idc_decode_batch(const char** paths, int n, int size, float* out,
 }
 
 // ABI version so the Python side can detect stale binaries.
-int idc_loader_abi_version() { return 1; }
+int idc_loader_abi_version() { return 2; }
 
 }  // extern "C"

@@ -71,8 +71,21 @@ def quantize(x: jax.Array, scale_bits: int = DEFAULT_SCALE_BITS, *,
 
 def dequantize(q: jax.Array, scale_bits: int = DEFAULT_SCALE_BITS,
                *, count: jax.Array | float = 1.0) -> jax.Array:
-    """int32 fixed point -> fp32, dividing by `count` (for the mean)."""
-    return q.astype(jnp.float32) / (2.0 ** scale_bits) / count
+    """int32 fixed point -> fp32, dividing by `count` (for the mean).
+
+    Evaluated in two exact pieces: the integer part (|q| < 2^31 -> below
+    2^(31-scale_bits)) and the fractional part (< 2^scale_bits <= 2^23)
+    are each exactly representable in fp32, so rounding happens only in
+    the final add/divide — a few ulps of the *result*. A straight
+    `q.astype(f32)` would instead drop low bits of any sum above 2^24
+    (reachable with clip_abs=64, scale_bits=20, 8 clients), losing the
+    advertised 2^-scale_bits resolution even when the mean is small.
+    """
+    scale = 1 << scale_bits
+    hi = q // scale                  # floor division: exact, lo stays >= 0
+    lo = q - hi * scale              # in [0, scale)
+    return (hi.astype(jnp.float32)
+            + lo.astype(jnp.float32) / jnp.float32(scale)) / count
 
 
 def pair_key(base: jax.Array, i: jax.Array, j: jax.Array) -> jax.Array:

@@ -156,14 +156,36 @@ class EncryptedNumber:
     public_key: PaillierPublicKey
     ciphertext: int
     exponent: int
+    # upper bound on bits of |plaintext mantissa|, tracked through every
+    # homomorphic op: sign decode (negative wraps above n/2) breaks as
+    # soon as a mantissa reaches n/2, silently, so each op budgets its
+    # growth and _scaled_to / __mul__ raise before wrap can happen
+    mantissa_bits: int = _MANTISSA_BITS
+
+    def _check_bits(self, bits: int, what: str) -> int:
+        if bits > self.public_key.n.bit_length() - 2:
+            raise ValueError(
+                f"{what} would overflow the "
+                f"{self.public_key.n.bit_length()}-bit modulus (mantissa "
+                f"bound 2^{bits} reaches n/2 and would wrap, decrypting "
+                f"to garbage — use a larger key or rescale operands)")
+        return bits
 
     def _scaled_to(self, exponent: int) -> "EncryptedNumber":
-        """Re-express at a smaller exponent (multiply mantissa by 2^diff)."""
+        """Re-express at a smaller exponent (multiply mantissa by 2^diff).
+
+        Guarded against encoding overflow (mirroring phe): easiest to hit
+        by adding operands of wildly different magnitudes under a small
+        (e.g. 512-bit) key.
+        """
         if exponent > self.exponent:
             raise ValueError("can only decrease exponent")
-        factor = 1 << (self.exponent - exponent)
+        diff = self.exponent - exponent
+        bits = self._check_bits(self.mantissa_bits + diff,
+                                f"exponent alignment by 2^{diff}")
+        factor = 1 << diff
         c = pow(self.ciphertext, factor, self.public_key.nsquare)
-        return EncryptedNumber(self.public_key, c, exponent)
+        return EncryptedNumber(self.public_key, c, exponent, bits)
 
     def __add__(self, other):
         if isinstance(other, EncryptedNumber):
@@ -173,17 +195,21 @@ class EncryptedNumber:
             exp = min(self.exponent, other.exponent)
             a = self._scaled_to(exp)
             b = other._scaled_to(exp)
+            bits = self._check_bits(
+                max(a.mantissa_bits, b.mantissa_bits) + 1, "addition")
             c = (a.ciphertext * b.ciphertext) % self.public_key.nsquare
-            return EncryptedNumber(self.public_key, c, exp)
+            return EncryptedNumber(self.public_key, c, exp, bits)
         return self + self.public_key.encrypt(other)
 
     __radd__ = __add__
 
     def __mul__(self, scalar: float | int) -> "EncryptedNumber":
         mantissa, exp = _encode(scalar)
+        bits = self._check_bits(self.mantissa_bits + _MANTISSA_BITS,
+                                "scalar multiplication")
         n, n2 = self.public_key.n, self.public_key.nsquare
         c = pow(self.ciphertext, mantissa % n, n2)
-        return EncryptedNumber(self.public_key, c, self.exponent + exp)
+        return EncryptedNumber(self.public_key, c, self.exponent + exp, bits)
 
     __rmul__ = __mul__
 

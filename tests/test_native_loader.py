@@ -58,12 +58,24 @@ def test_resize_matches_python_backend(tmp_path):
         np.testing.assert_allclose(got[i], ref, atol=1e-5)
 
 
-def test_bad_file_zeroed_not_fatal(tmp_path):
+def test_bad_file_raises_naming_the_file(tmp_path):
+    """Default is loud, like the PIL backend: backend='auto' must not
+    silently train on zero images carrying real labels."""
     _write_pngs(tmp_path, n_per_class=1, size=10)
     bad = tmp_path / "0" / "bad.png"
     bad.write_bytes(b"not a png")
     files = sorted(str(p) for p in tmp_path.glob("*/*.png"))
-    got = native.decode_batch(files, 10)
+    with pytest.raises(ValueError, match="bad.png"):
+        native.decode_batch(files, 10)
+
+
+def test_bad_file_zeroed_when_opted_in(tmp_path):
+    _write_pngs(tmp_path, n_per_class=1, size=10)
+    bad = tmp_path / "0" / "bad.png"
+    bad.write_bytes(b"not a png")
+    files = sorted(str(p) for p in tmp_path.glob("*/*.png"))
+    with pytest.warns(UserWarning, match="failed to decode"):
+        got = native.decode_batch(files, 10, on_error="zero")
     i_bad = files.index(str(bad))
     np.testing.assert_array_equal(got[i_bad], 0.0)
     assert got[(i_bad + 1) % len(files)].max() > 0
@@ -74,6 +86,39 @@ def test_all_bad_raises(tmp_path):
     bad.write_bytes(b"nope")
     with pytest.raises(ValueError):
         native.decode_batch([str(bad)], 10)
+    # all-failed raises even in lenient mode
+    with pytest.raises(ValueError, match="failed to decode"):
+        native.decode_batch([str(bad)], 10, on_error="zero")
+    with pytest.raises(ValueError, match="on_error"):
+        native.decode_batch([str(bad)], 10, on_error="ignore")
+
+
+def test_stale_abi_binary_triggers_rebuild(tmp_path, monkeypatch):
+    """A wrong-ABI .so that escapes the mtime test must be rebuilt from
+    source, not cached as a permanent failure."""
+    import shutil
+
+    import idc_models_tpu.data.native as nat
+
+    src = tmp_path / "loader.cpp"
+    so = tmp_path / "_native_loader.so"
+    shutil.copy(nat._SRC, src)
+    # build a fake ABI-0 binary, dated in the future so mtime says fresh
+    import subprocess
+    stub = tmp_path / "stub.cpp"
+    stub.write_text('extern "C" int idc_loader_abi_version() { return 0; }')
+    subprocess.run(["g++", "-O3", "-shared", "-fPIC", str(stub),
+                    "-o", str(so)], check=True)
+    import os as _os
+    future = _os.stat(src).st_mtime + 10_000
+    _os.utime(so, (future, future))
+
+    monkeypatch.setattr(nat, "_SRC", src)
+    monkeypatch.setattr(nat, "_SO", so)
+    monkeypatch.setattr(nat, "_lib", None)
+    monkeypatch.setattr(nat, "_build_error", None)
+    assert nat.available(), nat.build_error()
+    assert nat._lib.idc_loader_abi_version() == nat._ABI
 
 
 def test_load_directory_native_equals_pil(tmp_path):

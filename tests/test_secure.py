@@ -60,7 +60,10 @@ def test_masked_psum_equals_plain_psum():
     np.testing.assert_array_equal(np.asarray(masked_sum),
                                   np.asarray(plain_sum))
     # each device's masked contribution differs from its plain quantized
-    # update (i.e. the aggregator never sees plaintext)
+    # update. NOTE: this is a simulation-level property only — the round
+    # key that derives the pairwise masks is held by the driver, so a
+    # party with that key could regenerate the masks (masking.py
+    # docstring; reference quirk Q9 keeps both Paillier keys global too).
     q_plain = np.asarray(quantize(jnp.asarray(vals)))
     assert not np.array_equal(np.asarray(contributions), q_plain)
     # and the dequantized mean matches the true mean to quantization error
@@ -72,6 +75,42 @@ def test_quantize_roundtrip():
     x = jnp.asarray(np.random.default_rng(1).normal(size=(100,)) * 5)
     back = dequantize(quantize(x))
     np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-6)
+
+
+def test_dequantize_keeps_resolution_for_large_sums():
+    """Sums past 2^24 (reachable with clip 64, scale 20, 8 clients) must
+    not lose low bits: the split evaluation matches a float64 reference
+    exactly for power-of-two counts (one rounding, at the result)."""
+    s = 20
+    q_np = np.asarray([2**24 + 1, -(2**24 + 1), 2**29 + 3, (1 << 31) - 1,
+                       -(1 << 31), 12345, 0], np.int64)
+    got = np.asarray(dequantize(jnp.asarray(q_np, jnp.int32), s, count=8))
+    want = (q_np.astype(np.float64) / 2**s / 8).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+    # non-power-of-two count: one extra rounding, still ~ulp accurate
+    got3 = np.asarray(dequantize(jnp.asarray(q_np, jnp.int32), s, count=3))
+    np.testing.assert_allclose(
+        got3, (q_np.astype(np.float64) / 2**s / 3).astype(np.float32),
+        rtol=1e-7)
+
+
+def test_paillier_exponent_gap_overflow_raises(keypair):
+    """Aligning exponents across a huge magnitude gap would wrap the
+    mantissa mod n and decrypt to garbage; it must raise instead."""
+    pub, _ = keypair
+    big = pub.encrypt(1e100)
+    tiny = pub.encrypt(1e-100)
+    with pytest.raises(ValueError, match="overflow"):
+        _ = big + tiny
+    # scalar multiplication grows the tracked mantissa bound (106 bits
+    # here); a fixed 53-bit-mantissa assumption would wave this through
+    # and the sum would wrap mod n and decrypt to garbage
+    a = pub.encrypt(1e100) * 0.3
+    b = pub.encrypt(1e-30) * 0.7
+    with pytest.raises(ValueError, match="overflow"):
+        _ = a + b
+    # ordinary same-scale arithmetic is untouched by the guard
+    _ = pub.encrypt(1e10) + pub.encrypt(1e-10) * 0.5
 
 
 def test_quantize_clips_instead_of_wrapping():
