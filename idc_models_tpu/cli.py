@@ -83,6 +83,10 @@ def _parse(argv):
                         help="host-resident parameter store, broadcast "
                              "per step (the reference's use_mirror=False "
                              "CentralStorageStrategy toggle)")
+        sp.add_argument("--cache-features", action="store_true",
+                        help="fine-tune on cached frozen-backbone "
+                             "activations (prefix computed once instead "
+                             "of every step; numerically equivalent)")
 
     sp = sub.add_parser("fed", help="federated averaging (FedAvg)")
     common(sp)
@@ -257,7 +261,8 @@ def _run_dist(ns):
                            batch_size=global_batch,
                            fine_tune_at=preset.fine_tune_at,
                            repeats=preset.repeats, seed=ns.seed,
-                           central_storage=ns.central_storage),
+                           central_storage=ns.central_storage,
+                           cache_features=ns.cache_features),
             pretrained_weights=ns.pretrained_weights,
             artifact_path=ns.path, logger=logger)
     test_metrics = evaluate(result.model, result.state, test,

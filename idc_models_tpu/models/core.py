@@ -57,6 +57,11 @@ class Module:
     apply: Callable[..., tuple[jax.Array, State]]
     name: str = "module"
     layer_names: tuple[str, ...] = ()
+    # (param_key, child Module) pairs for composites built by `sequential`
+    # / `classifier`; lets consumers re-compose sub-programs (e.g. the
+    # frozen-backbone feature cache splits a backbone at fine_tune_at).
+    # Empty for leaf layers and hand-rolled composites.
+    children: tuple[tuple[str, "Module"], ...] = ()
 
 
 def _split(rng, n):
@@ -292,18 +297,11 @@ def dropout(rate: float, name: str = "dropout") -> Module:
 # composition
 # ---------------------------------------------------------------------------
 
-def sequential(layers: Sequence[Module], name: str = "sequential") -> Module:
-    """Compose modules; params/state are dicts keyed by unique layer names."""
-    keys: list[str] = []
-    used: set[str] = set()
-    for m in layers:
-        n = m.name
-        i = 0
-        while n in used:
-            n = f"{m.name}_{i}"
-            i += 1
-        used.add(n)
-        keys.append(n)
+def _keyed_sequential(keys: list[str], layers: list[Module],
+                      name: str) -> Module:
+    """The one sequential-composition body: params/state are dicts under
+    the given per-layer keys. Shared by `sequential` (which derives fresh
+    unique keys) and `subsequence` (which KEEPS a parent's keys)."""
 
     def init(rng):
         rngs = _split(rng, len(layers))
@@ -327,7 +325,64 @@ def sequential(layers: Sequence[Module], name: str = "sequential") -> Module:
                 new_state[key] = s2
         return x, new_state
 
-    return Module(init, apply, name, layer_names=tuple(keys))
+    return Module(init, apply, name, layer_names=tuple(keys),
+                  children=tuple(zip(keys, layers)))
+
+
+def sequential(layers: Sequence[Module], name: str = "sequential") -> Module:
+    """Compose modules; params/state are dicts keyed by unique layer names."""
+    keys: list[str] = []
+    used: set[str] = set()
+    for m in layers:
+        n = m.name
+        i = 0
+        while n in used:
+            n = f"{m.name}_{i}"
+            i += 1
+        used.add(n)
+        keys.append(n)
+    return _keyed_sequential(keys, list(layers), name)
+
+
+def subsequence(seq: Module, keys_subset: Sequence[str],
+                name: str | None = None) -> Module:
+    """A sequential over a contiguous run of `seq`'s children, KEEPING the
+    parent's param keys (so the sub-module consumes/produces the matching
+    subtree of the parent's params/state directly). `keys_subset` must be
+    a contiguous in-order slice of the parent's child keys (possibly
+    empty: the identity module) — anything else would silently compute a
+    different function than the parent."""
+    parent_keys = [k for k, _ in seq.children]
+    if not parent_keys:
+        raise ValueError(f"{seq.name} has no children to slice")
+    keys = list(keys_subset)
+    if keys:
+        try:
+            start = parent_keys.index(keys[0])
+        except ValueError:
+            raise KeyError(f"{seq.name} has no child {keys[0]!r}")
+        if parent_keys[start:start + len(keys)] != keys:
+            raise ValueError(
+                f"keys_subset must be a contiguous in-order run of "
+                f"{seq.name}'s children; got {keys}")
+    child_map = dict(seq.children)
+    default = (f"{seq.name}[{keys[0]}:{keys[-1]}]" if keys
+               else f"{seq.name}[empty]")
+    return _keyed_sequential(keys, [child_map[k] for k in keys],
+                             name or default)
+
+
+def split_sequential(seq: Module, at_key: str) -> tuple[Module, Module]:
+    """Split a sequential composite into (prefix, suffix) at `at_key`
+    (the suffix starts with `at_key`). Param/state keys are preserved, so
+    `suffix.apply(subset_of_params, ...)` composes with
+    `prefix.apply(...)` to reproduce `seq.apply` exactly."""
+    keys = [k for k, _ in seq.children]
+    if at_key not in keys:
+        raise KeyError(f"{seq.name} has no child {at_key!r}; have {keys}")
+    i = keys.index(at_key)
+    return (subsequence(seq, keys[:i], name=f"{seq.name}[:{at_key}]"),
+            subsequence(seq, keys[i:], name=f"{seq.name}[{at_key}:]"))
 
 
 def classifier(backbone: Module, feature_dim: int, num_outputs: int,
@@ -359,7 +414,8 @@ def classifier(backbone: Module, feature_dim: int, num_outputs: int,
     bb_names = (tuple(f"backbone.{n}" for n in backbone.layer_names)
                 if backbone.layer_names else ("backbone",))
     return Module(init, apply, name or f"{backbone.name}_classifier",
-                  layer_names=bb_names + ("head",))
+                  layer_names=bb_names + ("head",),
+                  children=(("backbone", backbone), ("head", head)))
 
 
 # ---------------------------------------------------------------------------

@@ -20,6 +20,10 @@ class ModelSpec:
     fine_tune_mask: Callable              # (params, fine_tune_at) -> bool pytree
     default_fine_tune_at: int
     feature_dim: int
+    # Keras layer index per parameterized backbone layer (the zoo's
+    # KERAS_LAYER_INDEX); consumers: fine-tune boundary lookups such as
+    # the frozen-prefix feature cache. None for models without one.
+    layer_index: dict[str, int] | None = None
 
 
 def _always_trainable(params, fine_tune_at=0):
@@ -30,14 +34,17 @@ def _always_trainable(params, fine_tune_at=0):
 
 REGISTRY: dict[str, ModelSpec] = {
     "vgg16": ModelSpec(vgg.vgg16, vgg.head_only_mask, vgg.fine_tune_mask,
-                       default_fine_tune_at=15, feature_dim=512),
+                       default_fine_tune_at=15, feature_dim=512,
+                       layer_index=vgg.KERAS_LAYER_INDEX),
     "mobilenet_v2": ModelSpec(mobilenet.mobilenet_v2,
                               mobilenet.head_only_mask,
                               mobilenet.fine_tune_mask,
-                              default_fine_tune_at=100, feature_dim=1280),
+                              default_fine_tune_at=100, feature_dim=1280,
+                              layer_index=mobilenet.KERAS_LAYER_INDEX),
     "densenet201": ModelSpec(densenet.densenet201, densenet.head_only_mask,
                              densenet.fine_tune_mask,
-                             default_fine_tune_at=150, feature_dim=1920),
+                             default_fine_tune_at=150, feature_dim=1920,
+                             layer_index=densenet.KERAS_LAYER_INDEX),
     "small_cnn": ModelSpec(
         lambda num_outputs=1, in_channels=3: small_cnn_mod.small_cnn(
             10, in_channels, num_outputs),

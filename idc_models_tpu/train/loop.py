@@ -196,6 +196,8 @@ class TwoPhaseConfig:
     eval_steps: int | None = 20    # baseline-floor sample size (quirk Q3)
     repeats: int = 1               # dataset passes per epoch (dense: 2,
     #                                dist_model_tf_dense.py:122-123)
+    cache_features: bool = False   # phase 2 on cached frozen-prefix
+    #                                activations (train/feature_cache.py)
     seed: int = 0
     compute_dtype: Any = jnp.float32
     central_storage: bool = False  # D2: host-resident params per step
@@ -299,15 +301,31 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
                        model_state=state.model_state,
                        opt_state=opt2.init(state.params))
 
+    plan = None
+    if config.cache_features:
+        from idc_models_tpu.train import feature_cache as fc
+
+        plan = fc.plan_feature_cache(model2, spec.layer_index or {},
+                                     fine_tune_at, spec.feature_dim,
+                                     num_outputs)
+        if plan is None:
+            print(f"[idc_models_tpu] {model_name} is not splittable at "
+                  f"fine_tune_at={fine_tune_at}; feature cache disabled")
+
     total_epochs = config.epochs + config.fine_tune_epochs
     with Timer(f"Fine tuning for {config.fine_tune_epochs} epochs",
                logger=logger) as t2:
-        state, history_fine = fit(
-            model2, opt2, loss_fn, state, train_ds, val_ds, mesh,
-            epochs=total_epochs, batch_size=config.batch_size,
-            initial_epoch=config.epochs, seed=config.seed + 1,
-            logger=logger, central_storage=config.central_storage,
-            compute_dtype=config.compute_dtype, repeats=config.repeats)
+        if plan is not None:
+            state, history_fine = _fit_cached_phase2(
+                plan, spec, state, train_ds, val_ds, mesh, config,
+                fine_tune_at, loss_fn, total_epochs, logger)
+        else:
+            state, history_fine = fit(
+                model2, opt2, loss_fn, state, train_ds, val_ds, mesh,
+                epochs=total_epochs, batch_size=config.batch_size,
+                initial_epoch=config.epochs, seed=config.seed + 1,
+                logger=logger, central_storage=config.central_storage,
+                compute_dtype=config.compute_dtype, repeats=config.repeats)
 
     print(history)
     print(history_fine)
@@ -319,4 +337,50 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
         state=state, model=model2, history=history,
         history_fine=history_fine, baseline=baseline,
         pretrain_seconds=t1.seconds, fine_tune_seconds=t2.seconds)
+
+
+def _fit_cached_phase2(plan, spec, state: TrainState, train_ds, val_ds,
+                       mesh: Mesh, config: TwoPhaseConfig,
+                       fine_tune_at: int, loss_fn, total_epochs: int,
+                       logger) -> tuple[TrainState, History]:
+    """Phase 2 on cached frozen-prefix features (train/feature_cache.py):
+    run the prefix once over train/val, fit the suffix model on the
+    features with the same mask/optimizer/seed schedule the uncached path
+    would use, then graft the trained suffix back into the full trees.
+
+    Returns a TrainState for the FULL model; its optimizer state is
+    freshly initialized (the suffix moments live only inside this phase).
+    """
+    from idc_models_tpu.train import feature_cache as fc
+
+    with Timer("Caching frozen-backbone features", logger=logger):
+        feat_train = fc.compute_features(
+            plan, state.params, state.model_state, train_ds, mesh,
+            batch_size=config.batch_size, compute_dtype=config.compute_dtype)
+        feat_val = (fc.compute_features(
+            plan, state.params, state.model_state, val_ds, mesh,
+            batch_size=config.batch_size, compute_dtype=config.compute_dtype)
+            if val_ds is not None else None)
+
+    sp, ss = fc.suffix_variables(plan, state.params, state.model_state)
+    opt = rmsprop(config.lr / 10.0,
+                  trainable_mask=spec.fine_tune_mask(sp, fine_tune_at))
+    sstate = TrainState(step=state.step, params=sp, model_state=ss,
+                        opt_state=opt.init(sp))
+    sstate, history_fine = fit(
+        plan.suffix_model, opt, loss_fn, sstate, feat_train, feat_val,
+        mesh, epochs=total_epochs, batch_size=config.batch_size,
+        initial_epoch=config.epochs, seed=config.seed + 1, logger=logger,
+        central_storage=config.central_storage,
+        compute_dtype=config.compute_dtype, repeats=config.repeats)
+
+    params, model_state = fc.merge_suffix_variables(
+        plan, state.params, state.model_state,
+        jax.device_get(sstate.params), jax.device_get(sstate.model_state))
+    mask2 = spec.fine_tune_mask(params, fine_tune_at)
+    opt2 = rmsprop(config.lr / 10.0, trainable_mask=mask2)
+    full = TrainState(step=sstate.step, params=params,
+                      model_state=model_state,
+                      opt_state=opt2.init(params))
+    return full, history_fine
 

@@ -1,0 +1,92 @@
+"""Frozen-backbone feature cache (train/feature_cache.py): split
+correctness, plan fallbacks, and cached-vs-uncached phase-2 equivalence
+on the flagship VGG16 config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.data.idc import ArrayDataset
+from idc_models_tpu.models import core, registry
+from idc_models_tpu.models.vgg import KERAS_LAYER_INDEX, vgg16, vgg16_backbone
+from idc_models_tpu.train import TwoPhaseConfig, two_phase_fit
+from idc_models_tpu.train import feature_cache as fc
+
+
+def test_split_sequential_composes_to_full():
+    bb = vgg16_backbone()
+    v = bb.init(jax.random.key(0))
+    x = jnp.asarray(
+        np.random.default_rng(0).random((2, 50, 50, 3), np.float32))
+    full, _ = bb.apply(v.params, v.state, x, train=False)
+    prefix, suffix = core.split_sequential(bb, "block5_conv1")
+    pk = [k for k, _ in prefix.children]
+    sk = [k for k, _ in suffix.children]
+    assert pk[-1] == "block4_pool" and sk[0] == "block5_conv1"
+    h, _ = prefix.apply({k: v.params[k] for k in pk if k in v.params},
+                        {}, x, train=False)
+    out, _ = suffix.apply({k: v.params[k] for k in sk if k in v.params},
+                          {}, h, train=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+
+
+def test_split_unknown_key_raises():
+    bb = vgg16_backbone()
+    with pytest.raises(KeyError, match="nope"):
+        core.split_sequential(bb, "nope")
+    # non-contiguous / reordered subsets are rejected, empty is identity
+    with pytest.raises(ValueError, match="contiguous"):
+        core.subsequence(bb, ["block3_conv1", "block1_conv1"])
+    with pytest.raises(ValueError, match="contiguous"):
+        core.subsequence(bb, ["block1_conv1", "block3_conv1"])
+    empty = core.subsequence(bb, [])
+    x = jnp.ones((1, 4, 4, 3))
+    out, _ = empty.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_plan_vgg_boundary_and_fallbacks():
+    model = vgg16(1)
+    plan = fc.plan_feature_cache(model, KERAS_LAYER_INDEX, 15, 512, 1)
+    assert plan is not None and plan.boundary == "block5_conv1"
+    assert plan.suffix_keys[0] == "block5_conv1"
+    # fine_tune_at below every index: nothing frozen -> no plan
+    assert fc.plan_feature_cache(model, KERAS_LAYER_INDEX, 0, 512, 1) is None
+    # fine_tune_at above every index: whole backbone cached, head trains
+    plan_all = fc.plan_feature_cache(model, KERAS_LAYER_INDEX, 10_000,
+                                     512, 1)
+    assert plan_all is not None and plan_all.boundary is None
+    assert plan_all.suffix_keys == ()
+    # a model without children metadata is not splittable
+    small = registry.get_model("small_cnn").build(1, 3)
+    assert fc.plan_feature_cache(small, {}, 0, 8, 1) is None
+
+
+def test_two_phase_cached_matches_uncached(devices):
+    """The headline guarantee: phase 2 on cached features reproduces the
+    uncached phase-2 training trajectory (same seeds, no rng consumers in
+    the live path)."""
+    mesh = meshlib.data_mesh(8)
+    imgs, labels = synthetic.make_idc_like(48, size=50, seed=0)
+    train = ArrayDataset(imgs[:32], labels[:32])
+    val = ArrayDataset(imgs[32:], labels[32:])
+    kw = dict(lr=1e-3, epochs=1, fine_tune_epochs=1, batch_size=8,
+              eval_steps=1, seed=0)
+
+    r_plain = two_phase_fit("vgg16", 1, train, val, mesh,
+                            TwoPhaseConfig(**kw))
+    r_cached = two_phase_fit("vgg16", 1, train, val, mesh,
+                             TwoPhaseConfig(cache_features=True, **kw))
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        jax.device_get(r_plain.state.params),
+        jax.device_get(r_cached.state.params))
+    np.testing.assert_allclose(r_plain.history_fine["loss"],
+                               r_cached.history_fine["loss"], rtol=1e-4)
+    np.testing.assert_allclose(r_plain.history_fine["val_loss"],
+                               r_cached.history_fine["val_loss"], rtol=1e-4)
