@@ -87,6 +87,10 @@ def _parse(argv):
                         help="fine-tune on cached frozen-backbone "
                              "activations (prefix computed once instead "
                              "of every step; numerically equivalent)")
+        sp.add_argument("--resumable", action="store_true",
+                        help="checkpoint the training loop after every "
+                             "epoch under <path>/dist_ckpt and resume "
+                             "from there on restart (requires --path)")
 
     sp = sub.add_parser("fed", help="federated averaging (FedAvg)")
     common(sp)
@@ -225,6 +229,8 @@ def _run_dist(ns):
     from idc_models_tpu.data.idc import train_val_test_split
     from idc_models_tpu.train import TwoPhaseConfig, evaluate, two_phase_fit
 
+    if ns.resumable and ns.path is None:
+        sys.exit("--resumable requires --path (checkpoints live under it)")
     preset = _apply_overrides(
         get_preset(ns.preset_key), ns,
         ["batch_size", "lr", "epochs", "fine_tune_epochs", "fine_tune_at",
@@ -264,7 +270,10 @@ def _run_dist(ns):
                            central_storage=ns.central_storage,
                            cache_features=ns.cache_features),
             pretrained_weights=ns.pretrained_weights,
-            artifact_path=ns.path, logger=logger)
+            artifact_path=ns.path,
+            checkpoint_dir=(str(Path(ns.path) / "dist_ckpt")
+                            if ns.resumable and ns.path else None),
+            logger=logger)
     test_metrics = evaluate(result.model, result.state, test,
                             _loss_for(preset.num_outputs), mesh,
                             batch_size=global_batch,

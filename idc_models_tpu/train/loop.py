@@ -113,14 +113,21 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         val_ds: ArrayDataset | None, mesh: Mesh, *, epochs: int,
         batch_size: int = 32, initial_epoch: int = 0, seed: int = 0,
         logger=None, verbose: bool = True, central_storage: bool = False,
-        compute_dtype=jnp.float32,
-        repeats: int = 1) -> tuple[TrainState, History]:
+        compute_dtype=jnp.float32, repeats: int = 1,
+        checkpoint_dir: str | None = None) -> tuple[TrainState, History]:
     """Keras-`fit`-shaped epoch loop over the jitted DP train step.
 
     Returns the final state and a Keras-style history dict
     ({"loss", "accuracy", "val_loss", "val_accuracy"} per epoch).
     `initial_epoch` continues a previous schedule's epoch numbering
     (dist_model_tf_vgg.py:159 `initial_epoch=history.epoch[-1]`).
+
+    `checkpoint_dir` enables epoch-granular resume (SURVEY.md §5 build
+    target: checkpoint every loop, not just the pretrainer): the full
+    TrainState + history are saved after each epoch, and a restart picks
+    up at the next epoch. Per-step rng keys are derived by folding the
+    epoch into the seed, so a resumed run consumes the exact stream a
+    straight-through run would have.
 
     `central_storage=True` is the parity toggle for the reference's
     `CentralStorageStrategy` variant (D2, dist_model_tf_dense.py:18,21-24):
@@ -157,8 +164,18 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
                  if val_ds is not None else None)
     history: History = {"loss": [], "accuracy": [],
                         "val_loss": [], "val_accuracy": []}
-    key = jax.random.key(seed)
-    for epoch in range(initial_epoch, epochs):
+    start_epoch = initial_epoch
+    if checkpoint_dir is not None:
+        restored = _restore_fit_checkpoint(checkpoint_dir, state, epochs)
+        if restored is not None:
+            state, history, start_epoch = restored
+            start_epoch = max(start_epoch, initial_epoch)
+            if verbose and start_epoch > initial_epoch:
+                print(f"resuming fit from epoch {start_epoch + 1}")
+    for epoch in range(start_epoch, epochs):
+        # epoch folded into the seed (not a running split) so a resumed
+        # run reproduces the straight-through rng stream
+        key = jax.random.fold_in(jax.random.key(seed), epoch)
         losses, accs = [], []
         for x, y in prefetch_to_mesh(loader.epoch(epoch), mesh):
             key, sub = jax.random.split(key)
@@ -180,7 +197,63 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
             print(f"epoch {epoch + 1}/{epochs} {msg}")
         if logger is not None:
             logger.log(event="epoch", epoch=epoch, **ep)
+        if checkpoint_dir is not None:
+            _save_fit_checkpoint(checkpoint_dir, state, history, epoch + 1)
     return state, history
+
+
+def _save_fit_checkpoint(ckpt_dir, state: TrainState, history: History,
+                         next_epoch: int) -> None:
+    """Commit protocol: the epoch-versioned orbax save lands first, then
+    meta.json is atomically renamed to point at it. A crash between the
+    two leaves meta pointing at the previous consistent (state, epoch)
+    pair, so resume retrains at most the one interrupted epoch — never a
+    state/counter mismatch. On multi-host pods only process 0 writes (the
+    checkpoint dir is assumed shared); every process restores."""
+    import json
+    import shutil
+    from pathlib import Path
+
+    from idc_models_tpu.train.checkpoint import save_checkpoint
+
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return
+    d = Path(ckpt_dir)
+    name = f"state_e{next_epoch}"
+    save_checkpoint(d / name, jax.device_get(state))
+    tmp = d / "meta.json.tmp"
+    tmp.write_text(json.dumps({"epoch": next_epoch, "state": name,
+                               "history": history}))
+    tmp.replace(d / "meta.json")
+    for old in d.glob("state_e*"):
+        if old.name != name:
+            shutil.rmtree(old, ignore_errors=True)
+
+
+def _restore_fit_checkpoint(ckpt_dir, target: TrainState, epochs: int):
+    import json
+    from pathlib import Path
+
+    from idc_models_tpu.train.checkpoint import (
+        checkpoint_exists, restore_checkpoint,
+    )
+
+    d = Path(ckpt_dir)
+    meta = d / "meta.json"
+    if not meta.exists():
+        return None
+    info = json.loads(meta.read_text())
+    epoch = int(info["epoch"])
+    if epoch > epochs:
+        raise ValueError(
+            f"checkpoint {d} was trained for {epoch} epochs but this run "
+            f"asks for {epochs}; refusing to silently return the longer "
+            f"run — delete the checkpoint dir or raise --epochs")
+    state_dir = d / info.get("state", "state")
+    if not checkpoint_exists(state_dir):
+        return None
+    state = restore_checkpoint(state_dir, jax.device_get(target))
+    return state, dict(info["history"]), epoch
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,6 +309,7 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
                   pretrained_params=None, pretrained_state=None,
                   pretrained_weights: str | None = None,
                   artifact_path: str | None = None,
+                  checkpoint_dir: str | None = None,
                   logger=None) -> TwoPhaseResult:
     """The reference's full two-phase transfer-learning program (C7).
 
@@ -243,7 +317,8 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
     (dist_model_tf_vgg.py:122,130-138). Phase 2: layers with Keras index
     >= fine_tune_at unfrozen, fresh RMSprop at lr/10, epoch counter
     continued (dist_model_tf_vgg.py:141-160). Saves the C18 plot artifact
-    under `artifact_path` when given.
+    under `artifact_path` when given. `checkpoint_dir` enables
+    epoch-granular resume of both phases (per-phase subdirectories).
     """
     from idc_models_tpu.train.losses import (
         binary_cross_entropy, sparse_categorical_cross_entropy,
@@ -291,7 +366,9 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
             epochs=config.epochs, batch_size=config.batch_size,
             seed=config.seed, logger=logger,
             central_storage=config.central_storage,
-            compute_dtype=config.compute_dtype, repeats=config.repeats)
+            compute_dtype=config.compute_dtype, repeats=config.repeats,
+            checkpoint_dir=(f"{checkpoint_dir}/phase1"
+                            if checkpoint_dir else None))
 
     # Phase 2: "recompile" = fresh optimizer (and state) at lr/10 with the
     # fine-tune mask; BN below fine_tune_at stays in inference mode.
@@ -315,17 +392,20 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
     total_epochs = config.epochs + config.fine_tune_epochs
     with Timer(f"Fine tuning for {config.fine_tune_epochs} epochs",
                logger=logger) as t2:
+        phase2_ckpt = f"{checkpoint_dir}/phase2" if checkpoint_dir else None
         if plan is not None:
             state, history_fine = _fit_cached_phase2(
                 plan, spec, state, train_ds, val_ds, mesh, config,
-                fine_tune_at, loss_fn, total_epochs, logger)
+                fine_tune_at, loss_fn, total_epochs, logger,
+                checkpoint_dir=phase2_ckpt)
         else:
             state, history_fine = fit(
                 model2, opt2, loss_fn, state, train_ds, val_ds, mesh,
                 epochs=total_epochs, batch_size=config.batch_size,
                 initial_epoch=config.epochs, seed=config.seed + 1,
                 logger=logger, central_storage=config.central_storage,
-                compute_dtype=config.compute_dtype, repeats=config.repeats)
+                compute_dtype=config.compute_dtype, repeats=config.repeats,
+                checkpoint_dir=phase2_ckpt)
 
     print(history)
     print(history_fine)
@@ -342,7 +422,9 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
 def _fit_cached_phase2(plan, spec, state: TrainState, train_ds, val_ds,
                        mesh: Mesh, config: TwoPhaseConfig,
                        fine_tune_at: int, loss_fn, total_epochs: int,
-                       logger) -> tuple[TrainState, History]:
+                       logger,
+                       checkpoint_dir: str | None = None
+                       ) -> tuple[TrainState, History]:
     """Phase 2 on cached frozen-prefix features (train/feature_cache.py):
     run the prefix once over train/val, fit the suffix model on the
     features with the same mask/optimizer/seed schedule the uncached path
@@ -372,7 +454,8 @@ def _fit_cached_phase2(plan, spec, state: TrainState, train_ds, val_ds,
         mesh, epochs=total_epochs, batch_size=config.batch_size,
         initial_epoch=config.epochs, seed=config.seed + 1, logger=logger,
         central_storage=config.central_storage,
-        compute_dtype=config.compute_dtype, repeats=config.repeats)
+        compute_dtype=config.compute_dtype, repeats=config.repeats,
+        checkpoint_dir=checkpoint_dir)
 
     params, model_state = fc.merge_suffix_variables(
         plan, state.params, state.model_state,

@@ -78,8 +78,31 @@ def main() -> int:
     em = ev(state, ArrayDataset(imgs, labels))
     assert np.isfinite(em["loss"]) and 0.0 <= em["accuracy"] <= 1.0
 
+    # Federated round across the process boundary: 8 clients, one per
+    # device spanning both hosts; the round-boundary weighted pmean rides
+    # the jax.distributed (DCN stand-in) link.
+    from idc_models_tpu.federated import initialize_server, make_fedavg_round
+
+    n_clients = 4 * num_procs
+    cmesh = meshlib.client_mesh(n_clients)
+    server = replicate(cmesh, initialize_server(model, jax.random.key(0)))
+    round_fn = make_fedavg_round(model, opt, binary_cross_entropy, cmesh,
+                                 local_epochs=1, batch_size=8)
+    csh = meshlib.sharding(cmesh, meshlib.CLIENT_AXIS)
+    ci = meshlib.put_with_sharding(
+        imgs.reshape(n_clients, -1, *imgs.shape[1:]), csh)
+    cl = meshlib.put_with_sharding(labels.reshape(n_clients, -1), csh)
+    w = np.full((n_clients,), ci.shape[1], np.float32)
+    for r in range(2):
+        server, fm = round_fn(server, ci, cl, w,
+                              jax.random.fold_in(jax.random.key(5), r))
+    fed_loss = float(fm["loss"])
+    fed_digest = float(jnp.sum(jax.tree.leaves(server.params)[0]
+                               .astype(jnp.float32)))
+
     print(f"RESULT proc={proc_id} loss={loss:.8f} digest={digest:.8f} "
-          f"eval_loss={em['loss']:.8f} eval_auroc={em['auroc']:.8f}",
+          f"eval_loss={em['loss']:.8f} eval_auroc={em['auroc']:.8f} "
+          f"fed_loss={fed_loss:.8f} fed_digest={fed_digest:.8f}",
           flush=True)
     return 0
 

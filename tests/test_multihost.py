@@ -45,10 +45,12 @@ def test_two_process_dp_step_agrees():
     results = {}
     for out in outs:
         m = re.search(r"RESULT proc=(\d+) loss=([-\d.]+) digest=([-\d.]+) "
-                      r"eval_loss=([-\d.]+) eval_auroc=([-\d.]+)", out)
+                      r"eval_loss=([-\d.]+) eval_auroc=([-\d.]+) "
+                      r"fed_loss=([-\d.]+) fed_digest=([-\d.]+)", out)
         assert m, out
         results[int(m.group(1))] = m.groups()[1:]
     assert set(results) == {0, 1}
-    # the allreduce (and the eval logits gather) spanned processes: both
-    # hosts hold identical state and computed identical full-set metrics
+    # the DP allreduce, the eval logits gather, and the FedAvg round
+    # boundary all spanned processes: both hosts hold identical state and
+    # computed identical metrics
     assert results[0] == results[1], results
