@@ -135,7 +135,10 @@ def bench_vgg_throughput(on_accelerator: bool):
     from idc_models_tpu.train.losses import binary_cross_entropy
 
     n_dev = len(jax.devices())
-    per_chip_batch = 1024 if on_accelerator else 16
+    # 2048/chip measures ~5% above 1024 (better MXU occupancy); fits in
+    # 16 GB HBM because the frozen backbone's backward is DCE'd so only
+    # block5 activations are saved
+    per_chip_batch = 2048 if on_accelerator else 16
     batch = per_chip_batch * n_dev
 
     mesh = meshlib.data_mesh()
@@ -197,7 +200,7 @@ def bench_vgg_cached_throughput(on_accelerator: bool):
     from idc_models_tpu.train.losses import binary_cross_entropy
 
     n_dev = len(jax.devices())
-    per_chip_batch = 1024 if on_accelerator else 16
+    per_chip_batch = 8192 if on_accelerator else 16
     batch = per_chip_batch * n_dev
 
     mesh = meshlib.data_mesh()

@@ -165,8 +165,12 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
     history: History = {"loss": [], "accuracy": [],
                         "val_loss": [], "val_accuracy": []}
     start_epoch = initial_epoch
+    fingerprint = None
     if checkpoint_dir is not None:
-        restored = _restore_fit_checkpoint(checkpoint_dir, state, epochs)
+        fingerprint = _fit_fingerprint(state, seed, batch_size, repeats,
+                                       initial_epoch)
+        restored = _restore_fit_checkpoint(checkpoint_dir, state, epochs,
+                                           fingerprint)
         if restored is not None:
             state, history, start_epoch = restored
             start_epoch = max(start_epoch, initial_epoch)
@@ -198,31 +202,53 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         if logger is not None:
             logger.log(event="epoch", epoch=epoch, **ep)
         if checkpoint_dir is not None:
-            _save_fit_checkpoint(checkpoint_dir, state, history, epoch + 1)
+            _save_fit_checkpoint(checkpoint_dir, state, history, epoch + 1,
+                                 fingerprint)
     return state, history
 
 
+def _fit_fingerprint(state: TrainState, seed: int, batch_size: int,
+                     repeats: int, initial_epoch: int) -> str:
+    """Identifies the training run a checkpoint belongs to: the rng/data
+    schedule knobs plus a digest of the STARTING parameters (so e.g. a
+    re-trained upstream phase invalidates a downstream phase's
+    checkpoint instead of silently restoring stale state). The optimizer
+    is not captured — changing lr between runs is not detected."""
+    import hashlib
+
+    h = hashlib.sha1(
+        f"{seed}/{batch_size}/{repeats}/{initial_epoch}".encode())
+    for leaf in jax.tree.leaves(jax.device_get(state.params)):
+        a = np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(np.float64(a.astype(np.float64).sum()).tobytes())
+    return h.hexdigest()
+
+
 def _save_fit_checkpoint(ckpt_dir, state: TrainState, history: History,
-                         next_epoch: int) -> None:
+                         next_epoch: int, fingerprint: str) -> None:
     """Commit protocol: the epoch-versioned orbax save lands first, then
     meta.json is atomically renamed to point at it. A crash between the
     two leaves meta pointing at the previous consistent (state, epoch)
     pair, so resume retrains at most the one interrupted epoch — never a
-    state/counter mismatch. On multi-host pods only process 0 writes (the
-    checkpoint dir is assumed shared); every process restores."""
+    state/counter mismatch. orbax save is a collective (it opens with an
+    all-host barrier), so EVERY process calls it — orbax itself elects
+    the writing host; only the tiny meta.json commit is process-0-gated
+    (the checkpoint dir is assumed shared on pods)."""
     import json
     import shutil
     from pathlib import Path
 
     from idc_models_tpu.train.checkpoint import save_checkpoint
 
-    if jax.process_count() > 1 and jax.process_index() != 0:
-        return
     d = Path(ckpt_dir)
     name = f"state_e{next_epoch}"
     save_checkpoint(d / name, jax.device_get(state))
+    if jax.process_index() != 0:
+        return
     tmp = d / "meta.json.tmp"
     tmp.write_text(json.dumps({"epoch": next_epoch, "state": name,
+                               "fingerprint": fingerprint,
                                "history": history}))
     tmp.replace(d / "meta.json")
     for old in d.glob("state_e*"):
@@ -230,8 +256,10 @@ def _save_fit_checkpoint(ckpt_dir, state: TrainState, history: History,
             shutil.rmtree(old, ignore_errors=True)
 
 
-def _restore_fit_checkpoint(ckpt_dir, target: TrainState, epochs: int):
+def _restore_fit_checkpoint(ckpt_dir, target: TrainState, epochs: int,
+                            fingerprint: str):
     import json
+    import warnings
     from pathlib import Path
 
     from idc_models_tpu.train.checkpoint import (
@@ -243,6 +271,12 @@ def _restore_fit_checkpoint(ckpt_dir, target: TrainState, epochs: int):
     if not meta.exists():
         return None
     info = json.loads(meta.read_text())
+    if info.get("fingerprint") != fingerprint:
+        warnings.warn(
+            f"checkpoint {d} belongs to a different run (seed/batch/"
+            f"repeats or starting parameters changed); ignoring it and "
+            f"training from scratch", stacklevel=2)
+        return None
     epoch = int(info["epoch"])
     if epoch > epochs:
         raise ValueError(

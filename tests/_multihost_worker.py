@@ -100,9 +100,28 @@ def main() -> int:
     fed_digest = float(jnp.sum(jax.tree.leaves(server.params)[0]
                                .astype(jnp.float32)))
 
+    # Checkpointed fit across processes: orbax save is a collective, so
+    # this hangs (not just fails) if any process skips it. The dir is
+    # shared (same host in this stand-in, like GCS/NFS on a real pod).
+    import os as _os
+
+    from idc_models_tpu.data.idc import ArrayDataset as _ADS
+    from idc_models_tpu.train import fit
+
+    ckpt_dir = _os.environ["GRAFT_TEST_CKPT_DIR"]
+    opt_c = rmsprop(1e-3)
+    state_c = create_train_state(model, opt_c, jax.random.key(0))
+    state_c, hist_c = fit(model, opt_c, binary_cross_entropy, state_c,
+                          _ADS(imgs, labels), None, mesh, epochs=1,
+                          batch_size=16, verbose=False,
+                          checkpoint_dir=ckpt_dir)
+    assert _os.path.exists(_os.path.join(ckpt_dir, "meta.json"))
+    ckpt_loss = float(hist_c["loss"][-1])
+
     print(f"RESULT proc={proc_id} loss={loss:.8f} digest={digest:.8f} "
           f"eval_loss={em['loss']:.8f} eval_auroc={em['auroc']:.8f} "
-          f"fed_loss={fed_loss:.8f} fed_digest={fed_digest:.8f}",
+          f"fed_loss={fed_loss:.8f} fed_digest={fed_digest:.8f} "
+          f"ckpt_loss={ckpt_loss:.8f}",
           flush=True)
     return 0
 

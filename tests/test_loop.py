@@ -139,6 +139,18 @@ def test_fit_resume_matches_straight_through(devices, tmp_path):
     import pytest
     with pytest.raises(ValueError, match="trained for 3 epochs"):
         run(2, ckpt=d)
+    # a checkpoint from a different run (different seed -> different
+    # fingerprint) is ignored with a warning, not silently restored
+    def run_seed9(epochs, ckpt):
+        opt = rmsprop(1e-3)
+        state = create_train_state(model, opt, jax.random.key(0))
+        return fit(model, opt, binary_cross_entropy, state, train_ds,
+                   val_ds, mesh, epochs=epochs, batch_size=32, seed=9,
+                   verbose=False, checkpoint_dir=ckpt)
+
+    with pytest.warns(UserWarning, match="different run"):
+        _, h9 = run_seed9(3, ckpt=d)
+    assert len(h9["loss"]) == 3  # trained from scratch, not restored
 
 
 def test_two_phase_resumable_cli_dirs(devices, tmp_path):

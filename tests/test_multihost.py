@@ -23,12 +23,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_dp_step_agrees():
+def test_two_process_dp_step_agrees(tmp_path):
+    import os
+
     coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, GRAFT_TEST_CKPT_DIR=str(tmp_path / "ck"))
     procs = [
         subprocess.Popen(
             [sys.executable, str(WORKER), coordinator, "2", str(i)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
         for i in range(2)
     ]
     outs = []
@@ -46,11 +50,12 @@ def test_two_process_dp_step_agrees():
     for out in outs:
         m = re.search(r"RESULT proc=(\d+) loss=([-\d.]+) digest=([-\d.]+) "
                       r"eval_loss=([-\d.]+) eval_auroc=([-\d.]+) "
-                      r"fed_loss=([-\d.]+) fed_digest=([-\d.]+)", out)
+                      r"fed_loss=([-\d.]+) fed_digest=([-\d.]+) "
+                      r"ckpt_loss=([-\d.]+)", out)
         assert m, out
         results[int(m.group(1))] = m.groups()[1:]
     assert set(results) == {0, 1}
-    # the DP allreduce, the eval logits gather, and the FedAvg round
-    # boundary all spanned processes: both hosts hold identical state and
-    # computed identical metrics
+    # the DP allreduce, the eval logits gather, the FedAvg round
+    # boundary, and the collective checkpoint save all spanned processes:
+    # both hosts hold identical state and computed identical metrics
     assert results[0] == results[1], results
