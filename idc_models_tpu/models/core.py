@@ -62,6 +62,12 @@ class Module:
     # frozen-backbone feature cache splits a backbone at fine_tune_at).
     # Empty for leaf layers and hand-rolled composites.
     children: tuple[tuple[str, "Module"], ...] = ()
+    # Optional model-provided split for backbones whose topology is not a
+    # plain sequential (residual adds, dense concats): called with a
+    # Keras fine_tune_at index, returns (prefix, suffix) Modules sharing
+    # the parent's flat param keys — each section's layer_names lists the
+    # param keys it consumes — or None when no frozen prefix exists.
+    splitter: Callable[[int], tuple["Module", "Module"] | None] | None = None
 
 
 def _split(rng, n):

@@ -87,6 +87,120 @@ _BN = dict(momentum=0.999, eps=1e-3)
 FREEZE_ALL = 10**9  # bn_frozen_below value freezing every BN layer
 
 
+def _units(in_channels: int, bn_frozen_below: int):
+    """The backbone as a list of topology units — unit 0 = stem (Conv1 +
+    block 0), units 1..16 = inverted-residual blocks, unit 17 = the
+    Conv_1 top. Each unit is (param_names, apply_fn(run, h) -> h) where
+    `run` applies a named leaf layer. Units are the split granularity for
+    the frozen-backbone feature cache: every unit is a pure function of
+    its input, so any unit boundary is a valid cache point (the residual
+    add lives entirely inside its block's unit)."""
+    specs: list[tuple[str, core.Module]] = []
+
+    def _bn(c, name):
+        frozen = KERAS_LAYER_INDEX[name] < bn_frozen_below
+        return core.batch_norm(c, name=name, frozen=frozen, **_BN)
+
+    def reg(m: core.Module) -> str:
+        specs.append((m.name, m))
+        return m.name
+
+    def relu6(h):
+        return jnp.minimum(jax.nn.relu(h), 6.0)
+
+    units: list[tuple[list[str], object]] = []
+
+    stem_names = [
+        reg(core.conv2d(in_channels, 32, 3, stride=2, use_bias=False,
+                        name="Conv1")),
+        reg(_bn(32, "bn_Conv1")),
+        reg(core.depthwise_conv2d(32, 3, use_bias=False,
+                                  name="expanded_conv_depthwise")),
+        reg(_bn(32, "expanded_conv_depthwise_BN")),
+        reg(core.conv2d(32, 16, 1, use_bias=False,
+                        name="expanded_conv_project")),
+        reg(_bn(16, "expanded_conv_project_BN")),
+    ]
+
+    def stem(run, x):
+        h = relu6(run("bn_Conv1", run("Conv1", x)))
+        h = relu6(run("expanded_conv_depthwise_BN",
+                      run("expanded_conv_depthwise", h)))
+        return run("expanded_conv_project_BN",
+                   run("expanded_conv_project", h))
+
+    units.append((stem_names, stem))
+
+    c_in = 16
+    for b, (t, c, s) in enumerate(_BLOCKS[1:], start=1):
+        hidden = t * c_in
+        names = [
+            reg(core.conv2d(c_in, hidden, 1, use_bias=False,
+                            name=f"block_{b}_expand")),
+            reg(_bn(hidden, f"block_{b}_expand_BN")),
+            reg(core.depthwise_conv2d(hidden, 3, stride=s, use_bias=False,
+                                      name=f"block_{b}_depthwise")),
+            reg(_bn(hidden, f"block_{b}_depthwise_BN")),
+            reg(core.conv2d(hidden, c, 1, use_bias=False,
+                            name=f"block_{b}_project")),
+            reg(_bn(c, f"block_{b}_project_BN")),
+        ]
+
+        def block(run, h, *, b=b, residual=(s == 1 and c == c_in)):
+            inp = h
+            h = relu6(run(f"block_{b}_expand_BN", run(f"block_{b}_expand", h)))
+            h = relu6(run(f"block_{b}_depthwise_BN",
+                          run(f"block_{b}_depthwise", h)))
+            h = run(f"block_{b}_project_BN", run(f"block_{b}_project", h))
+            return h + inp if residual else h
+
+        units.append((names, block))
+        c_in = c
+
+    top_names = [
+        reg(core.conv2d(320, 1280, 1, use_bias=False, name="Conv_1")),
+        reg(_bn(1280, "Conv_1_bn")),
+    ]
+    units.append((top_names, lambda run, h: relu6(run("Conv_1_bn",
+                                                      run("Conv_1", h)))))
+    return units, dict(specs)
+
+
+def _section(units, modules, lo: int, hi: int, name: str,
+             splitter=None) -> core.Module:
+    """A Module running units [lo, hi); params/state are the flat
+    Keras-layer-name dicts restricted to those units' layers."""
+    names = [n for ns, _ in units[lo:hi] for n in ns]
+
+    def init(rng):
+        rngs = jax.random.split(rng, len(names))
+        params, state = {}, {}
+        for n, r in zip(names, rngs):
+            v = modules[n].init(r)
+            if v.params:
+                params[n] = v.params
+            if v.state:
+                state[n] = v.state
+        return core.Variables(params, state)
+
+    def apply(params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+
+        def run(n, h):
+            y, s2 = modules[n].apply(params.get(n, {}), state.get(n, {}),
+                                     h, train=train, rng=None)
+            if n in state:
+                new_state[n] = s2
+            return y
+
+        for _, unit_fn in units[lo:hi]:
+            x = unit_fn(run, x)
+        return x, new_state
+
+    return core.Module(init, apply, name, layer_names=tuple(names),
+                       splitter=splitter)
+
+
 def mobilenet_v2_backbone(in_channels: int = 3, *,
                           bn_frozen_below: int = 0) -> core.Module:
     """Returns the backbone module; params keyed by Keras layer names.
@@ -95,87 +209,40 @@ def mobilenet_v2_backbone(in_channels: int = 3, *,
     inference mode (Keras `trainable=False` semantics) — pass FREEZE_ALL
     for the head-only phase and the phase-2 `fine_tune_at` for fine-tuning,
     mirroring the masks.
+
+    The returned Module carries a `splitter` (unit granularity: stem, 16
+    blocks, top) so the frozen-backbone feature cache works despite the
+    residual topology; the split lands on the last unit edge where every
+    earlier layer has Keras index < fine_tune_at.
     """
-    specs: list[tuple[str, core.Module]] = []
+    units, modules = _units(in_channels, bn_frozen_below)
 
-    def add(m: core.Module):
-        specs.append((m.name, m))
-
-    def _bn(c, name):
-        frozen = KERAS_LAYER_INDEX[name] < bn_frozen_below
-        return core.batch_norm(c, name=name, frozen=frozen, **_BN)
-
-    add(core.conv2d(in_channels, 32, 3, stride=2, use_bias=False, name="Conv1"))
-    add(_bn(32, "bn_Conv1"))
-    add(core.depthwise_conv2d(32, 3, use_bias=False,
-                              name="expanded_conv_depthwise"))
-    add(_bn(32, "expanded_conv_depthwise_BN"))
-    add(core.conv2d(32, 16, 1, use_bias=False, name="expanded_conv_project"))
-    add(_bn(16, "expanded_conv_project_BN"))
-    c_in = 16
-    blocks = []
-    for b, (t, c, s) in enumerate(_BLOCKS[1:], start=1):
-        hidden = t * c_in
-        add(core.conv2d(c_in, hidden, 1, use_bias=False, name=f"block_{b}_expand"))
-        add(_bn(hidden, f"block_{b}_expand_BN"))
-        add(core.depthwise_conv2d(hidden, 3, stride=s, use_bias=False,
-                                  name=f"block_{b}_depthwise"))
-        add(_bn(hidden, f"block_{b}_depthwise_BN"))
-        add(core.conv2d(hidden, c, 1, use_bias=False, name=f"block_{b}_project"))
-        add(_bn(c, f"block_{b}_project_BN"))
-        blocks.append((b, t, c, s, c_in))
-        c_in = c
-    add(core.conv2d(320, 1280, 1, use_bias=False, name="Conv_1"))
-    add(_bn(1280, "Conv_1_bn"))
-    modules = dict(specs)
-
-    def init(rng):
-        rngs = jax.random.split(rng, len(specs))
-        params, state = {}, {}
-        for (name, m), r in zip(specs, rngs):
-            v = m.init(r)
-            if v.params:
-                params[name] = v.params
-            if v.state:
-                state[name] = v.state
-        return core.Variables(params, state)
-
-    def apply(params, state, x, *, train=False, rng=None):
-        new_state = dict(state)
-
-        def run(name, h):
-            m = modules[name]
-            y, s2 = m.apply(params.get(name, {}), state.get(name, {}), h,
-                            train=train, rng=None)
-            if name in state:
-                new_state[name] = s2
-            return y
-
-        h = run("Conv1", x)
-        h = jnp.minimum(jax.nn.relu(run("bn_Conv1", h)), 6.0)
-        h = run("expanded_conv_depthwise", h)
-        h = jnp.minimum(jax.nn.relu(run("expanded_conv_depthwise_BN", h)), 6.0)
-        h = run("expanded_conv_project", h)
-        h = run("expanded_conv_project_BN", h)
-        for b, t, c, s, ci in blocks:
-            inp = h
-            h = run(f"block_{b}_expand", h)
-            h = jnp.minimum(jax.nn.relu(run(f"block_{b}_expand_BN", h)), 6.0)
-            h = run(f"block_{b}_depthwise", h)
-            h = jnp.minimum(jax.nn.relu(run(f"block_{b}_depthwise_BN", h)), 6.0)
-            h = run(f"block_{b}_project", h)
-            h = run(f"block_{b}_project_BN", h)
-            if s == 1 and c == ci:
-                h = h + inp
-        h = run("Conv_1", h)
-        h = jnp.minimum(jax.nn.relu(run("Conv_1_bn", h)), 6.0)
-        return h, new_state
+    def split(fine_tune_at: int):
+        k = _boundary_unit(units, fine_tune_at)
+        if k is None:
+            return None
+        return (_section(units, modules, 0, k, f"mobilenet_v2[:{k}]"),
+                _section(units, modules, k, len(units),
+                         f"mobilenet_v2[{k}:]"))
 
     # layer_names in Keras creation order (_build_index inserts names in
     # ascending Keras-index order) so secure percent-selection follows
     # get_weights() order for this backbone too (secure_fed_model.py:115-121)
-    return core.Module(init, apply, "mobilenet_v2",
-                       layer_names=tuple(KERAS_LAYER_INDEX))
+    sec = _section(units, modules, 0, len(units), "mobilenet_v2",
+                   splitter=split)
+    assert sec.layer_names == tuple(KERAS_LAYER_INDEX)
+    return sec
+
+
+def _boundary_unit(units, fine_tune_at: int):
+    """First unit containing a layer with Keras index >= fine_tune_at;
+    None when that is unit 0 (no frozen prefix to cache). Keras indices
+    are monotone in creation order, so every unit before the boundary is
+    fully frozen."""
+    for k, (names, _) in enumerate(units):
+        if any(KERAS_LAYER_INDEX[n] >= fine_tune_at for n in names):
+            return k if k > 0 else None
+    return len(units)  # nothing live: cache everything
 
 
 def mobilenet_v2(num_outputs: int = 1, in_channels: int = 3, *,

@@ -18,10 +18,13 @@ inference mode), so prefix-once + suffix-per-step computes the same
 function as full-model-per-step, and `tests/test_feature_cache.py` pins
 the cached and uncached phase-2 training trajectories against each other.
 
-Works for any model whose top-level composite exposes `children` with a
-"backbone" built by `core.sequential` (the whole zoo's pattern via
-`core.classifier`); `plan_feature_cache` returns None for models it
-cannot split and callers fall back to the uncached path.
+Splitting strategies: sequential backbones (VGG16) split at the first
+live layer via `core.split_sequential`; non-sequential topologies
+provide a model `splitter` (MobileNetV2 splits at inverted-residual
+unit edges — every unit is a pure function of its input, so the
+residual adds stay whole). `plan_feature_cache` returns None for models
+it cannot split (DenseNet201's dense-concat backbone, small_cnn) and
+callers fall back to the uncached path.
 """
 
 from __future__ import annotations
@@ -47,40 +50,64 @@ class FeatureCachePlan:
 
     prefix: core.Module          # backbone[:boundary]
     suffix_model: core.Module    # classifier(backbone[boundary:]) + GAP + head
-    boundary: str | None         # first live backbone layer (None: none live)
+    # first backbone layer of the SUFFIX (None: empty suffix). On the
+    # sequential path this is the first live layer; on the unit-granular
+    # splitter path it may be a frozen layer of the boundary unit (the
+    # split rounds down to a unit edge).
+    boundary: str | None
     suffix_keys: tuple[str, ...]  # backbone child keys the suffix owns
+
+
+def _param_keys(module: core.Module) -> tuple[str, ...]:
+    """The top-level param/state keys a section consumes: its children's
+    keys for sequential composites, its layer_names for splitter-built
+    flat sections."""
+    if module.children:
+        return tuple(k for k, _ in module.children)
+    return module.layer_names
 
 
 def plan_feature_cache(model: core.Module, layer_index: dict[str, int],
                        fine_tune_at: int, feature_dim: int,
                        num_outputs: int) -> FeatureCachePlan | None:
     """Split `model` (a `core.classifier` composite) at the fine-tune
-    boundary. Returns None when the model is not splittable (no children
-    metadata, no sequential backbone, or nothing frozen to cache)."""
+    boundary. Sequential backbones (VGG) split at the first live layer;
+    backbones with non-sequential topology provide a `splitter`
+    (MobileNetV2: unit granularity). Returns None when the model is not
+    splittable or nothing frozen precedes the boundary."""
     children = dict(model.children)
     backbone = children.get("backbone")
-    if backbone is None or not backbone.children:
+    if backbone is None:
         return None
-    keys = [k for k, _ in backbone.children]
-    live = [k for k in keys
-            if layer_index.get(k, -1) >= fine_tune_at]
-    if live:
-        boundary = live[0]
-        if boundary == keys[0]:
-            return None  # nothing frozen before the boundary — no win
-        prefix, suffix_bb = core.split_sequential(backbone, boundary)
+    if backbone.children:
+        keys = [k for k, _ in backbone.children]
+        live = [k for k in keys
+                if layer_index.get(k, -1) >= fine_tune_at]
+        if live:
+            boundary = live[0]
+            if boundary == keys[0]:
+                return None  # nothing frozen before the boundary — no win
+            prefix, suffix_bb = core.split_sequential(backbone, boundary)
+        else:
+            # everything frozen: cache the backbone, train GAP+head only
+            boundary = None
+            prefix = backbone
+            suffix_bb = core.subsequence(backbone, [],
+                                         name=f"{backbone.name}[empty]")
+    elif backbone.splitter is not None:
+        split = backbone.splitter(fine_tune_at)
+        if split is None:
+            return None
+        prefix, suffix_bb = split
+        boundary = (suffix_bb.layer_names[0] if suffix_bb.layer_names
+                    else None)
     else:
-        # everything frozen: cache the whole backbone, train GAP+head only
-        boundary = None
-        prefix = backbone
-        suffix_bb = core.subsequence(backbone, [],
-                                     name=f"{backbone.name}[empty]")
+        return None
     suffix_model = core.classifier(suffix_bb, feature_dim, num_outputs,
                                    name=f"{model.name}_suffix")
     return FeatureCachePlan(prefix=prefix, suffix_model=suffix_model,
                             boundary=boundary,
-                            suffix_keys=tuple(k for k, _ in
-                                              suffix_bb.children))
+                            suffix_keys=_param_keys(suffix_bb))
 
 
 def _subset(tree: dict, keys) -> dict:
@@ -119,10 +146,9 @@ def compute_features(plan: FeatureCachePlan, params, model_state,
     the mesh) and return the activations as a host dataset with the same
     labels and ordering. Values are computed in `compute_dtype` (exactly
     what the uncached per-step forward would produce) and stored f32."""
-    prefix_params = _subset(params["backbone"],
-                            [k for k, _ in plan.prefix.children])
-    prefix_state = _subset(model_state.get("backbone", {}),
-                           [k for k, _ in plan.prefix.children])
+    prefix_keys = _param_keys(plan.prefix)
+    prefix_params = _subset(params["backbone"], prefix_keys)
+    prefix_state = _subset(model_state.get("backbone", {}), prefix_keys)
 
     def fwd(p, s, x):
         h, _ = plan.prefix.apply(p, s, x.astype(compute_dtype), train=False)

@@ -65,6 +65,74 @@ def test_plan_vgg_boundary_and_fallbacks():
     assert fc.plan_feature_cache(small, {}, 0, 8, 1) is None
 
 
+def test_mobilenet_split_composes_to_full():
+    """The splitter's prefix∘suffix must equal the full backbone forward
+    (residual adds live entirely inside units, so any unit edge works)."""
+    from idc_models_tpu.models.mobilenet import (
+        KERAS_LAYER_INDEX as MNV2_INDEX, mobilenet_v2_backbone,
+    )
+
+    bb = mobilenet_v2_backbone(3, bn_frozen_below=100)
+    v = bb.init(jax.random.key(0))
+    x = jnp.asarray(
+        np.random.default_rng(0).random((2, 50, 50, 3), np.float32))
+    full, _ = bb.apply(v.params, v.state, x, train=False)
+    split = bb.splitter(100)
+    assert split is not None
+    prefix, suffix = split
+    # fine_tune_at=100 lands inside block 11: prefix = stem + blocks 1-10
+    assert "block_10_project" in prefix.layer_names
+    assert "block_11_expand" in suffix.layer_names
+    assert all(MNV2_INDEX[n] < 100 for n in prefix.layer_names)
+    sub = lambda tree, names: {k: tree[k] for k in names if k in tree}
+    h, _ = prefix.apply(sub(v.params, prefix.layer_names),
+                        sub(v.state, prefix.layer_names), x, train=False)
+    out, _ = suffix.apply(sub(v.params, suffix.layer_names),
+                          sub(v.state, suffix.layer_names), h, train=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+    # boundary below everything -> no frozen prefix -> no split
+    assert bb.splitter(0) is None
+
+
+def test_mobilenet_plan(devices):
+    from idc_models_tpu.models.mobilenet import (
+        KERAS_LAYER_INDEX as MNV2_INDEX, mobilenet_v2,
+    )
+
+    model = mobilenet_v2(1, bn_frozen_below=100)
+    plan = fc.plan_feature_cache(model, MNV2_INDEX, 100, 1280, 1)
+    assert plan is not None and plan.boundary == "block_11_expand"
+    assert "Conv_1" in plan.suffix_keys
+
+
+def test_two_phase_cached_matches_uncached_mobilenet(devices):
+    """BN-bearing backbone: frozen-prefix BN runs in inference mode, so
+    the cache is exact there too; live-suffix BN batch stats see the
+    same batches either way."""
+    mesh = meshlib.data_mesh(8)
+    imgs, labels = synthetic.make_idc_like(40, size=50, seed=0)
+    train = ArrayDataset(imgs[:24], labels[:24])
+    val = ArrayDataset(imgs[24:], labels[24:])
+    kw = dict(lr=1e-4, epochs=1, fine_tune_epochs=1, batch_size=8,
+              eval_steps=1, seed=0)
+
+    r_plain = two_phase_fit("mobilenet_v2", 1, train, val, mesh,
+                            TwoPhaseConfig(**kw))
+    r_cached = two_phase_fit("mobilenet_v2", 1, train, val, mesh,
+                             TwoPhaseConfig(cache_features=True, **kw))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        jax.device_get(r_plain.state.params),
+        jax.device_get(r_cached.state.params))
+    # BN moving stats of the live suffix must track identically too
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        jax.device_get(r_plain.state.model_state),
+        jax.device_get(r_cached.state.model_state))
+
+
 def test_two_phase_cached_matches_uncached(devices):
     """The headline guarantee: phase 2 on cached features reproduces the
     uncached phase-2 training trajectory (same seeds, no rng consumers in
