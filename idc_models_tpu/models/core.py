@@ -391,6 +391,71 @@ def split_sequential(seq: Module, at_key: str) -> tuple[Module, Module]:
             subsequence(seq, keys[i:], name=f"{seq.name}[{at_key}:]"))
 
 
+def unit_backbone(units: Sequence[tuple[list[str], Callable]],
+                  modules: dict[str, Module], name: str,
+                  layer_index: dict[str, int]) -> Module:
+    """Compose a backbone from topology *units* over a FLAT param/state
+    namespace (Keras layer names), with a fine-tune splitter at unit
+    granularity.
+
+    `units` is a list of (param_names, apply_fn) where `apply_fn(run, h)`
+    threads the activation through the unit's layers via
+    `run(layer_name, h)`. A unit must be a pure function of its input
+    activation — residual adds / dense concats live entirely inside one
+    unit — so every unit edge is a valid frozen-prefix cache point. The
+    returned Module's `splitter(fine_tune_at)` cuts at the first unit
+    containing a layer with Keras index >= fine_tune_at (indices are
+    monotone in creation order, so everything before it is frozen).
+    """
+
+    def section(lo: int, hi: int, sec_name: str, splitter=None) -> Module:
+        names = [n for ns, _ in units[lo:hi] for n in ns]
+
+        def init(rng):
+            rngs = _split(rng, len(names))
+            params, state = {}, {}
+            for n, r in zip(names, rngs):
+                v = modules[n].init(r)
+                if v.params:
+                    params[n] = v.params
+                if v.state:
+                    state[n] = v.state
+            return Variables(params, state)
+
+        def apply(params, state, x, *, train=False, rng=None):
+            new_state = dict(state)
+
+            def run(n, h):
+                y, s2 = modules[n].apply(params.get(n, {}),
+                                         state.get(n, {}), h,
+                                         train=train, rng=None)
+                if n in state:
+                    new_state[n] = s2
+                return y
+
+            for _, unit_fn in units[lo:hi]:
+                x = unit_fn(run, x)
+            return x, new_state
+
+        return Module(init, apply, sec_name, layer_names=tuple(names),
+                      splitter=splitter)
+
+    def boundary_unit(fine_tune_at: int):
+        for k, (names, _) in enumerate(units):
+            if any(layer_index[n] >= fine_tune_at for n in names):
+                return k if k > 0 else None
+        return len(units)  # nothing live: cache everything
+
+    def split(fine_tune_at: int):
+        k = boundary_unit(fine_tune_at)
+        if k is None:
+            return None
+        return (section(0, k, f"{name}[:{k}]"),
+                section(k, len(units), f"{name}[{k}:]"))
+
+    return section(0, len(units), name, splitter=split)
+
+
 def classifier(backbone: Module, feature_dim: int, num_outputs: int,
                name: str | None = None) -> Module:
     """Backbone + GlobalAveragePooling + Dense head — the model shape every

@@ -77,92 +77,90 @@ FREEZE_ALL = 10**9
 def densenet201_backbone(in_channels: int = 3, *,
                          bn_frozen_below: int = 0) -> core.Module:
     """`bn_frozen_below`: BN layers with Keras index < this run in
-    permanent inference mode (Keras trainable=False semantics)."""
+    permanent inference mode (Keras trainable=False semantics).
+
+    Built as topology units (stem, one unit per dense layer, one per
+    transition, final BN) over the flat Keras-layer-name params: a dense
+    layer is `h -> concat(h, f(h))` — a pure function of its input — so
+    every unit edge is a valid split point for the frozen-backbone
+    feature cache despite the dense-concat topology.
+    """
     specs: list[tuple[str, core.Module]] = []
 
-    def add(m):
+    def reg(m) -> str:
         specs.append((m.name, m))
+        return m.name
 
     def bn(c, name):
         frozen = KERAS_LAYER_INDEX[name] < bn_frozen_below
         return core.batch_norm(c, name=name, frozen=frozen, **_BN)
 
+    units: list[tuple[list[str], object]] = []
+
     # Keras stem: ZeroPadding2D((3,3)) + valid 7x7/2 conv, then
     # ZeroPadding2D((1,1)) + valid 3x3/2 pool — symmetric padding, which
     # lax SAME (lo<=hi asymmetric) would shift by one pixel.
-    add(core.conv2d(in_channels, 64, 7, stride=2, use_bias=False,
-                    padding=((3, 3), (3, 3)), name="conv1_conv"))
-    add(bn(64, "conv1_bn"))
+    stem_names = [
+        reg(core.conv2d(in_channels, 64, 7, stride=2, use_bias=False,
+                        padding=((3, 3), (3, 3)), name="conv1_conv")),
+        reg(bn(64, "conv1_bn")),
+    ]
+
+    def stem(run, x):
+        h = jax.nn.relu(run("conv1_bn", run("conv1_conv", x)))
+        return jax.lax.reduce_window(h, -jnp.inf, jax.lax.max,
+                                     (1, 3, 3, 1), (1, 2, 2, 1),
+                                     [(0, 0), (1, 1), (1, 1), (0, 0)])
+
+    units.append((stem_names, stem))
+
     c = 64
-    stages = []
     for stage, n_layers in enumerate(_BLOCKS, start=2):
         for l in range(1, n_layers + 1):
             p = f"conv{stage}_block{l}"
-            add(bn(c + (l - 1) * _GROWTH, f"{p}_0_bn"))
-            add(core.conv2d(c + (l - 1) * _GROWTH, 4 * _GROWTH, 1,
-                            use_bias=False, name=f"{p}_1_conv"))
-            add(bn(4 * _GROWTH, f"{p}_1_bn"))
-            add(core.conv2d(4 * _GROWTH, _GROWTH, 3, use_bias=False,
-                            name=f"{p}_2_conv"))
-        c = c + n_layers * _GROWTH
-        if stage < 5:
-            add(bn(c, f"pool{stage}_bn"))
-            add(core.conv2d(c, c // 2, 1, use_bias=False,
-                            name=f"pool{stage}_conv"))
-            c = c // 2
-        stages.append((stage, n_layers))
-    add(bn(c, "bn"))
-    modules = dict(specs)
-    out_channels = c  # 1920
+            names = [
+                reg(bn(c + (l - 1) * _GROWTH, f"{p}_0_bn")),
+                reg(core.conv2d(c + (l - 1) * _GROWTH, 4 * _GROWTH, 1,
+                                use_bias=False, name=f"{p}_1_conv")),
+                reg(bn(4 * _GROWTH, f"{p}_1_bn")),
+                reg(core.conv2d(4 * _GROWTH, _GROWTH, 3, use_bias=False,
+                                name=f"{p}_2_conv")),
+            ]
 
-    def init(rng):
-        rngs = jax.random.split(rng, len(specs))
-        params, state = {}, {}
-        for (name, m), r in zip(specs, rngs):
-            v = m.init(r)
-            if v.params:
-                params[name] = v.params
-            if v.state:
-                state[name] = v.state
-        return core.Variables(params, state)
-
-    def apply(params, state, x, *, train=False, rng=None):
-        new_state = dict(state)
-
-        def run(name, h):
-            m = modules[name]
-            y, s2 = m.apply(params.get(name, {}), state.get(name, {}), h,
-                            train=train, rng=None)
-            if name in state:
-                new_state[name] = s2
-            return y
-
-        h = run("conv1_conv", x)
-        h = jax.nn.relu(run("conv1_bn", h))
-        h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max,
-                                  (1, 3, 3, 1), (1, 2, 2, 1),
-                                  [(0, 0), (1, 1), (1, 1), (0, 0)])
-        for stage, n_layers in stages:
-            for l in range(1, n_layers + 1):
-                p = f"conv{stage}_block{l}"
+            def dense_layer(run, h, *, p=p):
                 y = jax.nn.relu(run(f"{p}_0_bn", h))
                 y = run(f"{p}_1_conv", y)
                 y = jax.nn.relu(run(f"{p}_1_bn", y))
                 y = run(f"{p}_2_conv", y)
-                h = jnp.concatenate([h, y], axis=-1)
-            if stage < 5:
+                return jnp.concatenate([h, y], axis=-1)
+
+            units.append((names, dense_layer))
+        c = c + n_layers * _GROWTH
+        if stage < 5:
+            names = [
+                reg(bn(c, f"pool{stage}_bn")),
+                reg(core.conv2d(c, c // 2, 1, use_bias=False,
+                                name=f"pool{stage}_conv")),
+            ]
+
+            def transition(run, h, *, stage=stage):
                 h = jax.nn.relu(run(f"pool{stage}_bn", h))
                 h = run(f"pool{stage}_conv", h)
-                h = jax.lax.reduce_window(h, 0.0, jax.lax.add,
-                                          (1, 2, 2, 1), (1, 2, 2, 1),
-                                          "VALID") / 4.0
-        h = jax.nn.relu(run("bn", h))
-        return h, new_state
+                return jax.lax.reduce_window(h, 0.0, jax.lax.add,
+                                             (1, 2, 2, 1), (1, 2, 2, 1),
+                                             "VALID") / 4.0
+
+            units.append((names, transition))
+            c = c // 2
+    units.append(([reg(bn(c, "bn"))],
+                  lambda run, h: jax.nn.relu(run("bn", h))))
 
     # layer_names in Keras creation order (see mobilenet.py) so secure
     # percent-selection keeps get_weights() order for this backbone
-    return core.Module(init, apply, "densenet201",
-                       layer_names=tuple(KERAS_LAYER_INDEX))
+    sec = core.unit_backbone(units, dict(specs), "densenet201",
+                             KERAS_LAYER_INDEX)
+    assert sec.layer_names == tuple(KERAS_LAYER_INDEX)
+    return sec
 
 
 DENSENET201_FEATURES = 1920

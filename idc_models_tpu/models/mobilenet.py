@@ -166,41 +166,6 @@ def _units(in_channels: int, bn_frozen_below: int):
     return units, dict(specs)
 
 
-def _section(units, modules, lo: int, hi: int, name: str,
-             splitter=None) -> core.Module:
-    """A Module running units [lo, hi); params/state are the flat
-    Keras-layer-name dicts restricted to those units' layers."""
-    names = [n for ns, _ in units[lo:hi] for n in ns]
-
-    def init(rng):
-        rngs = jax.random.split(rng, len(names))
-        params, state = {}, {}
-        for n, r in zip(names, rngs):
-            v = modules[n].init(r)
-            if v.params:
-                params[n] = v.params
-            if v.state:
-                state[n] = v.state
-        return core.Variables(params, state)
-
-    def apply(params, state, x, *, train=False, rng=None):
-        new_state = dict(state)
-
-        def run(n, h):
-            y, s2 = modules[n].apply(params.get(n, {}), state.get(n, {}),
-                                     h, train=train, rng=None)
-            if n in state:
-                new_state[n] = s2
-            return y
-
-        for _, unit_fn in units[lo:hi]:
-            x = unit_fn(run, x)
-        return x, new_state
-
-    return core.Module(init, apply, name, layer_names=tuple(names),
-                       splitter=splitter)
-
-
 def mobilenet_v2_backbone(in_channels: int = 3, *,
                           bn_frozen_below: int = 0) -> core.Module:
     """Returns the backbone module; params keyed by Keras layer names.
@@ -216,33 +181,13 @@ def mobilenet_v2_backbone(in_channels: int = 3, *,
     earlier layer has Keras index < fine_tune_at.
     """
     units, modules = _units(in_channels, bn_frozen_below)
-
-    def split(fine_tune_at: int):
-        k = _boundary_unit(units, fine_tune_at)
-        if k is None:
-            return None
-        return (_section(units, modules, 0, k, f"mobilenet_v2[:{k}]"),
-                _section(units, modules, k, len(units),
-                         f"mobilenet_v2[{k}:]"))
-
     # layer_names in Keras creation order (_build_index inserts names in
     # ascending Keras-index order) so secure percent-selection follows
     # get_weights() order for this backbone too (secure_fed_model.py:115-121)
-    sec = _section(units, modules, 0, len(units), "mobilenet_v2",
-                   splitter=split)
+    sec = core.unit_backbone(units, modules, "mobilenet_v2",
+                             KERAS_LAYER_INDEX)
     assert sec.layer_names == tuple(KERAS_LAYER_INDEX)
     return sec
-
-
-def _boundary_unit(units, fine_tune_at: int):
-    """First unit containing a layer with Keras index >= fine_tune_at;
-    None when that is unit 0 (no frozen prefix to cache). Keras indices
-    are monotone in creation order, so every unit before the boundary is
-    fully frozen."""
-    for k, (names, _) in enumerate(units):
-        if any(KERAS_LAYER_INDEX[n] >= fine_tune_at for n in names):
-            return k if k > 0 else None
-    return len(units)  # nothing live: cache everything
 
 
 def mobilenet_v2(num_outputs: int = 1, in_channels: int = 3, *,

@@ -20,11 +20,12 @@ the cached and uncached phase-2 training trajectories against each other.
 
 Splitting strategies: sequential backbones (VGG16) split at the first
 live layer via `core.split_sequential`; non-sequential topologies
-provide a model `splitter` (MobileNetV2 splits at inverted-residual
-unit edges — every unit is a pure function of its input, so the
-residual adds stay whole). `plan_feature_cache` returns None for models
-it cannot split (DenseNet201's dense-concat backbone, small_cnn) and
-callers fall back to the uncached path.
+provide a model `splitter` built on `core.unit_backbone` (MobileNetV2
+splits at inverted-residual unit edges, DenseNet201 at dense-layer /
+transition edges — every unit is a pure function of its input, so
+residual adds and dense concats stay whole). `plan_feature_cache`
+returns None for models it cannot split (small_cnn) and callers fall
+back to the uncached path.
 """
 
 from __future__ import annotations

@@ -65,6 +65,26 @@ def test_plan_vgg_boundary_and_fallbacks():
     assert fc.plan_feature_cache(small, {}, 0, 8, 1) is None
 
 
+def _assert_split_composes(bb, fine_tune_at, layer_index, image_size):
+    """Shared check for unit splitters: prefix∘suffix == full forward,
+    prefix fully frozen. Returns (prefix, suffix) for extra assertions."""
+    v = bb.init(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(0).random(
+        (2, image_size, image_size, 3), np.float32))
+    full, _ = bb.apply(v.params, v.state, x, train=False)
+    split = bb.splitter(fine_tune_at)
+    assert split is not None
+    prefix, suffix = split
+    assert all(layer_index[n] < fine_tune_at for n in prefix.layer_names)
+    sub = lambda tree, names: {k: tree[k] for k in names if k in tree}
+    h, _ = prefix.apply(sub(v.params, prefix.layer_names),
+                        sub(v.state, prefix.layer_names), x, train=False)
+    out, _ = suffix.apply(sub(v.params, suffix.layer_names),
+                          sub(v.state, suffix.layer_names), h, train=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+    return prefix, suffix
+
+
 def test_mobilenet_split_composes_to_full():
     """The splitter's prefix∘suffix must equal the full backbone forward
     (residual adds live entirely inside units, so any unit edge works)."""
@@ -73,23 +93,10 @@ def test_mobilenet_split_composes_to_full():
     )
 
     bb = mobilenet_v2_backbone(3, bn_frozen_below=100)
-    v = bb.init(jax.random.key(0))
-    x = jnp.asarray(
-        np.random.default_rng(0).random((2, 50, 50, 3), np.float32))
-    full, _ = bb.apply(v.params, v.state, x, train=False)
-    split = bb.splitter(100)
-    assert split is not None
-    prefix, suffix = split
+    prefix, suffix = _assert_split_composes(bb, 100, MNV2_INDEX, 50)
     # fine_tune_at=100 lands inside block 11: prefix = stem + blocks 1-10
     assert "block_10_project" in prefix.layer_names
     assert "block_11_expand" in suffix.layer_names
-    assert all(MNV2_INDEX[n] < 100 for n in prefix.layer_names)
-    sub = lambda tree, names: {k: tree[k] for k in names if k in tree}
-    h, _ = prefix.apply(sub(v.params, prefix.layer_names),
-                        sub(v.state, prefix.layer_names), x, train=False)
-    out, _ = suffix.apply(sub(v.params, suffix.layer_names),
-                          sub(v.state, suffix.layer_names), h, train=False)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
     # boundary below everything -> no frozen prefix -> no split
     assert bb.splitter(0) is None
 
@@ -126,6 +133,45 @@ def test_two_phase_cached_matches_uncached_mobilenet(devices):
         jax.device_get(r_plain.state.params),
         jax.device_get(r_cached.state.params))
     # BN moving stats of the live suffix must track identically too
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        jax.device_get(r_plain.state.model_state),
+        jax.device_get(r_cached.state.model_state))
+
+
+def test_densenet_split_composes_to_full():
+    """Dense-concat topology: a dense layer is h -> concat(h, f(h)), so
+    unit edges are valid split points; ft=150 lands inside conv4_block2."""
+    from idc_models_tpu.models.densenet import (
+        KERAS_LAYER_INDEX as DN_INDEX, densenet201_backbone,
+    )
+
+    bb = densenet201_backbone(3, bn_frozen_below=150)
+    _, suffix = _assert_split_composes(bb, 150, DN_INDEX, 32)
+    assert "conv4_block2_1_conv" in suffix.layer_names
+
+
+def test_two_phase_cached_matches_uncached_densenet(devices):
+    """Phase 2 only (epochs=0 skips phase 1 to keep this test fast):
+    cached and uncached fine-tuning of DenseNet201 must coincide."""
+    mesh = meshlib.data_mesh(8)
+    imgs, labels = synthetic.make_idc_like(24, size=32, seed=0)
+    labels = (np.arange(24) % 10).astype(np.int32)
+    train = ArrayDataset(imgs[:16], labels[:16])
+    val = ArrayDataset(imgs[16:], labels[16:])
+    kw = dict(lr=1e-4, epochs=0, fine_tune_epochs=1, batch_size=8,
+              eval_steps=1, seed=0)
+
+    r_plain = two_phase_fit("densenet201", 10, train, val, mesh,
+                            TwoPhaseConfig(**kw))
+    r_cached = two_phase_fit("densenet201", 10, train, val, mesh,
+                             TwoPhaseConfig(cache_features=True, **kw))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        jax.device_get(r_plain.state.params),
+        jax.device_get(r_cached.state.params))
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
