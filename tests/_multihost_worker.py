@@ -62,9 +62,12 @@ def main() -> int:
         key, sub = jax.random.split(key)
         state, m = step(state, x, y, sub)
 
+    def param_digest(params):
+        return float(jnp.sum(jax.tree.leaves(params)[0]
+                             .astype(jnp.float32)))
+
     loss = float(m["loss"])
-    digest = float(jnp.sum(jax.tree.leaves(state.params)[0]
-                           .astype(jnp.float32)))
+    digest = param_digest(state.params)
     assert np.isfinite(loss)
 
     # Evaluator across the process boundary: its eval step's logits are
@@ -97,8 +100,20 @@ def main() -> int:
         server, fm = round_fn(server, ci, cl, w,
                               jax.random.fold_in(jax.random.key(5), r))
     fed_loss = float(fm["loss"])
-    fed_digest = float(jnp.sum(jax.tree.leaves(server.params)[0]
-                               .astype(jnp.float32)))
+    fed_digest = param_digest(server.params)
+
+    # Secure-aggregation round across processes: pairwise masks are
+    # generated per-device from the global client index, and the masked
+    # psum must cancel them across the DCN boundary exactly.
+    from idc_models_tpu.secure import make_secure_fedavg_round
+
+    sserver = replicate(cmesh, initialize_server(model, jax.random.key(2)))
+    sround = make_secure_fedavg_round(model, opt, binary_cross_entropy,
+                                      cmesh, percent=0.5, local_epochs=1,
+                                      batch_size=8)
+    sserver, sm = sround(sserver, ci, cl, jax.random.key(7))
+    sec_loss = float(sm["loss"])
+    sec_digest = param_digest(sserver.params)
 
     # Checkpointed fit across processes: orbax save is a collective, so
     # this hangs (not just fails) if any process skips it. The dir is
@@ -121,6 +136,7 @@ def main() -> int:
     print(f"RESULT proc={proc_id} loss={loss:.8f} digest={digest:.8f} "
           f"eval_loss={em['loss']:.8f} eval_auroc={em['auroc']:.8f} "
           f"fed_loss={fed_loss:.8f} fed_digest={fed_digest:.8f} "
+          f"sec_loss={sec_loss:.8f} sec_digest={sec_digest:.8f} "
           f"ckpt_loss={ckpt_loss:.8f}",
           flush=True)
     return 0

@@ -51,11 +51,13 @@ def test_two_process_dp_step_agrees(tmp_path):
         m = re.search(r"RESULT proc=(\d+) loss=([-\d.]+) digest=([-\d.]+) "
                       r"eval_loss=([-\d.]+) eval_auroc=([-\d.]+) "
                       r"fed_loss=([-\d.]+) fed_digest=([-\d.]+) "
+                      r"sec_loss=([-\d.]+) sec_digest=([-\d.]+) "
                       r"ckpt_loss=([-\d.]+)", out)
         assert m, out
         results[int(m.group(1))] = m.groups()[1:]
     assert set(results) == {0, 1}
-    # the DP allreduce, the eval logits gather, the FedAvg round
-    # boundary, and the collective checkpoint save all spanned processes:
-    # both hosts hold identical state and computed identical metrics
+    # the DP allreduce, the eval logits gather, the FedAvg and
+    # secure-aggregation round boundaries, and the collective checkpoint
+    # save all spanned processes: both hosts hold identical state and
+    # computed identical metrics
     assert results[0] == results[1], results
