@@ -207,6 +207,27 @@ def test_load_or_train_gate(devices, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_profile_trace_writes_tensorboard_artifact(devices, tmp_path):
+    """§5 tracing: profile_trace must actually produce a TensorBoard-
+    viewable trace directory around device work (and no-op on None)."""
+    from idc_models_tpu.observe import profile_trace
+
+    with profile_trace(None):
+        pass  # unconditional call-site contract
+    mesh = meshlib.data_mesh(8)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    ds = _data(64)
+    logdir = tmp_path / "trace"
+    with profile_trace(str(logdir)):
+        fit(model, opt, binary_cross_entropy, state, ds, None, mesh,
+            epochs=1, batch_size=32, verbose=False)
+    traces = list(logdir.rglob("*.trace.json.gz")) + \
+        list(logdir.rglob("*.xplane.pb"))
+    assert traces, f"no trace artifacts under {logdir}"
+
+
 def test_timer_prints_reference_format(capsys):
     with Timer("Pre-training for 10 epochs") as t:
         pass
