@@ -91,6 +91,11 @@ def _parse(argv):
                         help="checkpoint the training loop after every "
                              "epoch under <path>/dist_ckpt and resume "
                              "from there on restart (requires --path)")
+        sp.add_argument("--stream", action="store_true",
+                        help="decode training batches from disk on the "
+                             "fly (datasets larger than host RAM) "
+                             "instead of materializing the train split; "
+                             "needs a real --data-dir IDC tree")
 
     sp = sub.add_parser("fed", help="federated averaging (FedAvg)")
     common(sp)
@@ -150,16 +155,21 @@ def _logger(ns):
     return JsonlLogger(Path(ns.path) / "logs" / "run.jsonl")
 
 
-def _load_idc(ns, image_size, limit):
-    """--data-dir > <path>/data/balanced_IDC_30k > synthetic."""
-    from idc_models_tpu.data import synthetic
-    from idc_models_tpu.data.idc import ArrayDataset, load_directory
-
+def _data_root(ns):
+    """--data-dir > <path>/data/balanced_IDC_30k > None (synthetic)."""
     root = ns.data_dir
     if root is None and ns.path is not None:
         cand = Path(ns.path) / "data" / "balanced_IDC_30k"
         if cand.exists():
             root = cand
+    return root
+
+
+def _load_idc(ns, image_size, limit):
+    from idc_models_tpu.data import synthetic
+    from idc_models_tpu.data.idc import ArrayDataset, load_directory
+
+    root = _data_root(ns)
     if root is not None:
         return load_directory(root, image_size=image_size, limit=limit,
                               seed=ns.seed)
@@ -169,6 +179,39 @@ def _load_idc(ns, image_size, limit):
     imgs, labels = synthetic.make_idc_like(ns.synthetic_examples,
                                            size=image_size, seed=ns.seed)
     return ArrayDataset(imgs, labels)
+
+
+def _streamed_idc_splits(ns, preset, global_batch):
+    """80/10/10 split at the FILE level: train as a FileStream (decoded
+    per batch), val/test materialized (they are small and eval needs
+    ArrayDatasets)."""
+    import numpy as np
+
+    from idc_models_tpu.data.idc import (
+        ArrayDataset, decode_pairs, list_shuffled_pairs,
+    )
+    from idc_models_tpu.data.pipeline import FileStream
+
+    root = _data_root(ns)
+    if root is None:
+        return None
+    pairs = list_shuffled_pairs(root, seed=ns.seed,
+                                limit=preset.dataset_limit)
+    n = len(pairs)
+    n_tr, n_va = int(0.8 * n), int(0.1 * n)
+    if n_tr < global_batch or n_va == 0 or n - n_tr - n_va == 0:
+        sys.exit(f"--stream: {n} files are too few for an 80/10/10 split "
+                 f"at global batch {global_batch}")
+    train = FileStream(pairs[:n_tr], preset.image_size, global_batch,
+                       seed=ns.seed, repeat=preset.repeats)
+
+    def materialize(subset):
+        labels = np.asarray([l for _, l in subset], np.int32)
+        return ArrayDataset(decode_pairs(subset, preset.image_size), labels)
+
+    val = materialize(pairs[n_tr:n_tr + n_va])
+    test = materialize(pairs[n_tr + n_va:])
+    return train, val, test
 
 
 def _run_convert(ns):
@@ -244,7 +287,18 @@ def _run_dist(ns):
     # Synthetic fallback must yield at least one full global batch after
     # the train split, or the Loader rightly refuses to run.
     ns.synthetic_examples = max(ns.synthetic_examples, 2 * global_batch)
-    if preset.dataset == "cifar10":
+    streamed = None
+    if ns.stream:
+        if preset.dataset != "idc":
+            sys.exit("--stream needs an IDC directory preset (vgg/mobile)")
+        streamed = _streamed_idc_splits(ns, preset, global_batch)
+        if streamed is None:
+            print("[idc_models_tpu] --stream: no real data dir found; "
+                  "falling back to the materialized synthetic path",
+                  file=sys.stderr)
+    if streamed is not None:
+        train, val, test = streamed
+    elif preset.dataset == "cifar10":
         ds = load_cifar10(ns.path, split="train",
                           synthetic_size=ns.synthetic_examples, seed=ns.seed)
         test = load_cifar10(ns.path, split="test",

@@ -112,30 +112,54 @@ def load_directory(root: str | os.PathLike, *, image_size: int = 50,
     `backend`: "native" (C++/libpng threaded decoder), "pil" (Python
     thread pool), or "auto" (native when buildable, else pil).
     """
+    pairs = list_shuffled_pairs(root, seed=seed, limit=limit)
+    labels = np.asarray([l for _, l in pairs], np.int32)
+    return ArrayDataset(decode_pairs(pairs, image_size, workers=workers,
+                                     backend=backend), labels)
+
+
+def list_shuffled_pairs(root: str | os.PathLike, *, seed: int = 0,
+                        limit: int | None = None) -> list[tuple[str, int]]:
+    """The loaders' shared preamble: list the labeled tree, shuffle once
+    with `seed`, apply the optional subset `limit`."""
     pairs = list_labeled_files(root)
     if not pairs:
         raise FileNotFoundError(f"no <label>/*.png files under {root}")
-    rng = np.random.default_rng(seed)
-    order = rng.permutation(len(pairs))
+    order = np.random.default_rng(seed).permutation(len(pairs))
     pairs = [pairs[i] for i in order]
-    if limit is not None:
-        pairs = pairs[:limit]
-    labels = np.asarray([l for _, l in pairs], np.int32)
+    return pairs[:limit] if limit is not None else pairs
 
+
+def decode_pairs(pairs: list[tuple[str, int]], image_size: int, *,
+                 workers: int = 16, backend: str = "auto",
+                 pool=None) -> np.ndarray:
+    """Decode (path, label) pairs to a float32 [n, s, s, 3] batch.
+
+    The one decode entry point shared by the materializing loader and
+    the streaming loader (`pipeline.FileStream`); `backend` as in
+    `load_directory`. `pool` (a zero-arg callable returning a live
+    executor) lets per-batch callers amortize thread-pool creation on
+    the PIL fallback path.
+    """
     if backend not in ("auto", "native", "pil"):
         raise ValueError(f"backend must be auto|native|pil, got {backend!r}")
+    if not pairs:
+        return np.zeros((0, image_size, image_size, 3), np.float32)
     if backend in ("auto", "native"):
         from idc_models_tpu.data import native
 
         if native.available():
-            images = native.decode_batch([p for p, _ in pairs], image_size,
-                                         threads=workers)
-            return ArrayDataset(images, labels)
+            return native.decode_batch([p for p, _ in pairs], image_size,
+                                       threads=workers)
         if backend == "native":
             raise RuntimeError(native.build_error())
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        imgs = list(pool.map(lambda p: _decode_one(p[0], image_size), pairs))
-    return ArrayDataset(np.stack(imgs), labels)
+    job = lambda p: _decode_one(p[0], image_size)
+    if pool is not None:
+        imgs = list(pool().map(job, pairs))
+    else:
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            imgs = list(ex.map(job, pairs))
+    return np.stack(imgs)
 
 
 def train_val_test_split(ds: ArrayDataset,

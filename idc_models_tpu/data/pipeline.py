@@ -23,8 +23,10 @@ from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.data.idc import ArrayDataset
 
 
-class Loader:
-    """Iterates (images, labels) numpy batches over epochs.
+class _EpochSchedule:
+    """The shared batching/shuffle/repeat schedule — the seeding contract
+    ((seed, epoch) for pass 0, (seed, epoch, rep) for extra passes) lives
+    only here, so `Loader` and `FileStream` stay bit-identical.
 
     - `shuffle`: new seeded permutation each epoch (epoch mixed into seed)
     - `drop_remainder`: required under data parallelism so every step's
@@ -35,34 +37,54 @@ class Loader:
       (dist_model_tf_dense.py:122-123), so each fit "epoch" sees the
       train set twice; with shuffle on, every pass gets a fresh
       permutation (tf.data reshuffles each iteration)
+
+    Subclasses define `_num_examples()` and `_gather(idx) -> batch`.
     """
 
-    def __init__(self, ds: ArrayDataset, batch_size: int, *,
-                 shuffle: bool = True, seed: int = 0,
-                 drop_remainder: bool = True, repeat: int = 1):
+    def __init__(self, batch_size: int, *, shuffle: bool = True,
+                 seed: int = 0, drop_remainder: bool = True,
+                 repeat: int = 1):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
-        if len(ds) < batch_size and drop_remainder:
-            raise ValueError(
-                f"dataset of {len(ds)} examples yields zero batches of "
-                f"size {batch_size} with drop_remainder")
         if repeat < 1:
             raise ValueError(f"repeat must be >= 1, got {repeat}")
-        self.ds = ds
+        n = self._num_examples()
+        if n < batch_size and drop_remainder:
+            raise ValueError(
+                f"dataset of {n} examples yields zero batches of "
+                f"size {batch_size} with drop_remainder")
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
         self.drop_remainder = drop_remainder
         self.repeat = repeat
 
+    def _num_examples(self) -> int:
+        raise NotImplementedError
+
+    def _gather(self, idx: np.ndarray):
+        raise NotImplementedError
+
+    def replace(self, **kw) -> "_EpochSchedule":
+        """A copy with schedule knobs replaced (seed/repeat/...); used by
+        `fit` to impose its per-phase schedule on caller-built loaders."""
+        import copy
+
+        new = copy.copy(self)
+        for k, v in kw.items():
+            if not hasattr(new, k):
+                raise AttributeError(f"{type(self).__name__} has no {k!r}")
+            setattr(new, k, v)
+        return new
+
     def __len__(self) -> int:
-        n = len(self.ds)
+        n = self._num_examples()
         per_pass = (n // self.batch_size if self.drop_remainder
                     else -(-n // self.batch_size))
         return per_pass * self.repeat
 
-    def epoch(self, epoch: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        n = len(self.ds)
+    def epoch(self, epoch: int = 0) -> Iterator:
+        n = self._num_examples()
         stop = (n // self.batch_size * self.batch_size
                 if self.drop_remainder else n)
         for rep in range(self.repeat):
@@ -74,11 +96,75 @@ class Loader:
             else:
                 order = np.arange(n)
             for i in range(0, stop, self.batch_size):
-                idx = order[i:i + self.batch_size]
-                yield self.ds.images[idx], self.ds.labels[idx]
+                yield self._gather(order[i:i + self.batch_size])
 
     def __iter__(self):
         return self.epoch(0)
+
+
+class Loader(_EpochSchedule):
+    """Iterates (images, labels) numpy batches of a materialized
+    ArrayDataset over epochs (see _EpochSchedule for the knobs)."""
+
+    def __init__(self, ds: ArrayDataset, batch_size: int, **kw):
+        self.ds = ds
+        super().__init__(batch_size, **kw)
+
+    def _num_examples(self) -> int:
+        return len(self.ds)
+
+    def _gather(self, idx):
+        return self.ds.images[idx], self.ds.labels[idx]
+
+
+class FileStream(_EpochSchedule):
+    """Loader-shaped iterator that decodes image files per batch instead
+    of materializing the dataset in host RAM.
+
+    The scale path for C1/C2: `ArrayDataset` + `Loader` is the
+    reference's `cache()` (entire dataset resident, fastest for the
+    preset-sized subsets); `FileStream` is its streaming tf.data shape
+    for directories that do not fit in memory — per-epoch seeded
+    permutation of the FILE list, batches decoded on demand (native
+    C++/libpng decoder when available, one persistent thread pool on the
+    PIL fallback). Under `prefetch_to_mesh` the decode runs in the
+    producer thread, overlapping device compute.
+
+    Shares `Loader`'s schedule (`_EpochSchedule`) bit-for-bit: streaming
+    a directory and training on its materialized ArrayDataset (same pair
+    order) produce identical batch streams.
+    """
+
+    def __init__(self, pairs: list[tuple[str, int]], image_size: int,
+                 batch_size: int, *, workers: int = 16,
+                 backend: str = "auto", **kw):
+        if not pairs:
+            raise ValueError("FileStream needs a non-empty file list")
+        self.pairs = list(pairs)
+        self.image_size = image_size
+        self.workers = workers
+        self.backend = backend
+        self._pool = None  # lazy persistent pool for the PIL path
+        super().__init__(batch_size, **kw)
+
+    def _num_examples(self) -> int:
+        return len(self.pairs)
+
+    def _gather(self, idx):
+        from idc_models_tpu.data.idc import decode_pairs
+
+        batch = [self.pairs[j] for j in idx]
+        labels = np.asarray([l for _, l in batch], np.int32)
+        return decode_pairs(batch, self.image_size, workers=self.workers,
+                            backend=self.backend,
+                            pool=self._pil_pool), labels
+
+    def _pil_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=self.workers)
+        return self._pool
 
 
 def prefetch_to_mesh(batches: Iterator, mesh: Mesh, *, axis=meshlib.DATA_AXIS,

@@ -156,9 +156,16 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         state = replicate(mesh, state)
     # repeats>1 reproduces the reference CIFAR pipeline's `.repeat(2)`
     # (dist_model_tf_dense.py:122-123): each epoch passes over the train
-    # set `repeats` times, freshly shuffled per pass.
-    loader = Loader(train_ds, batch_size, shuffle=True, seed=seed,
-                    repeat=repeats)
+    # set `repeats` times, freshly shuffled per pass. A Loader-shaped
+    # stream (data.pipeline.FileStream) may be passed instead of an
+    # ArrayDataset; it keeps its batching/decode configuration but takes
+    # THIS fit's seed/repeat so the schedule (e.g. phase 2's seed+1)
+    # matches what the materialized path would use.
+    if isinstance(train_ds, ArrayDataset):
+        loader = Loader(train_ds, batch_size, shuffle=True, seed=seed,
+                        repeat=repeats)
+    else:
+        loader = train_ds.replace(seed=seed, repeat=repeats)
     evaluator = (Evaluator(model, loss_fn, mesh, batch_size=batch_size,
                            compute_dtype=compute_dtype)
                  if val_ds is not None else None)
@@ -167,7 +174,10 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
     start_epoch = initial_epoch
     fingerprint = None
     if checkpoint_dir is not None:
-        fingerprint = _fit_fingerprint(state, seed, batch_size, repeats,
+        # the loader's own knobs (== the fit args for ArrayDataset; the
+        # stream's configuration otherwise) identify the data schedule
+        fingerprint = _fit_fingerprint(state, loader.seed,
+                                       loader.batch_size, loader.repeat,
                                        initial_epoch)
         restored = _restore_fit_checkpoint(checkpoint_dir, state, epochs,
                                            fingerprint)
@@ -414,6 +424,11 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
 
     plan = None
     if config.cache_features:
+        if not isinstance(train_ds, ArrayDataset):
+            raise ValueError(
+                "cache_features needs a materialized ArrayDataset (the "
+                "cache runs the frozen prefix over the whole train set); "
+                "drop --stream or --cache-features")
         from idc_models_tpu.train import feature_cache as fc
 
         plan = fc.plan_feature_cache(model2, spec.layer_index or {},

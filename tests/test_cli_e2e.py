@@ -65,6 +65,25 @@ def test_cli_vgg_pretrained_weights(tmp_path, capsys):
     assert floor(base) != floor(warm)
 
 
+def test_cli_vgg_streamed(tmp_path, capsys):
+    """--stream decodes train batches from disk on the fly; val/test are
+    materialized from the same file-level split."""
+    from PIL import Image
+
+    data = tmp_path / "idc"
+    rng = np.random.default_rng(0)
+    for label in ("0", "1"):
+        d = data / label
+        d.mkdir(parents=True)
+        for i in range(40):
+            arr = (rng.random((50, 50, 3)) * 200).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"p{i}.png")
+    out = _run(["vgg", "--path", str(tmp_path), "--data-dir", str(data),
+                "--host-devices", "8", "--batch-size", "8", "--stream",
+                "--epochs", "1", "--fine-tune-epochs", "1"], capsys)
+    assert "epoch 2/2" in out and "test:" in out
+
+
 def test_cli_mobile(capsys):
     out = _run(["mobile", "--host-devices", "8", "--synthetic-examples",
                 "64", "--batch-size", "8", "--epochs", "1",
