@@ -35,14 +35,25 @@ def weighted_pmean(tree, weight, axis_name: str):
     secure server is an unweighted mean (quirk Q7, secure_fed_model.py:160-168);
     we expose the weighted form as the primitive and let callers pass
     weight=1 to recover the unweighted behavior.
+
+    Failure-tolerance semantics: negative weights are treated as 0, and
+    zero-weight members are excluded even if their values are non-finite
+    (a crashed/diverged client would otherwise poison the aggregate
+    through NaN * 0 == NaN). If EVERY member has weight 0 the result is
+    a zero tree, not NaN — callers that must distinguish "no
+    contributors" should check psum(weight) themselves (the FedAvg round
+    keeps its previous state in that case).
     """
-    weight = jnp.asarray(weight, jnp.float32)
+    weight = jnp.maximum(jnp.asarray(weight, jnp.float32), 0.0)
     total = lax.psum(weight, axis_name)
-    return jax.tree.map(
-        lambda x: lax.psum(x * weight.astype(x.dtype), axis_name)
-        / total.astype(x.dtype),
-        tree,
-    )
+    safe_total = jnp.maximum(total, jnp.float32(1e-30))
+
+    def contrib(x):
+        w = weight.astype(x.dtype)
+        masked = jnp.where(w > 0, x * w, jnp.zeros_like(x))
+        return lax.psum(masked, axis_name) / safe_total.astype(x.dtype)
+
+    return jax.tree.map(contrib, tree)
 
 
 def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = False):

@@ -44,20 +44,23 @@ class _EpochSchedule:
     def __init__(self, batch_size: int, *, shuffle: bool = True,
                  seed: int = 0, drop_remainder: bool = True,
                  repeat: int = 1):
-        if batch_size <= 0:
-            raise ValueError("batch_size must be positive")
-        if repeat < 1:
-            raise ValueError(f"repeat must be >= 1, got {repeat}")
-        n = self._num_examples()
-        if n < batch_size and drop_remainder:
-            raise ValueError(
-                f"dataset of {n} examples yields zero batches of "
-                f"size {batch_size} with drop_remainder")
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
         self.drop_remainder = drop_remainder
         self.repeat = repeat
+        self._validate()
+
+    def _validate(self) -> None:
+        if self.batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if self.repeat < 1:
+            raise ValueError(f"repeat must be >= 1, got {self.repeat}")
+        n = self._num_examples()
+        if n < self.batch_size and self.drop_remainder:
+            raise ValueError(
+                f"dataset of {n} examples yields zero batches of "
+                f"size {self.batch_size} with drop_remainder")
 
     def _num_examples(self) -> int:
         raise NotImplementedError
@@ -67,7 +70,9 @@ class _EpochSchedule:
 
     def replace(self, **kw) -> "_EpochSchedule":
         """A copy with schedule knobs replaced (seed/repeat/...); used by
-        `fit` to impose its per-phase schedule on caller-built loaders."""
+        `fit` to impose its per-phase schedule on caller-built loaders.
+        Re-runs the constructor validation, so a bad knob fails as loudly
+        here as at construction."""
         import copy
 
         new = copy.copy(self)
@@ -75,6 +80,7 @@ class _EpochSchedule:
             if not hasattr(new, k):
                 raise AttributeError(f"{type(self).__name__} has no {k!r}")
             setattr(new, k, v)
+        new._validate()
         return new
 
     def __len__(self) -> int:
@@ -144,7 +150,9 @@ class FileStream(_EpochSchedule):
         self.image_size = image_size
         self.workers = workers
         self.backend = backend
-        self._pool = None  # lazy persistent pool for the PIL path
+        # lazy persistent pool for the PIL path, boxed so replace()'s
+        # shallow copies share ONE pool instead of each leaking their own
+        self._pool_box: list = [None]
         super().__init__(batch_size, **kw)
 
     def _num_examples(self) -> int:
@@ -160,11 +168,20 @@ class FileStream(_EpochSchedule):
                             pool=self._pil_pool), labels
 
     def _pil_pool(self):
-        if self._pool is None:
+        if self._pool_box[0] is None:
             from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(max_workers=self.workers)
-        return self._pool
+            self._pool_box[0] = ThreadPoolExecutor(max_workers=self.workers)
+        return self._pool_box[0]
+
+    def close(self) -> None:
+        """Shut the decode pool down (no-op if never created). Copies
+        made by replace() share the same pool, so close the stream only
+        when no copy is iterating; without close() the single shared
+        pool simply lives until process exit."""
+        pool, self._pool_box[0] = self._pool_box[0], None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
 
 def prefetch_to_mesh(batches: Iterator, mesh: Mesh, *, axis=meshlib.DATA_AXIS,

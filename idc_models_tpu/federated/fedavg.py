@@ -189,6 +189,14 @@ def make_fedavg_round(
         metrics = collectives.weighted_pmean(
             {"loss": jnp.mean(losses), "accuracy": jnp.mean(accs)},
             weight, meshlib.CLIENT_AXIS)
+        # all clients dropped (total weight 0, e.g. every participant
+        # failed): keep the incoming global state instead of the
+        # degenerate zero aggregate
+        any_alive = collectives.psum(jnp.maximum(weight, 0.0),
+                                     meshlib.CLIENT_AXIS) > 0
+        agg = jax.tree.map(
+            lambda new, old: jnp.where(any_alive, new, old), agg,
+            {"params": params, "model_state": model_state})
         return agg["params"], agg["model_state"], metrics
 
     mapped = shard_map(

@@ -158,14 +158,15 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
     # (dist_model_tf_dense.py:122-123): each epoch passes over the train
     # set `repeats` times, freshly shuffled per pass. A Loader-shaped
     # stream (data.pipeline.FileStream) may be passed instead of an
-    # ArrayDataset; it keeps its batching/decode configuration but takes
-    # THIS fit's seed/repeat so the schedule (e.g. phase 2's seed+1)
-    # matches what the materialized path would use.
+    # ArrayDataset; it keeps its decode configuration but fit imposes
+    # the FULL schedule (batch/shuffle/seed/repeat) so both paths train
+    # identically for the same arguments (e.g. phase 2's seed+1).
     if isinstance(train_ds, ArrayDataset):
         loader = Loader(train_ds, batch_size, shuffle=True, seed=seed,
                         repeat=repeats)
     else:
-        loader = train_ds.replace(seed=seed, repeat=repeats)
+        loader = train_ds.replace(batch_size=batch_size, shuffle=True,
+                                  seed=seed, repeat=repeats)
     evaluator = (Evaluator(model, loss_fn, mesh, batch_size=batch_size,
                            compute_dtype=compute_dtype)
                  if val_ds is not None else None)

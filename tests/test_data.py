@@ -209,6 +209,16 @@ def test_filestream_matches_materialized_loader(png_tree):
     assert len(ys) == 6
     with pytest.raises(ValueError, match="non-empty"):
         pipeline.FileStream([], 50, 8)
+    # replace() re-validates, so fit's schedule path fails as loudly as
+    # the constructor would
+    with pytest.raises(ValueError, match="repeat"):
+        stream.replace(repeat=0)
+    with pytest.raises(ValueError, match="batch_size"):
+        stream.replace(batch_size=0)
+    with pytest.raises(AttributeError):
+        stream.replace(nope=1)
+    stream.close()  # idempotent even when the pool was never created
+    stream.close()
 
 
 def test_fit_on_filestream_equals_materialized(png_tree, devices):

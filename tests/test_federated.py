@@ -126,6 +126,27 @@ def test_weight_concentration_selects_client(devices):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+def test_all_clients_dropped_keeps_server_state(devices):
+    """Failure tolerance: a round where every client has weight 0 (all
+    participants failed) is a no-op on the global model — never NaN,
+    never a zero model — even when the dead clients' data is garbage."""
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    imgs, labels = _client_data(seed=4)
+    imgs = np.full_like(imgs, np.nan)  # every client is poisoned
+    server = initialize_server(model, jax.random.key(0))
+    before = jax.device_get(server.params)
+    rnd = make_fedavg_round(model, opt, binary_cross_entropy, mesh,
+                            local_epochs=1, batch_size=imgs.shape[1])
+    server, _ = rnd(server, imgs, labels,
+                    np.zeros((N_CLIENTS,), np.float32), jax.random.key(1))
+    for a, b in zip(jax.tree.leaves(jax.device_get(server.params)),
+                    jax.tree.leaves(before)):
+        np.testing.assert_array_equal(a, b)
+    assert int(server.round) == 1
+
+
 def test_federated_eval(devices):
     mesh = meshlib.client_mesh(N_CLIENTS)
     model = small_cnn(10, 3, 1)
