@@ -353,7 +353,7 @@ def _run_fed(ns):
     from idc_models_tpu import mesh as meshlib
     from idc_models_tpu.configs import get_preset
     from idc_models_tpu.data.partition import (
-        partition_clients, train_test_client_split,
+        pad_clients, partition_clients, train_test_client_split,
     )
     from idc_models_tpu.federated import (
         initialize_server, make_fedavg_round, make_federated_eval,
@@ -371,11 +371,9 @@ def _run_fed(ns):
         ["batch_size", "lr", "rounds", "iid", "num_clients", "local_epochs",
          "pretrain_epochs"])
     n_dev = len(jax.devices())
-    n_clients = min(preset.num_clients, n_dev)
-    if n_clients < preset.num_clients:
-        print(f"[idc_models_tpu] clamping num_clients "
-              f"{preset.num_clients} -> {n_clients} (devices)",
-              file=sys.stderr)
+    # client count is independent of chip count: k = ceil(C/D) clients
+    # train per device (vmapped), padded with weight-0 dummies
+    n_clients = preset.num_clients
     ds = _load_idc(ns, preset.image_size, preset.dataset_limit)
     logger = _logger(ns)
 
@@ -413,16 +411,25 @@ def _run_fed(ns):
 
     # Federate: clients fine-tune above fine_tune_at at lr/10
     # (fed_model.py:140-147,208).
-    mesh = meshlib.client_mesh(n_clients)
+    mesh = meshlib.client_mesh(min(n_clients, n_dev))
+    n_mesh = mesh.devices.size
     imgs, labels = partition_clients(ds, n_clients, iid=bool(preset.iid),
                                      seed=ns.seed)
     n_per_client = imgs.shape[1]
+    train_ids, test_ids = train_test_client_split(
+        n_clients, preset.test_client_fraction, seed=ns.seed)
+    # train clients carry weight = examples; test clients weight 0; pad
+    # the client axis to the mesh with inert weight-0 dummies
+    w_train = np.zeros((n_clients,), np.float32)
+    w_train[train_ids] = n_per_client
+    w_test = np.zeros((n_clients,), np.float32)
+    w_test[test_ids] = n_per_client
+    imgs, labels, w_train, w_test = pad_clients(imgs, labels, w_train,
+                                                w_test, multiple=n_mesh)
     # upload the stacked client shards to HBM once — not once per round
     cshard = meshlib.sharding(mesh, meshlib.CLIENT_AXIS)
     imgs = jax.device_put(imgs, cshard)
     labels = jax.device_put(labels, cshard)
-    train_ids, test_ids = train_test_client_split(
-        n_clients, preset.test_client_fraction, seed=ns.seed)
     opt = rmsprop(preset.lr / 10.0,
                   trainable_mask=spec.fine_tune_mask(params,
                                                      preset.fine_tune_at))
@@ -442,11 +449,6 @@ def _run_fed(ns):
                                  mesh, local_epochs=preset.local_epochs,
                                  batch_size=preset.batch_size)
     eval_fn = make_federated_eval(model, _loss_for(preset.num_outputs), mesh)
-    # train clients carry weight = examples; test clients weight 0
-    w_train = np.zeros((n_clients,), np.float32)
-    w_train[train_ids] = n_per_client
-    w_test = np.zeros((n_clients,), np.float32)
-    w_test[test_ids] = n_per_client
     print("round, train_loss, train_acc, test_loss, test_acc")
     with Timer("Federated training", logger=logger), \
             profile_trace(ns.profile_dir):

@@ -44,13 +44,24 @@ def weighted_pmean(tree, weight, axis_name: str):
     contributors" should check psum(weight) themselves (the FedAvg round
     keeps its previous state in that case).
     """
-    weight = jnp.maximum(jnp.asarray(weight, jnp.float32), 0.0)
-    total = lax.psum(weight, axis_name)
+    return weighted_pmean_local(
+        jax.tree.map(lambda x: jnp.asarray(x)[None], tree),
+        jnp.asarray(weight, jnp.float32).reshape(1), axis_name)
+
+
+def weighted_pmean_local(tree, weights, axis_name: str):
+    """Weighted mean over members stacked on each leaf's LEADING axis and
+    over the mesh axis — the k-clients-per-device round boundary
+    (`weights` has shape [k], leaves [k, ...]). Same failure-tolerance
+    semantics as `weighted_pmean`, of which this is the general form.
+    """
+    weights = jnp.maximum(jnp.asarray(weights, jnp.float32), 0.0)
+    total = lax.psum(weights.sum(), axis_name)
     safe_total = jnp.maximum(total, jnp.float32(1e-30))
 
     def contrib(x):
-        w = weight.astype(x.dtype)
-        masked = jnp.where(w > 0, x * w, jnp.zeros_like(x))
+        w = weights.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        masked = jnp.where(w > 0, x * w, jnp.zeros_like(x)).sum(axis=0)
         return lax.psum(masked, axis_name) / safe_total.astype(x.dtype)
 
     return jax.tree.map(contrib, tree)

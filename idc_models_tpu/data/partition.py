@@ -52,4 +52,35 @@ def train_test_client_split(num_clients: int, test_fraction: float = 0.2,
     """Split client *ids* into train/test populations (fed_model.py:47-49)."""
     ids = np.random.default_rng(seed).permutation(num_clients)
     n_test = max(1, int(round(test_fraction * num_clients)))
+    if n_test >= num_clients:
+        raise ValueError(
+            f"test_fraction {test_fraction} leaves no training clients "
+            f"out of {num_clients} — every round would be a no-op")
     return np.sort(ids[n_test:]), np.sort(ids[:n_test])
+
+
+def pad_clients(images: np.ndarray, labels: np.ndarray, *weights: np.ndarray,
+                multiple: int) -> tuple[np.ndarray, ...]:
+    """Pad the client axis up to a multiple of the mesh size with
+    weight-0 dummy clients (zero data). The round's failure-tolerant
+    aggregation ignores zero-weight clients entirely, so padding lets
+    any client count run on any device count (10 reference clients on an
+    8-device mesh -> 16 shards, 2 per device, 6 of them inert).
+
+    Every per-client weight vector travels through here together with
+    the data (varargs), so no caller can pad them inconsistently.
+    Returns (images, labels, *weights) padded to the same client count.
+    """
+    c = images.shape[0]
+    pad = (-c) % multiple
+    if pad == 0:
+        return (images, labels) + tuple(
+            np.asarray(w, np.float32) for w in weights)
+    images = np.concatenate(
+        [images, np.zeros((pad,) + images.shape[1:], images.dtype)])
+    labels = np.concatenate(
+        [labels, np.zeros((pad,) + labels.shape[1:], labels.dtype)])
+    padded_w = tuple(
+        np.concatenate([np.asarray(w, np.float32),
+                        np.zeros((pad,), np.float32)]) for w in weights)
+    return (images, labels) + padded_w

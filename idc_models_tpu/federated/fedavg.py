@@ -1,5 +1,6 @@
-"""FedAvg as a TPU-native program: one client per device on the "client"
-mesh axis.
+"""FedAvg as a TPU-native program: k clients per device on the "client"
+mesh axis (client count is a workload property, independent of chip
+count — the reference simulates 10 clients on one host, fed_model.py:47).
 
 Capability parity with the reference's federated stack (SURVEY.md D3,
 C9-C11): TFF's `build_federated_averaging_process` (fed_model.py:207-208)
@@ -37,6 +38,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -159,48 +161,56 @@ def make_fedavg_round(
 
     - ``images``  [C, S, H, W, 3] and ``labels`` [C, S] are the stacked
       client shards (from `data.partition.partition_clients`), sharded over
-      the "client" mesh axis;
+      the "client" mesh axis. C may be any multiple of the mesh size:
+      each device trains its k = C/D clients with a vmapped local
+      program, so client count is independent of chip count (the
+      reference simulates 10 clients on one host, fed_model.py:47 — pad
+      with weight-0 dummy clients when C is not a multiple of D);
     - ``weights`` [C] are per-client aggregation weights (example counts
-      for TFF parity; ones for the reference's unweighted secure server);
+      for TFF parity; ones for the reference's unweighted secure server;
+      0 drops a client — dead/padding clients cannot poison the round);
     - metrics are the example-weighted means of per-client local-training
       loss/accuracy over all local steps (the `train_metrics` half of the
       reference's per-round CSV print, fed_model.py:229).
     """
-    n_clients = mesh.shape[meshlib.CLIENT_AXIS]
+    n_devices = mesh.shape[meshlib.CLIENT_AXIS]
     local_train = make_local_trainer(
         model, optimizer, loss_fn, local_epochs=local_epochs,
         batch_size=batch_size, compute_dtype=compute_dtype)
 
-    def per_client(params, model_state, imgs, labels, weight, rng):
-        # shard_map gives each device a [1, S, ...] block: its one client.
-        imgs = imgs[0]
-        labels = labels[0]
-        weight = weight[0]
-        cid = collectives.axis_index(meshlib.CLIENT_AXIS)
-        rng = jax.random.fold_in(rng, cid)
+    def per_device(params, model_state, imgs, labels, weight, rng):
+        # shard_map gives each device a [k, S, ...] block: its k clients.
+        k = imgs.shape[0]
+        dev = collectives.axis_index(meshlib.CLIENT_AXIS)
+        # global client ids seed per-client rng streams, so the math is
+        # invariant to how clients are laid out over devices
+        cids = dev * k + jnp.arange(k)
+        rngs = jax.vmap(lambda c: jax.random.fold_in(rng, c))(cids)
 
-        new_params, new_model_state, (losses, accs) = local_train(
-            params, model_state, imgs, labels, rng)
+        new_params, new_model_state, (losses, accs) = jax.vmap(
+            local_train, in_axes=(None, None, 0, 0, 0))(
+            params, model_state, imgs, labels, rngs)
 
-        # Round boundary: the only collective in the program.
-        agg = collectives.weighted_pmean(
+        # Round boundary: the only collectives in the program.
+        agg = collectives.weighted_pmean_local(
             {"params": new_params, "model_state": new_model_state},
             weight, meshlib.CLIENT_AXIS)
-        metrics = collectives.weighted_pmean(
-            {"loss": jnp.mean(losses), "accuracy": jnp.mean(accs)},
+        metrics = collectives.weighted_pmean_local(
+            {"loss": jnp.mean(losses, axis=tuple(range(1, losses.ndim))),
+             "accuracy": jnp.mean(accs, axis=tuple(range(1, accs.ndim)))},
             weight, meshlib.CLIENT_AXIS)
         # all clients dropped (total weight 0, e.g. every participant
         # failed): keep the incoming global state instead of the
         # degenerate zero aggregate
-        any_alive = collectives.psum(jnp.maximum(weight, 0.0),
-                                     meshlib.CLIENT_AXIS) > 0
+        any_alive = collectives.psum(
+            jnp.maximum(weight, 0.0).sum(), meshlib.CLIENT_AXIS) > 0
         agg = jax.tree.map(
             lambda new, old: jnp.where(any_alive, new, old), agg,
             {"params": params, "model_state": model_state})
         return agg["params"], agg["model_state"], metrics
 
     mapped = shard_map(
-        per_client,
+        per_device,
         mesh=mesh,
         in_specs=(P(), P(), P(meshlib.CLIENT_AXIS), P(meshlib.CLIENT_AXIS),
                   P(meshlib.CLIENT_AXIS), P()),
@@ -209,10 +219,7 @@ def make_fedavg_round(
     )
 
     def round_fn(server: ServerState, images, labels, weights, rng):
-        if images.shape[0] != n_clients:
-            raise ValueError(
-                f"got {images.shape[0]} client shards for a "
-                f"{n_clients}-client mesh")
+        _check_client_shapes(images, weights, n_devices)
         params, model_state, metrics = mapped(
             server.params, server.model_state, images, labels,
             jnp.asarray(weights, jnp.float32), rng)
@@ -221,6 +228,19 @@ def make_fedavg_round(
         return new_server, metrics
 
     return jax.jit(round_fn, donate_argnums=(0,))
+
+
+def _check_client_shapes(images, weights, n_devices: int) -> None:
+    if images.shape[0] % n_devices:
+        raise ValueError(
+            f"got {images.shape[0]} client shards for a "
+            f"{n_devices}-device mesh; pad with weight-0 clients to a "
+            f"multiple (data.partition.pad_clients)")
+    if np.shape(weights)[0] != images.shape[0]:
+        raise ValueError(
+            f"{np.shape(weights)[0]} client weights for "
+            f"{images.shape[0]} client shards — pad them together "
+            f"(data.partition.pad_clients takes the weight vectors too)")
 
 
 def make_federated_eval(model: core.Module, loss_fn: LossFn, mesh: Mesh, *,
@@ -232,20 +252,22 @@ def make_federated_eval(model: core.Module, loss_fn: LossFn, mesh: Mesh, *,
     client's shard, metrics example-weighted-averaged across clients.
     """
 
-    def per_client(params, model_state, imgs, labels, weight):
-        imgs = imgs[0].astype(compute_dtype)
-        labels = labels[0]
-        weight = weight[0]
-        logits, _ = model.apply(params, model_state, imgs, train=False)
+    def per_client_eval(imgs, labels, params, model_state):
+        logits, _ = model.apply(params, model_state,
+                                imgs.astype(compute_dtype), train=False)
         logits = logits.astype(jnp.float32)
-        m = {
-            "loss": loss_fn(logits, labels),
-            "accuracy": metrics_lib.auto_accuracy(logits, labels),
-        }
-        return collectives.weighted_pmean(m, weight, meshlib.CLIENT_AXIS)
+        return {"loss": loss_fn(logits, labels),
+                "accuracy": metrics_lib.auto_accuracy(logits, labels)}
+
+    def per_device(params, model_state, imgs, labels, weight):
+        # [k, S, ...] block: evaluate each of the device's k clients
+        m = jax.vmap(per_client_eval, in_axes=(0, 0, None, None))(
+            imgs, labels, params, model_state)
+        return collectives.weighted_pmean_local(m, weight,
+                                                meshlib.CLIENT_AXIS)
 
     mapped = shard_map(
-        per_client,
+        per_device,
         mesh=mesh,
         in_specs=(P(), P(), P(meshlib.CLIENT_AXIS), P(meshlib.CLIENT_AXIS),
                   P(meshlib.CLIENT_AXIS)),
@@ -253,10 +275,14 @@ def make_federated_eval(model: core.Module, loss_fn: LossFn, mesh: Mesh, *,
         check_vma=False,
     )
 
-    @jax.jit
+    n_devices = mesh.shape[meshlib.CLIENT_AXIS]
+    jitted = jax.jit(lambda server, images, labels, weights: mapped(
+        server.params, server.model_state, images, labels,
+        jnp.asarray(weights, jnp.float32)))
+
     def eval_fn(server: ServerState, images, labels, weights):
-        return mapped(server.params, server.model_state, images, labels,
-                      jnp.asarray(weights, jnp.float32))
+        _check_client_shapes(images, weights, n_devices)
+        return jitted(server, images, labels, weights)
 
     return eval_fn
 

@@ -126,6 +126,79 @@ def test_weight_concentration_selects_client(devices):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+def test_client_count_independent_of_device_count(devices):
+    """k clients per device: the same 8 clients aggregated on an
+    8-device mesh (k=1) and a 4-device mesh (k=2) produce the same
+    round — client count is a workload property, not a hardware one."""
+    model = small_cnn(10, 3, 1)
+    imgs, labels = _client_data(seed=7)
+    w = np.full((N_CLIENTS,), imgs.shape[1], np.float32)
+    rng = jax.random.key(3)
+
+    def run(n_dev):
+        mesh = meshlib.client_mesh(n_dev)
+        server = initialize_server(model, jax.random.key(0))
+        rnd = make_fedavg_round(model, rmsprop(1e-3), binary_cross_entropy,
+                                mesh, local_epochs=2, batch_size=16)
+        server, m = rnd(server, imgs, labels, w, rng)
+        ev = make_federated_eval(model, binary_cross_entropy, mesh)
+        em = ev(server, imgs, labels, w)
+        return jax.device_get(server.params), m, em
+
+    p8, m8, e8 = run(8)
+    p4, m4, e4 = run(4)
+    for a, b in zip(jax.tree.leaves(p8), jax.tree.leaves(p4)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(m8["loss"]), float(m4["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(e8["loss"]), float(e4["loss"]),
+                               rtol=1e-5)
+
+
+def test_padded_dummy_clients_are_inert(devices):
+    """10 clients on an 8-device mesh: pad_clients adds 6 weight-0
+    dummies (k=2); the aggregate equals the same 10 clients on a
+    5-device mesh with no padding."""
+    from idc_models_tpu.data.partition import pad_clients
+
+    model = small_cnn(10, 3, 1)
+    imgs10, labels10 = synthetic.make_idc_like(10 * 16, size=10, seed=9)
+    ds = ArrayDataset(imgs10, labels10)
+    imgs, labels = partition_clients(ds, 10, iid=True, seed=9)
+    w = np.full((10,), 16.0, np.float32)
+    rng = jax.random.key(4)
+
+    def run(n_dev):
+        mesh = meshlib.client_mesh(n_dev)
+        ci, cl, cw = pad_clients(imgs, labels, w, multiple=n_dev)
+        server = initialize_server(model, jax.random.key(0))
+        rnd = make_fedavg_round(model, rmsprop(1e-3), binary_cross_entropy,
+                                mesh, local_epochs=1, batch_size=16)
+        server, _ = rnd(server, ci, cl, cw, rng)
+        return jax.device_get(server.params)
+
+    p8 = run(8)   # padded to 16 shards, 6 inert
+    p5 = run(5)   # exact fit, k=2, no padding
+    for a, b in zip(jax.tree.leaves(p8), jax.tree.leaves(p5)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    # mismatched weights (padded data, unpadded weights) fail loudly
+    import pytest
+
+    mesh = meshlib.client_mesh(8)
+    ci, cl, _ = pad_clients(imgs, labels, w, multiple=8)
+    rnd = make_fedavg_round(small_cnn(10, 3, 1), rmsprop(1e-3),
+                            binary_cross_entropy, mesh,
+                            local_epochs=1, batch_size=16)
+    with pytest.raises(ValueError, match="pad them together"):
+        rnd(initialize_server(small_cnn(10, 3, 1), jax.random.key(0)),
+            ci, cl, w, jax.random.key(1))
+    ev = make_federated_eval(small_cnn(10, 3, 1), binary_cross_entropy,
+                             mesh)
+    with pytest.raises(ValueError, match="pad them together"):
+        ev(initialize_server(small_cnn(10, 3, 1), jax.random.key(0)),
+           ci, cl, w)
+
+
 def test_all_clients_dropped_keeps_server_state(devices):
     """Failure tolerance: a round where every client has weight 0 (all
     participants failed) is a no-op on the global model — never NaN,
