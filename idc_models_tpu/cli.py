@@ -488,7 +488,12 @@ def _run_secure(ns):
         ["batch_size", "lr", "rounds", "percent", "num_clients",
          "local_epochs", "paillier"])
     n_dev = len(jax.devices())
-    n_clients = min(preset.num_clients, n_dev)
+    # the unweighted secure mean cannot absorb padding, so run the full
+    # client count on the largest mesh that divides it (k clients per
+    # device; 8 clients on 1 chip -> k=8)
+    n_clients = preset.num_clients
+    n_mesh = max(d for d in range(1, min(n_clients, n_dev) + 1)
+                 if n_clients % d == 0)
     ds = _load_idc(ns, preset.image_size, None)
     # take/skip split sized by the preset (24000/6000 in the reference,
     # secure_fed_model.py:219-220), scaled down when the dataset is smaller
@@ -517,7 +522,7 @@ def _run_secure(ns):
     imgs = np.stack([s.images[:size] for s in shards])
     labels = np.stack([s.labels[:size] for s in shards])
 
-    mesh = meshlib.client_mesh(n_clients)
+    mesh = meshlib.client_mesh(n_mesh)
     # upload the stacked client shards to HBM once — not once per round
     cshard = meshlib.sharding(mesh, meshlib.CLIENT_AXIS)
     imgs = jax.device_put(imgs, cshard)

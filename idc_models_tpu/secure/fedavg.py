@@ -94,103 +94,141 @@ def make_secure_fedavg_round(
     """
     if mask_impl not in ("threefry", "pallas"):
         raise ValueError(f"unknown mask_impl {mask_impl!r}")
-    n_clients = mesh.shape[meshlib.CLIENT_AXIS]
-    if scale_bits is None:
-        scale_bits = masking.choose_scale_bits(n_clients, clip_abs)
+    n_devices = mesh.shape[meshlib.CLIENT_AXIS]
     local_train = make_local_trainer(
         model, optimizer, loss_fn, local_epochs=local_epochs,
         batch_size=batch_size, compute_dtype=compute_dtype)
 
-    def per_client(params, model_state, imgs, labels, rng, mask_key):
-        imgs = imgs[0]
-        labels = labels[0]
-        cid = collectives.axis_index(meshlib.CLIENT_AXIS)
-        rng = jax.random.fold_in(rng, cid)
+    def _pack_k(leaves_k, k):
+        """Pack [k, ...] leaves into one [k, P] buffer + per-client meta
+        (the k-leading analogue of masking.pack_leaves)."""
+        shapes = [tuple(x.shape[1:]) for x in leaves_k]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        dtypes = [x.dtype for x in leaves_k]
+        flat = jnp.concatenate(
+            [x.reshape(k, -1).astype(jnp.float32) for x in leaves_k],
+            axis=1)
+        return flat, (sizes, shapes, dtypes)
 
-        new_params, new_model_state, (losses, accs) = local_train(
-            params, model_state, imgs, labels, rng)
+    def make_per_device(n_clients: int, k: int, sb: int):
+        def per_device(params, model_state, imgs, labels, rng, mask_key):
+            # [k, S, ...] block: this device's k clients. Masks belong to
+            # CLIENTS (global ids), so the cancellation algebra — and the
+            # aggregate, bit-for-bit on the int32 path — is invariant to
+            # how clients are laid out over devices.
+            dev = collectives.axis_index(meshlib.CLIENT_AXIS)
+            cids = dev * k + jnp.arange(k)
+            rngs = jax.vmap(lambda c: jax.random.fold_in(rng, c))(cids)
 
-        # Round boundary. "First fraction" follows the model's layer order
-        # (Keras get_weights() enumeration, secure_fed_model.py:115-121),
-        # not jax's alphabetical flatten.
-        protect = masking.first_fraction_selection(new_params, percent,
-                                                   model.layer_names)
-        leaves, treedef = jax.tree.flatten(new_params)
-        flags = jax.tree.leaves(protect)
-        state_leaves, state_def = jax.tree.flatten(new_model_state)
+            new_params, new_model_state, (losses, accs) = jax.vmap(
+                local_train, in_axes=(None, None, 0, 0, 0))(
+                params, model_state, imgs, labels, rngs)
 
-        prot = [x for x, f in zip(leaves, flags) if f]
-        plain = [x for x, f in zip(leaves, flags) if not f]
+            # "First fraction" follows the model's layer order (Keras
+            # get_weights() enumeration, secure_fed_model.py:115-121),
+            # not jax's alphabetical flatten.
+            protect = masking.first_fraction_selection(
+                new_params, percent, model.layer_names)
+            leaves, treedef = jax.tree.flatten(new_params)
+            flags = jax.tree.leaves(protect)
+            state_leaves, state_def = jax.tree.flatten(new_model_state)
 
-        # -- protected: one quantize+mask pass, ONE psum ----------------
-        prot_agg: list = []
-        if prot:
-            flat, meta = masking.pack_leaves(prot)
-            if mask_impl == "pallas":
-                from idc_models_tpu.ops import secure_masking_kernel as smk
+            prot = [x for x, f in zip(leaves, flags) if f]
+            plain = [x for x, f in zip(leaves, flags) if not f]
 
-                seed = jax.random.bits(mask_key, (), jnp.uint32)
-                seeds, signs = smk.pair_seeds_and_signs(seed, cid, n_clients)
-                masked = smk.fused_masked_quantize(
-                    flat, seeds, signs, scale_bits=scale_bits,
-                    clip_abs=clip_abs,
-                    # compile via Mosaic only on TPU-class backends (the
-                    # real chip's platform is "axon"); interpret elsewhere
-                    # (CPU test pods, GPU) instead of crashing in lowering
-                    interpret=jax.default_backend() not in ("tpu", "axon"))
-            else:
-                q = masking.quantize(flat, scale_bits, clip_abs=clip_abs)
-                m = masking.pairwise_mask(mask_key, cid, n_clients,
-                                          flat.shape)
-                masked = q + m
-            summed = collectives.psum(masked, meshlib.CLIENT_AXIS)
-            deq = masking.dequantize(summed, scale_bits, count=n_clients)
-            prot_agg = masking.unpack_leaves(deq, meta)
+            # -- protected: quantize+mask per client, local int32 sum
+            #    (mod 2^32, exactly like psum), then ONE psum ----------
+            prot_agg: list = []
+            if prot:
+                flat_k, meta = _pack_k(prot, k)
+                if mask_impl == "pallas":
+                    from idc_models_tpu.ops import secure_masking_kernel as smk
 
-        # -- everything else (unprotected params + state): ONE pmean ----
-        plain_agg: list = []
-        state_agg = state_leaves
-        if plain or state_leaves:
-            flat, meta = masking.pack_leaves(plain + state_leaves)
-            mean = collectives.pmean(flat, meshlib.CLIENT_AXIS)
-            unpacked = masking.unpack_leaves(mean, meta)
-            plain_agg = unpacked[:len(plain)]
-            state_agg = unpacked[len(plain):]
+                    seed = jax.random.bits(mask_key, (), jnp.uint32)
+                    interp = jax.default_backend() not in ("tpu", "axon")
+                    masked_total = jnp.zeros((flat_k.shape[1],), jnp.int32)
+                    for i in range(k):  # k is static and small
+                        seeds, signs = smk.pair_seeds_and_signs(
+                            seed, cids[i], n_clients)
+                        masked_total = masked_total + smk.fused_masked_quantize(
+                            flat_k[i], seeds, signs, scale_bits=sb,
+                            clip_abs=clip_abs, interpret=interp)
+                else:
+                    q = masking.quantize(flat_k, sb, clip_abs=clip_abs)
+                    masks = jax.vmap(
+                        lambda c: masking.pairwise_mask(
+                            mask_key, c, n_clients, (flat_k.shape[1],)))(cids)
+                    masked_total = (q + masks).sum(axis=0)
+                summed = collectives.psum(masked_total, meshlib.CLIENT_AXIS)
+                deq = masking.dequantize(summed, sb, count=n_clients)
+                prot_agg = masking.unpack_leaves(deq, meta)
 
-        prot_it, plain_it = iter(prot_agg), iter(plain_agg)
-        agg_leaves = [next(prot_it) if f else next(plain_it) for f in flags]
-        agg_params = jax.tree.unflatten(treedef, agg_leaves)
-        agg_state = jax.tree.unflatten(state_def, state_agg)
-        metrics = collectives.pmean(
-            {"loss": jnp.mean(losses), "accuracy": jnp.mean(accs)},
-            meshlib.CLIENT_AXIS)
-        return agg_params, agg_state, metrics
+            # -- everything else (unprotected params + state): local sum
+            #    then ONE psum / C (the unweighted mean, quirk Q7) ------
+            plain_agg: list = []
+            state_agg: list = []  # non-empty state always aggregates below
+            if plain or state_leaves:
+                flat_k, meta = _pack_k(plain + state_leaves, k)
+                mean = collectives.psum(flat_k.sum(axis=0),
+                                        meshlib.CLIENT_AXIS) / n_clients
+                unpacked = masking.unpack_leaves(mean, meta)
+                plain_agg = unpacked[:len(plain)]
+                state_agg = unpacked[len(plain):]
 
-    mapped = shard_map(
-        per_client,
-        mesh=mesh,
-        in_specs=(P(), P(), P(meshlib.CLIENT_AXIS), P(meshlib.CLIENT_AXIS),
-                  P(), P()),
-        out_specs=(P(), P(), P()),
-        check_vma=False,
-    )
+            prot_it, plain_it = iter(prot_agg), iter(plain_agg)
+            agg_leaves = [next(prot_it) if f else next(plain_it)
+                          for f in flags]
+            agg_params = jax.tree.unflatten(treedef, agg_leaves)
+            agg_state = jax.tree.unflatten(state_def, state_agg)
+            metrics = jax.tree.map(
+                lambda x: collectives.psum(
+                    jnp.mean(x, axis=tuple(range(1, x.ndim))).sum(),
+                    meshlib.CLIENT_AXIS) / n_clients,
+                {"loss": losses, "accuracy": accs})
+            return agg_params, agg_state, metrics
+
+        return per_device
+
+    def make_round(n_clients: int, sb: int):
+        mapped = shard_map(
+            make_per_device(n_clients, n_clients // n_devices, sb),
+            mesh=mesh,
+            in_specs=(P(), P(), P(meshlib.CLIENT_AXIS),
+                      P(meshlib.CLIENT_AXIS), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+
+        def round_fn(server: ServerState, images, labels, rng):
+            # One-time masks: the mask key is derived from the fresh
+            # per-round rng (distinct fold from the training rng), so
+            # streams are never reused across rounds.
+            params, model_state, metrics = mapped(
+                server.params, server.model_state, images, labels, rng,
+                jax.random.fold_in(rng, jnp.int32(-1)))
+            new_server = server.replace(
+                round=server.round + 1, params=params,
+                model_state=model_state)
+            return new_server, metrics
+
+        return jax.jit(round_fn, donate_argnums=(0,))
+
+    rounds: dict[int, Callable] = {}
 
     def round_fn(server: ServerState, images, labels, rng):
-        if images.shape[0] != n_clients:
+        n_clients = images.shape[0]
+        if n_clients % n_devices:
             raise ValueError(
-                f"got {images.shape[0]} client shards for a "
-                f"{n_clients}-client mesh")
-        # One-time masks: the mask key is derived from the fresh per-round
-        # rng (distinct fold from the training rng), so streams are never
-        # reused across rounds.
-        params, model_state, metrics = mapped(
-            server.params, server.model_state, images, labels, rng,
-            jax.random.fold_in(rng, jnp.int32(-1)))
-        new_server = server.replace(
-            round=server.round + 1, params=params, model_state=model_state)
-        return new_server, metrics
+                f"got {n_clients} client shards for a {n_devices}-device "
+                f"mesh; the unweighted secure mean cannot absorb padding "
+                f"— use a mesh size that divides the client count")
+        if n_clients not in rounds:
+            sb = (scale_bits if scale_bits is not None
+                  else masking.choose_scale_bits(n_clients, clip_abs))
+            rounds[n_clients] = make_round(n_clients, sb)
+        return rounds[n_clients](server, images, labels, rng)
 
-    return jax.jit(round_fn, donate_argnums=(0,))
+    return round_fn
 
 
 # ---------------------------------------------------------------------------

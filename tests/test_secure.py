@@ -251,6 +251,44 @@ def test_secure_round_matches_plain_round(devices):
                                rtol=1e-5)
 
 
+def test_secure_round_layout_invariant(devices):
+    """k clients per device: the same 8 clients on an 8-device mesh
+    (k=1), a 4-device mesh (k=2), and a 1-device mesh (k=8) produce the
+    same aggregate — the protected int32 path bit-for-bit (mod-2^32
+    addition is layout-independent), the f32 path to fp tolerance."""
+    model = small_cnn(10, 3, 1)
+    ci, cl = _client_data(seed=13)
+    rng = jax.random.key(21)
+
+    def run(n_dev, impl="threefry"):
+        mesh = meshlib.client_mesh(n_dev)
+        server = initialize_server(model, jax.random.key(0))
+        rnd = make_secure_fedavg_round(
+            model, rmsprop(1e-3), binary_cross_entropy, mesh, percent=0.5,
+            local_epochs=1, batch_size=16, mask_impl=impl)
+        server, m = rnd(server, ci, cl, rng)
+        return jax.device_get(server.params), float(m["loss"])
+
+    p8, l8 = run(8)
+    p4, l4 = run(4)
+    p1, l1 = run(1)
+    # the pallas impl's masks differ but cancel identically, so even a
+    # k=2 pallas layout must land on the same aggregate (exercises the
+    # per-client kernel loop with k > 1)
+    p4p, l4p = run(4, impl="pallas")
+    for ref in (p4, p1, p4p):
+        for a, b in zip(jax.tree.leaves(p8), jax.tree.leaves(ref)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose([l4, l1, l4p], l8, rtol=1e-5)
+    # non-divisible layout is refused (no padding for unweighted means)
+    mesh3 = meshlib.client_mesh(3)
+    rnd3 = make_secure_fedavg_round(
+        model, rmsprop(1e-3), binary_cross_entropy, mesh3, percent=0.5,
+        local_epochs=1, batch_size=16)
+    with pytest.raises(ValueError, match="divides"):
+        rnd3(initialize_server(model, jax.random.key(0)), ci, cl, rng)
+
+
 def test_mobilenet_selection_follows_keras_order():
     """Zoo backbones carry layer_names, so percent-selection follows the
     Keras get_weights() enumeration (VERDICT r1 weak #4): creation order
