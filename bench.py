@@ -230,8 +230,9 @@ def bench_vgg_cached_throughput(on_accelerator: bool):
 
 
 def bench_fed_round(on_accelerator: bool):
-    """One-chip FedAvg round wall-clock: VGG16 clients, one client per
-    device (fed_model.py:214 Timer / NUM_ROUNDS, per chip)."""
+    """FedAvg round wall-clock at the reference's scale: 10 VGG16
+    clients (fed_model.py:47) laid out k-per-device over however many
+    chips exist (fed_model.py:214 Timer / NUM_ROUNDS)."""
     import jax
     import jax.numpy as jnp
 
@@ -243,25 +244,27 @@ def bench_fed_round(on_accelerator: bool):
     from idc_models_tpu.train.losses import binary_cross_entropy
 
     n_dev = len(jax.devices())
-    per_client = 512 if on_accelerator else 32
+    n_clients = 10  # fed_model.py:47
+    n_mesh = meshlib.largest_dividing_mesh(n_clients, n_dev)
+    per_client = 256 if on_accelerator else 32
     size = 50 if on_accelerator else 10
     model = (vgg16(num_outputs=1) if on_accelerator else
              _small_model())
-    mesh = meshlib.client_mesh(n_dev)
+    mesh = meshlib.client_mesh(n_mesh)
     server = initialize_server(model, jax.random.key(0))
     round_fn = make_fedavg_round(model, rmsprop(1e-4),
                                  binary_cross_entropy, mesh,
                                  local_epochs=1, batch_size=32,
                                  compute_dtype=jnp.bfloat16)
-    imgs, labels = synthetic.make_idc_like(n_dev * per_client, size=size,
-                                           seed=0)
-    imgs = imgs.reshape(n_dev, per_client, size, size, 3)
-    labels = labels.reshape(n_dev, per_client)
+    imgs, labels = synthetic.make_idc_like(n_clients * per_client,
+                                           size=size, seed=0)
+    imgs = imgs.reshape(n_clients, per_client, size, size, 3)
+    labels = labels.reshape(n_clients, per_client)
     # upload client shards ONCE (round-loop inputs live in HBM, not host)
     imgs = jax.device_put(imgs, meshlib.sharding(mesh, meshlib.CLIENT_AXIS))
     labels = jax.device_put(labels,
                             meshlib.sharding(mesh, meshlib.CLIENT_AXIS))
-    weights = np.full((n_dev,), per_client, np.float32)
+    weights = np.full((n_clients,), per_client, np.float32)
 
     # >=3 warmup rounds: on the tunneled runtime the first TWO calls of a
     # fresh executable are slow (compile + terminal-side warmup)
@@ -279,8 +282,10 @@ def _small_model():
 
 
 def bench_secure_round(on_accelerator: bool):
-    """One-chip secure-aggregation round wall-clock: small CNN clients,
-    pairwise-masked aggregation (secure_fed_model.py:223-236 per round)."""
+    """Secure-aggregation round wall-clock at the reference's scale: 8
+    small-CNN clients (secure_fed_model.py:41), pairwise-masked
+    aggregation (secure_fed_model.py:223-236 per round), k clients per
+    device over however many chips exist."""
     import jax
     import jax.numpy as jnp
 
@@ -292,17 +297,19 @@ def bench_secure_round(on_accelerator: bool):
     from idc_models_tpu.train.losses import binary_cross_entropy
 
     n_dev = len(jax.devices())
+    n_clients = 8  # secure_fed_model.py:41 NUM_CLIENTS
+    n_mesh = meshlib.largest_dividing_mesh(n_clients, n_dev)
     per_client = 512 if on_accelerator else 32
     model = _small_model()
-    mesh = meshlib.client_mesh(n_dev)
+    mesh = meshlib.client_mesh(n_mesh)
     server = initialize_server(model, jax.random.key(0))
     round_fn = make_secure_fedavg_round(
         model, rmsprop(1e-3), binary_cross_entropy, mesh, percent=0.5,
         local_epochs=5, batch_size=32)
-    imgs, labels = synthetic.make_idc_like(n_dev * per_client, size=10,
+    imgs, labels = synthetic.make_idc_like(n_clients * per_client, size=10,
                                            seed=0)
-    imgs = imgs.reshape(n_dev, per_client, 10, 10, 3)
-    labels = labels.reshape(n_dev, per_client)
+    imgs = imgs.reshape(n_clients, per_client, 10, 10, 3)
+    labels = labels.reshape(n_clients, per_client)
     imgs = jax.device_put(imgs, meshlib.sharding(mesh, meshlib.CLIENT_AXIS))
     labels = jax.device_put(labels,
                             meshlib.sharding(mesh, meshlib.CLIENT_AXIS))

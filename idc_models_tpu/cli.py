@@ -492,8 +492,7 @@ def _run_secure(ns):
     # client count on the largest mesh that divides it (k clients per
     # device; 8 clients on 1 chip -> k=8)
     n_clients = preset.num_clients
-    n_mesh = max(d for d in range(1, min(n_clients, n_dev) + 1)
-                 if n_clients % d == 0)
+    n_mesh = meshlib.largest_dividing_mesh(n_clients, n_dev)
     ds = _load_idc(ns, preset.image_size, None)
     # take/skip split sized by the preset (24000/6000 in the reference,
     # secure_fed_model.py:219-220), scaled down when the dataset is smaller

@@ -116,6 +116,16 @@ def client_mesh(n_clients: int | None = None) -> Mesh:
     return make_mesh({CLIENT_AXIS: len(devs)}, devices=devs)
 
 
+def largest_dividing_mesh(n_clients: int, n_devices: int | None = None) -> int:
+    """The largest device count <= n_devices that divides n_clients —
+    the mesh size for k-clients-per-device programs whose aggregation
+    cannot absorb weight-0 padding (the unweighted secure mean)."""
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    return max(d for d in range(1, min(n_clients, n_devices) + 1)
+               if n_clients % d == 0)
+
+
 def sharding(mesh: Mesh, *spec) -> NamedSharding:
     """NamedSharding for `spec` over `mesh` (e.g. sharding(mesh, "data"))."""
     return NamedSharding(mesh, P(*spec))

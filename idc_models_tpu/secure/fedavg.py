@@ -99,17 +99,6 @@ def make_secure_fedavg_round(
         model, optimizer, loss_fn, local_epochs=local_epochs,
         batch_size=batch_size, compute_dtype=compute_dtype)
 
-    def _pack_k(leaves_k, k):
-        """Pack [k, ...] leaves into one [k, P] buffer + per-client meta
-        (the k-leading analogue of masking.pack_leaves)."""
-        shapes = [tuple(x.shape[1:]) for x in leaves_k]
-        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-        dtypes = [x.dtype for x in leaves_k]
-        flat = jnp.concatenate(
-            [x.reshape(k, -1).astype(jnp.float32) for x in leaves_k],
-            axis=1)
-        return flat, (sizes, shapes, dtypes)
-
     def make_per_device(n_clients: int, k: int, sb: int):
         def per_device(params, model_state, imgs, labels, rng, mask_key):
             # [k, S, ...] block: this device's k clients. Masks belong to
@@ -140,7 +129,7 @@ def make_secure_fedavg_round(
             #    (mod 2^32, exactly like psum), then ONE psum ----------
             prot_agg: list = []
             if prot:
-                flat_k, meta = _pack_k(prot, k)
+                flat_k, meta = masking.pack_leaves(prot, lead_axes=1)
                 if mask_impl == "pallas":
                     from idc_models_tpu.ops import secure_masking_kernel as smk
 
@@ -168,7 +157,8 @@ def make_secure_fedavg_round(
             plain_agg: list = []
             state_agg: list = []  # non-empty state always aggregates below
             if plain or state_leaves:
-                flat_k, meta = _pack_k(plain + state_leaves, k)
+                flat_k, meta = masking.pack_leaves(plain + state_leaves,
+                                                   lead_axes=1)
                 mean = collectives.psum(flat_k.sum(axis=0),
                                         meshlib.CLIENT_AXIS) / n_clients
                 unpacked = masking.unpack_leaves(mean, meta)

@@ -190,20 +190,28 @@ def leaf_paths(tree) -> list[tuple[str, ...]]:
     return [tuple(k.key for k in p) for p, _ in paths_and_leaves]
 
 
-def pack_leaves(leaves, dtype=jnp.float32):
+def pack_leaves(leaves, dtype=jnp.float32, *, lead_axes: int = 0):
     """Concatenate arrays into ONE flat vector (+ static split metadata).
 
     The round boundary uses this to turn per-tensor collectives into a
     single psum/pmean over one buffer — O(1) collectives per round
     instead of O(tensors), and one PRG stream covers every protected
     element. Returns (flat, meta); `unpack_leaves(flat, meta)` inverts.
+
+    `lead_axes=n` treats each leaf's first n axes as batch dims (the
+    k-clients-per-device round stacks client updates on a leading axis):
+    the result is [*lead, P] and the meta describes the per-item tail
+    shapes, so `unpack_leaves` recovers single-item leaves.
     """
-    shapes = [tuple(x.shape) for x in leaves]
+    shapes = [tuple(x.shape[lead_axes:]) for x in leaves]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
     dtypes = [x.dtype for x in leaves]
     if not leaves:
         return jnp.zeros((0,), dtype), (sizes, shapes, dtypes)
-    flat = jnp.concatenate([x.reshape(-1).astype(dtype) for x in leaves])
+    lead = leaves[0].shape[:lead_axes]
+    flat = jnp.concatenate(
+        [x.reshape(lead + (-1,)).astype(dtype) for x in leaves],
+        axis=lead_axes)
     return flat, (sizes, shapes, dtypes)
 
 
