@@ -77,6 +77,14 @@ def test_all_gather_and_axis_helpers():
     assert int(np.asarray(size)[0]) == N
 
 
+def test_largest_dividing_mesh():
+    assert meshlib.largest_dividing_mesh(8, 8) == 8
+    assert meshlib.largest_dividing_mesh(10, 8) == 5
+    assert meshlib.largest_dividing_mesh(8, 1) == 1
+    assert meshlib.largest_dividing_mesh(7, 4) == 1
+    assert meshlib.largest_dividing_mesh(3, 16) == 3
+
+
 def test_ppermute_ring_reduce_equals_psum():
     """N-1 ring shifts with accumulation == psum: the manual ring
     schedule built from the exposed primitives works."""
