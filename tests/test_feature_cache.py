@@ -179,6 +179,34 @@ def test_two_phase_cached_matches_uncached_densenet(devices):
         jax.device_get(r_cached.state.model_state))
 
 
+def test_cached_phase2_resumes_and_survives_cache_toggle(devices, tmp_path):
+    """--cache-features + --resumable: the suffix fit checkpoints and a
+    rerun restores it (same end state); toggling the cache OFF afterwards
+    changes the checkpoint fingerprint (suffix vs full trees), so the
+    stale checkpoint is ignored with a warning instead of crashing."""
+    mesh = meshlib.data_mesh(8)
+    imgs, labels = synthetic.make_idc_like(32, size=50, seed=0)
+    train = ArrayDataset(imgs[:24], labels[:24])
+    val = ArrayDataset(imgs[24:], labels[24:])
+    kw = dict(lr=1e-3, epochs=0, fine_tune_epochs=1, batch_size=8,
+              eval_steps=1, seed=0)
+    d = str(tmp_path / "ck")
+
+    r1 = two_phase_fit("vgg16", 1, train, val, mesh,
+                       TwoPhaseConfig(cache_features=True, **kw),
+                       checkpoint_dir=d)
+    r2 = two_phase_fit("vgg16", 1, train, val, mesh,
+                       TwoPhaseConfig(cache_features=True, **kw),
+                       checkpoint_dir=d)
+    for a, b in zip(jax.tree.leaves(jax.device_get(r1.state.params)),
+                    jax.tree.leaves(jax.device_get(r2.state.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    with pytest.warns(UserWarning, match="different run"):
+        two_phase_fit("vgg16", 1, train, val, mesh,
+                      TwoPhaseConfig(cache_features=False, **kw),
+                      checkpoint_dir=d)
+
+
 def test_two_phase_cached_matches_uncached(devices):
     """The headline guarantee: phase 2 on cached features reproduces the
     uncached phase-2 training trajectory (same seeds, no rng consumers in
