@@ -73,21 +73,38 @@ class Evaluator:
                  steps: int | None = None) -> dict[str, float]:
         n_dev = self.mesh.devices.size
         state = replicate(self.mesh, state)
-        logits_parts, labels_parts = [], []
+        logits_parts = []
         loader = Loader(ds, self.batch_size, shuffle=False,
                         drop_remainder=False)
-        for i, (x, y) in enumerate(loader.epoch(0)):
-            if steps is not None and i >= steps:
-                break
-            x, y, mask = pad_to_multiple(x, y, n_dev)
-            m = self._step(state, *shard_batch(self.mesh, x, y))
+
+        def padded():
+            for i, (x, y) in enumerate(loader.epoch(0)):
+                if steps is not None and i >= steps:
+                    break
+                x, y, _ = pad_to_multiple(x, y, n_dev)
+                yield x, y
+
+        # eval order is deterministic (shuffle off), so each batch's true
+        # size (and with it the tail padding to drop) is known by index;
+        # prefetching the padded batches overlaps the next host->HBM copy
+        # with this batch's device compute. The batch axis is inferred so
+        # eval works on "client" meshes too (see step._batch_axis).
+        from idc_models_tpu.train.step import _batch_axis
+
+        bs = self.batch_size
+        n_total = len(ds)
+        axis = _batch_axis(self.mesh, None)
+        for j, (x, y) in enumerate(
+                prefetch_to_mesh(padded(), self.mesh, axis=axis)):
+            size = min(bs, n_total - j * bs)
+            m = self._step(state, x, y)
             logits = m["logits"]
             if not logits.is_fully_addressable:
                 logits = self._gather(logits)
-            logits_parts.append(np.asarray(logits)[mask])
-            labels_parts.append(y[mask])
+            logits_parts.append(np.asarray(logits)[:size])
         logits = jnp.asarray(np.concatenate(logits_parts))
-        labels = jnp.asarray(np.concatenate(labels_parts))
+        # the kept rows are exactly the first len(logits) examples
+        labels = jnp.asarray(ds.labels[:len(logits)])
         out = {
             "loss": float(self.loss_fn(logits, labels)),
             "accuracy": float(metrics_lib.auto_accuracy(logits, labels)),
