@@ -235,6 +235,31 @@ def prefetch_to_mesh(batches: Iterator, mesh: Mesh, *, axis=meshlib.DATA_AXIS,
         stop.set()
 
 
+def prefetch_eval_batches(ds: ArrayDataset, mesh: Mesh, batch_size: int, *,
+                          steps: int | None = None) -> Iterator:
+    """The deterministic full-coverage eval pipeline, shared by the
+    Evaluator and the feature cache: batches of `ds` in order, final
+    batch padded to divide the mesh, transfers overlapped with compute
+    via `prefetch_to_mesh`. Yields (images_dev, labels_dev, size) where
+    `size` is the batch's true row count — padding rows sit at the tail,
+    so `out[:size]` drops them exactly."""
+    n_dev = mesh.devices.size
+    loader = Loader(ds, batch_size, shuffle=False, drop_remainder=False)
+
+    def padded():
+        for i, (x, y) in enumerate(loader.epoch(0)):
+            if steps is not None and i >= steps:
+                break
+            x, y, _ = pad_to_multiple(x, y, n_dev)
+            yield x, y
+
+    n_total = (len(ds) if steps is None
+               else min(len(ds), steps * batch_size))
+    axis = meshlib.batch_axis(mesh)
+    for j, (x, y) in enumerate(prefetch_to_mesh(padded(), mesh, axis=axis)):
+        yield x, y, min(batch_size, n_total - j * batch_size)
+
+
 def pad_to_multiple(images: np.ndarray, labels: np.ndarray,
                     multiple: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Pad a final partial batch up to `multiple`, returning a validity mask.

@@ -131,6 +131,20 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def batch_axis(mesh: Mesh, axis: str | None = None) -> str:
+    """The axis a leading batch dimension shards over: `axis` if given,
+    else "data" when present, else the mesh's only axis (so eval and
+    prefetch work on a "client" mesh too)."""
+    if axis is not None:
+        return axis
+    if DATA_AXIS in mesh.axis_names:
+        return DATA_AXIS
+    if len(mesh.axis_names) == 1:
+        return mesh.axis_names[0]
+    raise ValueError(f"cannot infer batch axis from mesh axes "
+                     f"{mesh.axis_names}; pass axis=...")
+
+
 def put_with_sharding(a, sh: NamedSharding):
     """Host array -> device(s) under `sh`, multi-process safe.
 

@@ -39,9 +39,9 @@ from jax.sharding import Mesh
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.data.idc import ArrayDataset
-from idc_models_tpu.data.pipeline import Loader, pad_to_multiple
+from idc_models_tpu.data.pipeline import prefetch_eval_batches
 from idc_models_tpu.models import core
-from idc_models_tpu.train.step import jit_data_parallel, replicate, shard_batch
+from idc_models_tpu.train.step import jit_data_parallel, replicate
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,14 +158,11 @@ def compute_features(plan: FeatureCachePlan, params, model_state,
     step = jit_data_parallel(lambda st, x, y: fwd(st["p"], st["s"], x),
                              mesh, donate_state=False)
     st = replicate(mesh, {"p": prefix_params, "s": prefix_state})
-    n_dev = mesh.devices.size
-    loader = Loader(ds, batch_size, shuffle=False, drop_remainder=False)
     parts = []
     gather = jax.jit(lambda x: x, out_shardings=meshlib.replicated(mesh))
-    for x, y in loader.epoch(0):
-        x, y, mask = pad_to_multiple(x, y, n_dev)
-        out = step(st, *shard_batch(mesh, x, y))["features"]
+    for x, y, size in prefetch_eval_batches(ds, mesh, batch_size):
+        out = step(st, x, y)["features"]
         if not out.is_fully_addressable:
             out = gather(out)
-        parts.append(np.asarray(out)[mask])
+        parts.append(np.asarray(out)[:size])
     return ArrayDataset(np.concatenate(parts), ds.labels)

@@ -31,7 +31,9 @@ from jax.sharding import Mesh
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.data.idc import ArrayDataset
-from idc_models_tpu.data.pipeline import Loader, pad_to_multiple, prefetch_to_mesh
+from idc_models_tpu.data.pipeline import (
+    Loader, prefetch_eval_batches, prefetch_to_mesh,
+)
 from idc_models_tpu.models import core, registry
 from idc_models_tpu.observe import Timer, plot_history
 from idc_models_tpu.train import metrics as metrics_lib
@@ -71,32 +73,11 @@ class Evaluator:
 
     def __call__(self, state: TrainState, ds: ArrayDataset, *,
                  steps: int | None = None) -> dict[str, float]:
-        n_dev = self.mesh.devices.size
         state = replicate(self.mesh, state)
         logits_parts = []
-        loader = Loader(ds, self.batch_size, shuffle=False,
-                        drop_remainder=False)
-
-        def padded():
-            for i, (x, y) in enumerate(loader.epoch(0)):
-                if steps is not None and i >= steps:
-                    break
-                x, y, _ = pad_to_multiple(x, y, n_dev)
-                yield x, y
-
-        # eval order is deterministic (shuffle off), so each batch's true
-        # size (and with it the tail padding to drop) is known by index;
-        # prefetching the padded batches overlaps the next host->HBM copy
-        # with this batch's device compute. The batch axis is inferred so
-        # eval works on "client" meshes too (see step._batch_axis).
-        from idc_models_tpu.train.step import _batch_axis
-
-        bs = self.batch_size
-        n_total = len(ds)
-        axis = _batch_axis(self.mesh, None)
-        for j, (x, y) in enumerate(
-                prefetch_to_mesh(padded(), self.mesh, axis=axis)):
-            size = min(bs, n_total - j * bs)
+        for x, y, size in prefetch_eval_batches(ds, self.mesh,
+                                                self.batch_size,
+                                                steps=steps):
             m = self._step(state, x, y)
             logits = m["logits"]
             if not logits.is_fully_addressable:

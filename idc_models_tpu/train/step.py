@@ -122,15 +122,7 @@ def shard_batch(mesh: Mesh, *arrays, axis: str | None = None):
     return out if len(out) > 1 else out[0]
 
 
-def _batch_axis(mesh: Mesh, axis: str | None) -> str:
-    if axis is not None:
-        return axis
-    if meshlib.DATA_AXIS in mesh.axis_names:
-        return meshlib.DATA_AXIS
-    if len(mesh.axis_names) == 1:
-        return mesh.axis_names[0]
-    raise ValueError(f"cannot infer batch axis from mesh axes "
-                     f"{mesh.axis_names}; pass axis=...")
+_batch_axis = meshlib.batch_axis
 
 
 def replicate(mesh: Mesh, tree):
