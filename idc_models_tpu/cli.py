@@ -96,6 +96,11 @@ def _parse(argv):
                              "fly (datasets larger than host RAM) "
                              "instead of materializing the train split; "
                              "needs a real --data-dir IDC tree")
+        sp.add_argument("--model-parallel", type=int, default=1,
+                        help="shard weights channel-wise over a 'model' "
+                             "mesh axis of this size (tensor parallelism "
+                             "via GSPMD, tp.py); composes with data "
+                             "parallelism over the remaining devices")
 
     sp = sub.add_parser("fed", help="federated averaging (FedAvg)")
     common(sp)
@@ -278,11 +283,23 @@ def _run_dist(ns):
         get_preset(ns.preset_key), ns,
         ["batch_size", "lr", "epochs", "fine_tune_epochs", "fine_tune_at",
          "repeats"])
-    mesh = meshlib.data_mesh()
-    n_dev = mesh.devices.size
+    if getattr(ns, "model_parallel", 1) > 1:
+        if ns.central_storage:
+            sys.exit("--central-storage broadcasts a host-resident "
+                     "replica each step and cannot keep a model-sharded "
+                     "layout; drop one of the two flags")
+        from idc_models_tpu import tp
+
+        try:
+            mesh = tp.dp_tp_mesh(ns.model_parallel)
+        except ValueError as e:
+            sys.exit(str(e))
+    else:
+        mesh = meshlib.data_mesh()
+    n_dev = mesh.shape.get(meshlib.DATA_AXIS, mesh.devices.size)
     global_batch = (preset.batch_size * n_dev if preset.per_replica_batch
                     else preset.batch_size)
-    print(f"Number of devices: {n_dev}")
+    print(f"Number of devices: {mesh.devices.size}")
 
     # Synthetic fallback must yield at least one full global batch after
     # the train split, or the Loader rightly refuses to run.

@@ -93,3 +93,45 @@ def ring_perm(n: int, shift: int = 1) -> list[tuple[int, int]]:
 def reduce_scatter(x, axis_name: str, *, scatter_dimension: int = 0):
     return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension,
                             tiled=True)
+
+
+def ring_psum(x, axis_name: str):
+    """All-reduce as an EXPLICIT bandwidth-optimal ring: a chunked
+    reduce-scatter followed by an all-gather, each built from n-1
+    neighbor `ppermute` shifts.
+
+    `psum` compiles to this same schedule on a TPU ICI ring, so the
+    normal hot path should just use `psum` and let XLA pick; this
+    explicit form exists because it is the schedule under *user*
+    control — the building block for programs that need to interleave
+    per-hop compute with the transfers (ring/blockwise schedules over a
+    sequence axis, e.g. ring attention, stage exactly this loop with the
+    block compute fused between hops), which SURVEY.md §5 calls out as
+    the future-facing reason this module exposes `ppermute`.
+
+    Equal to `psum` up to summation order: bit-exact for integer dtypes
+    (the secure-aggregation masks rely on int32 wrap-around, which is
+    order-free), within fp tolerance for floats.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    me = lax.axis_index(axis_name)
+    fwd = ring_perm(n)
+    flat = x.reshape(-1)
+    chunk = -(-flat.size // n)
+    blocks = jnp.pad(flat, (0, chunk * n - flat.size)).reshape(n, chunk)
+
+    # Reduce-scatter: after step s the carry holds s+2 devices' partial
+    # sum; after n-1 steps device i owns the full sum of block (i+1)%n.
+    carry = blocks[me]
+    for s in range(n - 1):
+        carry = lax.ppermute(carry, axis_name, fwd)
+        carry = carry + blocks[jnp.mod(me - s - 1, n)]
+
+    # All-gather: circulate the n reduced blocks back around the ring.
+    out = jnp.zeros_like(blocks).at[jnp.mod(me + 1, n)].set(carry)
+    for s in range(n - 1):
+        carry = lax.ppermute(carry, axis_name, fwd)
+        out = out.at[jnp.mod(me - s, n)].set(carry)
+    return out.reshape(-1)[: flat.size].reshape(x.shape)

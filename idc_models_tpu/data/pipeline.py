@@ -243,19 +243,22 @@ def prefetch_eval_batches(ds: ArrayDataset, mesh: Mesh, batch_size: int, *,
     via `prefetch_to_mesh`. Yields (images_dev, labels_dev, size) where
     `size` is the batch's true row count — padding rows sit at the tail,
     so `out[:size]` drops them exactly."""
-    n_dev = mesh.devices.size
+    axis = meshlib.batch_axis(mesh)
+    # pad to the BATCH axis size — on a 2-D ("data", "model") mesh the
+    # model axis replicates the batch, so padding to devices.size would
+    # compute model-factor more dummy rows than sharding needs
+    n_shards = mesh.shape[axis]
     loader = Loader(ds, batch_size, shuffle=False, drop_remainder=False)
 
     def padded():
         for i, (x, y) in enumerate(loader.epoch(0)):
             if steps is not None and i >= steps:
                 break
-            x, y, _ = pad_to_multiple(x, y, n_dev)
+            x, y, _ = pad_to_multiple(x, y, n_shards)
             yield x, y
 
     n_total = (len(ds) if steps is None
                else min(len(ds), steps * batch_size))
-    axis = meshlib.batch_axis(mesh)
     for j, (x, y) in enumerate(prefetch_to_mesh(padded(), mesh, axis=axis)):
         yield x, y, min(batch_size, n_total - j * batch_size)
 

@@ -10,7 +10,8 @@ XLA inserts the ICI/DCN collectives.
 Axis conventions used throughout the framework:
 
 - ``"data"``    batch / data-parallel axis (reference D1)
-- ``"model"``   tensor-parallel axis (reserved; unused by the five presets)
+- ``"model"``   tensor-parallel axis — channel-wise weight sharding via
+  GSPMD (tp.py, CLI --model-parallel); beyond reference parity
 - ``"client"``  federated-client axis — one client per device (reference D3)
 """
 

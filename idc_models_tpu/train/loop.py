@@ -39,7 +39,8 @@ from idc_models_tpu.observe import Timer, plot_history
 from idc_models_tpu.train import metrics as metrics_lib
 from idc_models_tpu.train.state import TrainState, create_train_state, rmsprop
 from idc_models_tpu.train.step import (
-    jit_data_parallel, make_eval_step, make_train_step, replicate, shard_batch,
+    jit_data_parallel, make_eval_step, make_train_step, place_state,
+    replicate, shard_batch,
 )
 
 History = dict[str, list[float]]
@@ -73,7 +74,7 @@ class Evaluator:
 
     def __call__(self, state: TrainState, ds: ArrayDataset, *,
                  steps: int | None = None) -> dict[str, float]:
-        state = replicate(self.mesh, state)
+        state = place_state(self.mesh, state)
         logits_parts = []
         for x, y, size in prefetch_eval_batches(ds, self.mesh,
                                                 self.batch_size,
@@ -144,6 +145,13 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
                 "reference's CentralStorageStrategy, "
                 "dist_model_tf_dense.py:18, is single-host too); use the "
                 "default mirrored mode on multi-host pods")
+        from idc_models_tpu import tp
+
+        if tp.has_model_axis(mesh):
+            raise NotImplementedError(
+                "central_storage broadcasts a host-resident replica each "
+                "step and cannot keep a model-sharded layout; drop "
+                "model parallelism or central_storage")
         state = jax.device_get(state)
 
         def step_fn(host_state, x, y, rng):
@@ -151,7 +159,7 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
             return jax.device_get(out), m
     else:
         step_fn = base_step
-        state = replicate(mesh, state)
+        state = place_state(mesh, state)
     # repeats>1 reproduces the reference CIFAR pipeline's `.repeat(2)`
     # (dist_model_tf_dense.py:122-123): each epoch passes over the train
     # set `repeats` times, freshly shuffled per pass. A Loader-shaped

@@ -94,11 +94,19 @@ def jit_data_parallel(step_fn, mesh: Mesh, *, donate_state: bool = True,
     the mesh's "data" axis, or its only axis when 1-D — so eval works on
     a "client" mesh too). This is the whole MirroredStrategy replacement
     for D1.
+
+    On a 2-D ("data", "model") mesh the state's sharding is left to
+    follow its placement instead of being pinned replicated, so a state
+    placed by `place_state` keeps its channel-wise tensor-parallel
+    layout and GSPMD partitions the step accordingly (tp.py).
     """
+    from idc_models_tpu import tp
+
     repl = meshlib.replicated(mesh)
+    state_sh = None if tp.has_model_axis(mesh) else repl
     batch = meshlib.sharding(mesh, _batch_axis(mesh, axis))
     n_batch = 2 + extra_batch_args
-    in_shardings = (repl,) + (batch,) * n_batch
+    in_shardings = (state_sh,) + (batch,) * n_batch
     return jax.jit(
         step_fn,
         in_shardings=in_shardings + (repl,) if _wants_rng(step_fn) else in_shardings,
@@ -131,3 +139,14 @@ def replicate(mesh: Mesh, tree):
     if sh.is_fully_addressable:
         return jax.device_put(tree, sh)
     return jax.tree.map(lambda a: meshlib.put_with_sharding(a, sh), tree)
+
+
+def place_state(mesh: Mesh, tree):
+    """Put a TrainState (or any param-shaped tree) on `mesh` in the
+    layout the jitted step expects: replicated on DP/client meshes,
+    channel-wise model-sharded on a ("data", "model") mesh (tp.py)."""
+    from idc_models_tpu import tp
+
+    if tp.has_model_axis(mesh):
+        return tp.place(mesh, tree)
+    return replicate(mesh, tree)

@@ -37,6 +37,17 @@ def test_cli_vgg_two_phase(tmp_path, capsys):
     assert (tmp_path / "logs" / "run.jsonl").exists()
 
 
+def test_cli_vgg_model_parallel(capsys):
+    """--model-parallel 2 trains on a 4x2 ("data", "model") mesh through
+    the product surface; the batch scales with the DATA axis only."""
+    out = _run(["vgg", "--host-devices", "8", "--synthetic-examples", "64",
+                "--batch-size", "8", "--epochs", "1",
+                "--fine-tune-epochs", "1", "--model-parallel", "2"], capsys)
+    assert "Number of devices: 8" in out
+    assert "epoch 2/2" in out
+    assert "test:" in out
+
+
 def test_cli_vgg_pretrained_weights(tmp_path, capsys):
     """The --pretrained-weights flag demonstrably reaches the init: the
     run reports the load and starts from a different baseline."""

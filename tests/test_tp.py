@@ -1,0 +1,112 @@
+"""Channel-wise tensor parallelism over the "model" axis (tp.py).
+
+Beyond-parity capability (the reference is DP-only, SURVEY.md §2b): the
+sharding rule splits output channels, GSPMD partitions the step, and a
+DP x TP run must match plain DP exactly — same math, different layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from idc_models_tpu import mesh as meshlib, tp
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.models import small_cnn
+from idc_models_tpu.train import (
+    create_train_state, jit_data_parallel, make_train_step, rmsprop,
+    shard_batch,
+)
+from idc_models_tpu.train.losses import binary_cross_entropy
+from idc_models_tpu.train.step import place_state
+
+
+def _train(mesh, n_steps=8):
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = place_state(mesh,
+                        create_train_state(model, opt, jax.random.key(0)))
+    step = jit_data_parallel(
+        make_train_step(model, opt, binary_cross_entropy), mesh)
+    imgs, labels = synthetic.make_idc_like(64, size=10, seed=0)
+    x, y = shard_batch(mesh, imgs, labels)
+    key = jax.random.key(1)
+    losses = []
+    for _ in range(n_steps):
+        key, sub = jax.random.split(key)
+        state, m = step(state, x, y, sub)
+        losses.append(float(m["loss"]))
+    return losses, jax.device_get(state.params)
+
+
+def test_dp_tp_matches_dp():
+    """The same training run on an 8-way DP mesh and a 2x4 DP x TP mesh
+    produces the same loss trajectory and parameters: channel sharding
+    changes layout, never math (contractions are over unsharded axes)."""
+    dp_losses, dp_params = _train(meshlib.data_mesh(8))
+    tp_losses, tp_params = _train(tp.dp_tp_mesh(4))
+    np.testing.assert_allclose(dp_losses, tp_losses, rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        dp_params, tp_params)
+    assert dp_losses[-1] < dp_losses[0]
+
+
+def test_channel_rule_and_placement():
+    """Kernels/biases with model-divisible channel counts shard on the
+    last axis; scalars, the Dense(1) head, and odd sizes replicate."""
+    assert tp.channel_spec(np.zeros((3, 3, 3, 32)), 4) == P(
+        None, None, None, meshlib.MODEL_AXIS)
+    assert tp.channel_spec(np.zeros((512, 8)), 4) == P(None,
+                                                       meshlib.MODEL_AXIS)
+    assert tp.channel_spec(np.zeros((32,)), 4) == P(meshlib.MODEL_AXIS)
+    assert tp.channel_spec(np.zeros((512, 1)), 4) == P()   # head
+    assert tp.channel_spec(np.zeros(()), 4) == P()         # step counter
+    assert tp.channel_spec(np.zeros((7,)), 4) == P()       # odd size
+
+    mesh = tp.dp_tp_mesh(4)
+    state = place_state(mesh, create_train_state(
+        small_cnn(10, 3, 1), rmsprop(1e-3), jax.random.key(0)))
+    kspec = state.params["conv1"]["kernel"].sharding.spec
+    assert kspec == P(None, None, None, meshlib.MODEL_AXIS)
+    # optimizer moments follow their parameter's layout
+    nus = [l for l in jax.tree.leaves(state.opt_state)
+           if getattr(l, "ndim", 0) == 4]
+    assert nus and all(
+        l.sharding.spec == P(None, None, None, meshlib.MODEL_AXIS)
+        for l in nus)
+    assert state.params["head"]["kernel"].sharding.spec == P()
+
+
+def test_dp_tp_mesh_validates_degree():
+    import pytest
+
+    with pytest.raises(ValueError, match="divide the device count"):
+        tp.dp_tp_mesh(16)   # oversize: would make a 0-device data axis
+    with pytest.raises(ValueError, match="divide the device count"):
+        tp.dp_tp_mesh(3)    # non-dividing: would silently drop devices
+    assert tp.dp_tp_mesh(2).devices.size == 8
+
+
+def test_fit_runs_on_tp_mesh():
+    """The full fit loop (loader, prefetch, eval) works unchanged on a
+    DP x TP mesh and matches the DP-mesh run."""
+    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.train.loop import fit
+    from idc_models_tpu.train.state import TrainState
+
+    imgs, labels = synthetic.make_idc_like(96, size=10, seed=2)
+    train = ArrayDataset(imgs[:64], labels[:64])
+    val = ArrayDataset(imgs[64:], labels[64:])
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+
+    def run(mesh):
+        state = create_train_state(model, opt, jax.random.key(0))
+        return fit(model, opt, binary_cross_entropy, state, train, val,
+                   mesh, epochs=2, batch_size=16, seed=3, verbose=False)
+
+    _, hist_tp = run(tp.dp_tp_mesh(4))
+    _, hist_dp = run(meshlib.data_mesh(8))
+    for k in hist_dp:
+        np.testing.assert_allclose(hist_dp[k], hist_tp[k], rtol=1e-4)
