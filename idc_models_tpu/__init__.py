@@ -10,6 +10,7 @@ of tf.distribute strategies and NCCL.
 Layering (bottom-up):
 
 - `mesh` / `collectives`    device mesh + XLA collective wrappers (ICI/DCN)
+- `tp`                      channel-wise tensor parallelism ("model" axis)
 - `data`                    host-side loaders + host->HBM prefetch pipeline
 - `models`                  explicit-pytree model zoo (pure jnp)
 - `train`                   jitted train/eval steps, two-phase loops, metrics
@@ -21,4 +22,4 @@ Layering (bottom-up):
 
 __version__ = "0.1.0"
 
-from idc_models_tpu import collectives, mesh  # noqa: F401
+from idc_models_tpu import collectives, mesh, tp  # noqa: F401
