@@ -62,12 +62,15 @@ def main() -> int:
         key, sub = jax.random.split(key)
         state, m = step(state, x, y, sub)
 
-    def param_digest(params):
-        return float(jnp.sum(jax.tree.leaves(params)[0]
-                             .astype(jnp.float32)))
+    def param_digest(params, dmesh):
+        # leaves may be sharded across other processes' devices (the TP
+        # case); reduce to a replicated scalar inside jit before fetching
+        return float(jax.jit(
+            lambda t: jnp.sum(jax.tree.leaves(t)[0].astype(jnp.float32)),
+            out_shardings=meshlib.replicated(dmesh))(params))
 
     loss = float(m["loss"])
-    digest = param_digest(state.params)
+    digest = param_digest(state.params, mesh)
     assert np.isfinite(loss)
 
     # Evaluator across the process boundary: its eval step's logits are
@@ -100,7 +103,7 @@ def main() -> int:
         server, fm = round_fn(server, ci, cl, w,
                               jax.random.fold_in(jax.random.key(5), r))
     fed_loss = float(fm["loss"])
-    fed_digest = param_digest(server.params)
+    fed_digest = param_digest(server.params, cmesh)
 
     # Secure-aggregation round across processes: pairwise masks are
     # generated per-device from the global client index, and the masked
@@ -113,7 +116,33 @@ def main() -> int:
                                       batch_size=8)
     sserver, sm = sround(sserver, ci, cl, jax.random.key(7))
     sec_loss = float(sm["loss"])
-    sec_digest = param_digest(sserver.params)
+    sec_digest = param_digest(sserver.params, cmesh)
+
+    # DP x TP across processes: weights channel-sharded over a "model"
+    # axis that REALLY spans the hosts — the model axis is built
+    # OUTERMOST ({model: 2, data: 4}) so with row-major device order
+    # each channel pair is (device i, device i+4), one on each process;
+    # row-major (data, model) would pair intra-host neighbors and never
+    # cross DCN. Same workload as the DP section (same init/data/rng),
+    # so its loss must reproduce the DP loss through GSPMD's
+    # cross-process channel gathers.
+    from idc_models_tpu.train.step import place_state
+
+    tpmesh = meshlib.make_mesh({meshlib.MODEL_AXIS: 2,
+                                meshlib.DATA_AXIS: 4})
+    assert len({d.process_index for d in
+                tpmesh.devices[:, 0]}) == num_procs, tpmesh.devices
+    tstate = place_state(tpmesh,
+                         create_train_state(model, opt, jax.random.key(0)))
+    tstep = jit_data_parallel(
+        make_train_step(model, opt, binary_cross_entropy), tpmesh)
+    tx, ty = shard_batch(tpmesh, imgs, labels)
+    tkey = jax.random.key(1)
+    for _ in range(3):
+        tkey, sub = jax.random.split(tkey)
+        tstate, tm = tstep(tstate, tx, ty, sub)
+    tp_digest = param_digest(tstate.params, tpmesh)
+    tp_loss = float(tm["loss"])
 
     # Checkpointed fit across processes: orbax save is a collective, so
     # this hangs (not just fails) if any process skips it. The dir is
@@ -137,7 +166,8 @@ def main() -> int:
           f"eval_loss={em['loss']:.8f} eval_auroc={em['auroc']:.8f} "
           f"fed_loss={fed_loss:.8f} fed_digest={fed_digest:.8f} "
           f"sec_loss={sec_loss:.8f} sec_digest={sec_digest:.8f} "
-          f"ckpt_loss={ckpt_loss:.8f}",
+          f"ckpt_loss={ckpt_loss:.8f} tp_loss={tp_loss:.8f} "
+          f"tp_digest={tp_digest:.8f}",
           flush=True)
     return 0
 
