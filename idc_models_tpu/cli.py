@@ -478,10 +478,16 @@ def _run_fed(ns):
             print(f"{r}, {float(tm['loss']):.4f}, "
                   f"{float(tm['accuracy']):.4f}, {float(em['loss']):.4f}, "
                   f"{float(em['accuracy']):.4f}")
+            dropped = int(tm.get("clients_dropped", 0))
+            if dropped:
+                print(f"[idc_models_tpu] round {r}: dropped {dropped} "
+                      f"client(s) with non-finite updates from the "
+                      f"aggregate", file=sys.stderr)
             if logger:
                 logger.log(event="round", round=r,
                            train_loss=tm["loss"], train_acc=tm["accuracy"],
-                           test_loss=em["loss"], test_acc=em["accuracy"])
+                           test_loss=em["loss"], test_acc=em["accuracy"],
+                           clients_dropped=dropped)
             if server_ckpt is not None:
                 save_checkpoint(server_ckpt, jax.device_get(server))
     if logger:

@@ -153,6 +153,7 @@ def make_fedavg_round(
     local_epochs: int = 1,
     batch_size: int = 32,
     compute_dtype=jnp.float32,
+    drop_nonfinite: bool = True,
 ):
     """Build the jitted one-round FedAvg program.
 
@@ -169,6 +170,13 @@ def make_fedavg_round(
     - ``weights`` [C] are per-client aggregation weights (example counts
       for TFF parity; ones for the reference's unweighted secure server;
       0 drops a client — dead/padding clients cannot poison the round);
+    - ``drop_nonfinite`` (default on) is automatic failure DETECTION on
+      top of that manual dropping: a client whose local update contains
+      any non-finite value (diverged, or fed corrupt data) has its
+      weight forced to 0 inside the round, so it is excluded from the
+      aggregate and the metrics without the caller having to know it
+      died (the reference has no failure detection at all, SURVEY.md §5;
+      `fed_metrics["clients_dropped"]` reports how many were cut);
     - metrics are the example-weighted means of per-client local-training
       loss/accuracy over all local steps (the `train_metrics` half of the
       reference's per-round CSV print, fed_model.py:229).
@@ -191,6 +199,19 @@ def make_fedavg_round(
             local_train, in_axes=(None, None, 0, 0, 0))(
             params, model_state, imgs, labels, rngs)
 
+        dropped = jnp.zeros((), jnp.float32)
+        if drop_nonfinite:
+            # failure detection: cut any client whose update went
+            # non-finite (every vmapped leaf carries the [k] client axis)
+            ok = jnp.ones((k,), bool)
+            for leaf in jax.tree.leaves((new_params, new_model_state,
+                                         losses)):
+                ok &= jnp.all(jnp.isfinite(leaf.reshape(k, -1)), axis=1)
+            dropped = collectives.psum(
+                jnp.sum((weight > 0) & ~ok).astype(jnp.float32),
+                meshlib.CLIENT_AXIS)
+            weight = jnp.where(ok, weight, 0.0)
+
         # Round boundary: the only collectives in the program.
         agg = collectives.weighted_pmean_local(
             {"params": new_params, "model_state": new_model_state},
@@ -201,9 +222,15 @@ def make_fedavg_round(
             weight, meshlib.CLIENT_AXIS)
         # all clients dropped (total weight 0, e.g. every participant
         # failed): keep the incoming global state instead of the
-        # degenerate zero aggregate
+        # degenerate zero aggregate, and report NaN metrics — the
+        # all-zero-weight mean would otherwise read as a perfect 0.0
+        # loss in the round logs while training silently stalls
         any_alive = collectives.psum(
             jnp.maximum(weight, 0.0).sum(), meshlib.CLIENT_AXIS) > 0
+        metrics = jax.tree.map(
+            lambda x: jnp.where(any_alive, x, jnp.float32(jnp.nan)),
+            metrics)
+        metrics["clients_dropped"] = dropped
         agg = jax.tree.map(
             lambda new, old: jnp.where(any_alive, new, old), agg,
             {"params": params, "model_state": model_state})

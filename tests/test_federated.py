@@ -126,6 +126,49 @@ def test_weight_concentration_selects_client(devices):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+def test_nonfinite_client_dropped_automatically(devices):
+    """Failure DETECTION (the reference has none, SURVEY.md §5): a
+    client whose update diverges to non-finite values is cut inside the
+    round — the aggregate equals a manual weight-0 exclusion, and
+    `clients_dropped` reports the cut."""
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    imgs, labels = _client_data(seed=11)
+    poisoned = np.array(imgs)
+    poisoned[3] = np.nan            # client 3's data corrupts its update
+    w = np.full((N_CLIENTS,), imgs.shape[1], np.float32)
+    rng = jax.random.key(13)
+
+    rnd = make_fedavg_round(model, opt, binary_cross_entropy, mesh,
+                            local_epochs=1, batch_size=16)
+    s_auto, m_auto = rnd(initialize_server(model, jax.random.key(0)),
+                         poisoned, labels, w, rng)
+    w_manual = w.copy()
+    w_manual[3] = 0.0
+    s_manual, m_manual = rnd(initialize_server(model, jax.random.key(0)),
+                             poisoned, labels, w_manual, rng)
+
+    assert int(m_auto["clients_dropped"]) == 1
+    assert int(m_manual["clients_dropped"]) == 0   # weight-0 != failure
+    assert np.isfinite(float(m_auto["loss"]))
+    for a, b in zip(jax.tree.leaves(jax.device_get(s_auto.params)),
+                    jax.tree.leaves(jax.device_get(s_manual.params))):
+        np.testing.assert_array_equal(a, b)
+    assert all(np.all(np.isfinite(l))
+               for l in jax.tree.leaves(jax.device_get(s_auto.params)))
+
+    # detection can be disabled: the poisoned client then poisons the
+    # round (documenting why the default is on)
+    rnd_off = make_fedavg_round(model, opt, binary_cross_entropy, mesh,
+                                local_epochs=1, batch_size=16,
+                                drop_nonfinite=False)
+    s_off, _ = rnd_off(initialize_server(model, jax.random.key(0)),
+                       poisoned, labels, w, rng)
+    assert not all(np.all(np.isfinite(l))
+                   for l in jax.tree.leaves(jax.device_get(s_off.params)))
+
+
 def test_client_count_independent_of_device_count(devices):
     """k clients per device: the same 8 clients aggregated on an
     8-device mesh (k=1) and a 4-device mesh (k=2) produce the same
@@ -212,12 +255,27 @@ def test_all_clients_dropped_keeps_server_state(devices):
     before = jax.device_get(server.params)
     rnd = make_fedavg_round(model, opt, binary_cross_entropy, mesh,
                             local_epochs=1, batch_size=imgs.shape[1])
-    server, _ = rnd(server, imgs, labels,
+    server, m = rnd(server, imgs, labels,
                     np.zeros((N_CLIENTS,), np.float32), jax.random.key(1))
     for a, b in zip(jax.tree.leaves(jax.device_get(server.params)),
                     jax.tree.leaves(before)):
         np.testing.assert_array_equal(a, b)
     assert int(server.round) == 1
+    # a round with no contributors must not report a (perfect-looking)
+    # 0.0 loss — it reports NaN
+    assert np.isnan(float(m["loss"])) and np.isnan(float(m["accuracy"]))
+
+    # same, reached through automatic detection: every client diverges,
+    # drop_nonfinite cuts them all, the state is kept and metrics are NaN
+    server2 = initialize_server(model, jax.random.key(0))
+    server2, m2 = rnd(server2, imgs, labels,
+                      np.full((N_CLIENTS,), 16.0, np.float32),
+                      jax.random.key(1))
+    assert int(m2["clients_dropped"]) == N_CLIENTS
+    assert np.isnan(float(m2["loss"]))
+    for a, b in zip(jax.tree.leaves(jax.device_get(server2.params)),
+                    jax.tree.leaves(before)):
+        np.testing.assert_array_equal(a, b)
 
 
 def test_federated_eval(devices):
