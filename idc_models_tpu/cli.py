@@ -573,8 +573,14 @@ def _run_secure(ns):
             print(f"round {r}: train_loss={float(tm['loss']):.4f} "
                   f"test_loss={em['loss']:.4f} acc={em['accuracy']:.4f} "
                   f"auroc={em['auroc']:.4f}")
+            recovered = int(tm.get("clients_recovered", 0))
+            if recovered:
+                print(f"[idc_models_tpu] round {r}: {recovered} "
+                      f"client(s) diverged; their updates were replaced "
+                      f"with the incoming global weights", file=sys.stderr)
             if logger:
                 logger.log(event="round", round=r, train_loss=tm["loss"],
+                           clients_recovered=recovered,
                            **{f"test_{k}": v for k, v in em.items()})
     if logger:
         logger.close()

@@ -144,6 +144,17 @@ def make_local_trainer(
     return local_train
 
 
+def finite_clients(k: int, *trees) -> jax.Array:
+    """[k] bool: which of a device's k vmapped clients produced an
+    all-finite local result (every leaf of `trees` carries the leading
+    [k] client axis). The shared divergence test for the plain round's
+    drop and the secure round's replace."""
+    ok = jnp.ones((k,), bool)
+    for leaf in jax.tree.leaves(trees):
+        ok &= jnp.all(jnp.isfinite(leaf.reshape(k, -1)), axis=1)
+    return ok
+
+
 def make_fedavg_round(
     model: core.Module,
     optimizer: optax.GradientTransformation,
@@ -202,11 +213,8 @@ def make_fedavg_round(
         dropped = jnp.zeros((), jnp.float32)
         if drop_nonfinite:
             # failure detection: cut any client whose update went
-            # non-finite (every vmapped leaf carries the [k] client axis)
-            ok = jnp.ones((k,), bool)
-            for leaf in jax.tree.leaves((new_params, new_model_state,
-                                         losses)):
-                ok &= jnp.all(jnp.isfinite(leaf.reshape(k, -1)), axis=1)
+            # non-finite
+            ok = finite_clients(k, new_params, new_model_state, losses)
             dropped = collectives.psum(
                 jnp.sum((weight > 0) & ~ok).astype(jnp.float32),
                 meshlib.CLIENT_AXIS)

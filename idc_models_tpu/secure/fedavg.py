@@ -41,7 +41,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from idc_models_tpu import collectives
 from idc_models_tpu import mesh as meshlib
-from idc_models_tpu.federated.fedavg import ServerState, make_local_trainer
+from idc_models_tpu.federated.fedavg import (
+    ServerState, finite_clients, make_local_trainer,
+)
 from idc_models_tpu.models import core
 from idc_models_tpu.secure import masking
 from idc_models_tpu.secure.paillier import (
@@ -65,6 +67,7 @@ def make_secure_fedavg_round(
     clip_abs: float = masking.DEFAULT_CLIP_ABS,
     compute_dtype=jnp.float32,
     mask_impl: str = "threefry",
+    recover_nonfinite: bool = True,
 ):
     """Build the jitted one-round secure-FedAvg program.
 
@@ -91,6 +94,18 @@ def make_secure_fedavg_round(
     cross-client sum of clipped (+-clip_abs) values cannot overflow int32
     (`masking.choose_scale_bits`) — overflow would silently corrupt the
     aggregate, so the headroom is budgeted, not assumed.
+
+    ``recover_nonfinite`` (default on) is failure handling for a path
+    where DROPPING a participant is cryptographically hard: removing a
+    client from the unweighted masked mean would leave its pairwise
+    masks uncancelled (full Bonawitz dropout recovery needs
+    secret-shared mask reconstruction — out of scope). Instead, a client
+    whose local update goes non-finite has its update replaced with the
+    incoming global weights BEFORE quantization/masking — a no-op
+    contribution that keeps the mask algebra and the divisor intact —
+    and is excluded from the training metrics;
+    ``metrics["clients_recovered"]`` reports the count. The reference
+    has no failure handling at all (SURVEY.md §5).
     """
     if mask_impl not in ("threefry", "pallas"):
         raise ValueError(f"unknown mask_impl {mask_impl!r}")
@@ -112,6 +127,24 @@ def make_secure_fedavg_round(
             new_params, new_model_state, (losses, accs) = jax.vmap(
                 local_train, in_axes=(None, None, 0, 0, 0))(
                 params, model_state, imgs, labels, rngs)
+
+            ok = jnp.ones((k,), bool)
+            recovered = jnp.zeros((), jnp.float32)
+            if recover_nonfinite:
+                # failure recovery: a diverged client contributes the
+                # incoming global weights instead of garbage (see the
+                # factory docstring — dropping would break the masks)
+                ok = finite_clients(k, new_params, new_model_state, losses)
+                recovered = collectives.psum(
+                    jnp.sum(~ok).astype(jnp.float32), meshlib.CLIENT_AXIS)
+
+                def keep(new, old):
+                    okr = ok.reshape((k,) + (1,) * (new.ndim - 1))
+                    return jnp.where(okr, new, old[None])
+
+                new_params = jax.tree.map(keep, new_params, params)
+                new_model_state = jax.tree.map(keep, new_model_state,
+                                               model_state)
 
             # "First fraction" follows the model's layer order (Keras
             # get_weights() enumeration, secure_fed_model.py:115-121),
@@ -170,11 +203,21 @@ def make_secure_fedavg_round(
                           for f in flags]
             agg_params = jax.tree.unflatten(treedef, agg_leaves)
             agg_state = jax.tree.unflatten(state_def, state_agg)
+            # training metrics over the clients that actually trained
+            # (weighted_pmean_local masks dead clients' NaNs exactly
+            # like the plain round); NaN — not a perfect-looking 0.0 —
+            # if every client diverged
+            alive = collectives.psum(
+                ok.astype(jnp.float32).sum(), meshlib.CLIENT_AXIS)
+            metrics = collectives.weighted_pmean_local(
+                jax.tree.map(
+                    lambda x: jnp.mean(x, axis=tuple(range(1, x.ndim))),
+                    {"loss": losses, "accuracy": accs}),
+                ok.astype(jnp.float32), meshlib.CLIENT_AXIS)
             metrics = jax.tree.map(
-                lambda x: collectives.psum(
-                    jnp.mean(x, axis=tuple(range(1, x.ndim))).sum(),
-                    meshlib.CLIENT_AXIS) / n_clients,
-                {"loss": losses, "accuracy": accs})
+                lambda x: jnp.where(alive > 0, x, jnp.float32(jnp.nan)),
+                metrics)
+            metrics["clients_recovered"] = recovered
             return agg_params, agg_state, metrics
 
         return per_device

@@ -251,6 +251,60 @@ def test_secure_round_matches_plain_round(devices):
                                rtol=1e-5)
 
 
+def test_secure_round_recovers_diverged_client(devices):
+    """Failure recovery on the masked path, where a client cannot simply
+    be dropped (its pairwise masks would stay uncancelled): the diverged
+    client's update is replaced with the incoming global weights before
+    masking. Expected aggregate = (7 healthy updates + old weights) / 8;
+    the healthy updates come from the plain round with the dead client
+    auto-dropped (identical rng derivation, proven by
+    test_secure_round_matches_plain_round)."""
+    mesh = meshlib.client_mesh(N_CLIENTS)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    imgs, labels = _client_data(seed=17)
+    poisoned = np.array(imgs)
+    poisoned[3] = np.nan
+    rng = jax.random.key(23)
+
+    server = initialize_server(model, jax.random.key(0))
+    old_params = jax.device_get(server.params)
+    secure_rnd = make_secure_fedavg_round(
+        model, opt, binary_cross_entropy, mesh, percent=1.0,
+        local_epochs=1, batch_size=16)
+    sa, ma = secure_rnd(server, poisoned, labels, rng)
+    assert int(ma["clients_recovered"]) == 1
+    assert np.isfinite(float(ma["loss"]))
+    assert all(np.all(np.isfinite(l))
+               for l in jax.tree.leaves(jax.device_get(sa.params)))
+
+    # healthy-only mean via the plain round's failure detection
+    plain_rnd = make_fedavg_round(model, opt, binary_cross_entropy, mesh,
+                                  local_epochs=1, batch_size=16)
+    sb, mb = plain_rnd(initialize_server(model, jax.random.key(0)),
+                       poisoned, labels, np.ones((N_CLIENTS,), np.float32),
+                       rng)
+    for a, healthy_mean, old in zip(
+            jax.tree.leaves(jax.device_get(sa.params)),
+            jax.tree.leaves(jax.device_get(sb.params)),
+            jax.tree.leaves(old_params)):
+        want = (healthy_mean * (N_CLIENTS - 1) + old) / N_CLIENTS
+        np.testing.assert_allclose(a, want, atol=5e-6)
+    # metrics average only the clients that actually trained
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]),
+                               rtol=1e-5)
+
+    # recovery can be disabled: the diverged client then poisons the
+    # masked aggregate (why the default is on)
+    rnd_off = make_secure_fedavg_round(
+        model, opt, binary_cross_entropy, mesh, percent=0.5,
+        local_epochs=1, batch_size=16, recover_nonfinite=False)
+    s_off, _ = rnd_off(initialize_server(model, jax.random.key(0)),
+                       poisoned, labels, rng)
+    assert not all(np.all(np.isfinite(l))
+                   for l in jax.tree.leaves(jax.device_get(s_off.params)))
+
+
 def test_secure_round_layout_invariant(devices):
     """k clients per device: the same 8 clients on an 8-device mesh
     (k=1), a 4-device mesh (k=2), and a 1-device mesh (k=8) produce the
