@@ -13,6 +13,7 @@ from idc_models_tpu.train.loop import (  # noqa: F401
     TwoPhaseResult,
     evaluate,
     fit,
+    predict,
     two_phase_fit,
 )
 from idc_models_tpu.train.checkpoint import (  # noqa: F401

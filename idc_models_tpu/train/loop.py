@@ -75,16 +75,9 @@ class Evaluator:
     def __call__(self, state: TrainState, ds: ArrayDataset, *,
                  steps: int | None = None) -> dict[str, float]:
         state = place_state(self.mesh, state)
-        logits_parts = []
-        for x, y, size in prefetch_eval_batches(ds, self.mesh,
-                                                self.batch_size,
-                                                steps=steps):
-            m = self._step(state, x, y)
-            logits = m["logits"]
-            if not logits.is_fully_addressable:
-                logits = self._gather(logits)
-            logits_parts.append(np.asarray(logits)[:size])
-        logits = jnp.asarray(np.concatenate(logits_parts))
+        logits = jnp.asarray(_batched_logits(
+            self.mesh, self._gather, ds, self.batch_size, steps,
+            lambda x, y: self._step(state, x, y)["logits"]))
         # the kept rows are exactly the first len(logits) examples
         labels = jnp.asarray(ds.labels[:len(logits)])
         out = {
@@ -97,6 +90,23 @@ class Evaluator:
         return out
 
 
+def _batched_logits(mesh: Mesh, gather, ds: ArrayDataset, batch_size: int,
+                    steps: int | None, run) -> np.ndarray:
+    """Shared eval/predict logits loop: batches of `ds` through `run(x, y)
+    -> logits` on the sharded pipeline, padding rows dropped, results
+    concatenated in order. `gather` is the identity jit with replicated
+    out_shardings that makes batch-sharded logits fetchable on multi-host
+    meshes (see Evaluator.__init__)."""
+    parts = []
+    for x, y, size in prefetch_eval_batches(ds, mesh, batch_size,
+                                            steps=steps):
+        logits = run(x, y)
+        if not logits.is_fully_addressable:
+            logits = gather(logits)
+        parts.append(np.asarray(logits)[:size])
+    return np.concatenate(parts)
+
+
 def evaluate(model: core.Module, state: TrainState, ds: ArrayDataset,
              loss_fn, mesh: Mesh, *, batch_size: int = 32,
              steps: int | None = None, compute_dtype=jnp.float32,
@@ -105,6 +115,37 @@ def evaluate(model: core.Module, state: TrainState, ds: ArrayDataset,
     ev = Evaluator(model, loss_fn, mesh, batch_size=batch_size,
                    compute_dtype=compute_dtype, with_auroc=with_auroc)
     return ev(state, ds, steps=steps)
+
+
+def predict(model: core.Module, state: TrainState, images, mesh: Mesh, *,
+            batch_size: int = 32, compute_dtype=jnp.float32) -> np.ndarray:
+    """Inference over a batch-sharded dataset: logits for every example,
+    in order (the `model.predict` convenience of the Keras surface the
+    reference's users come from). Runs the same sharded eval pipeline as
+    the Evaluator — transfers overlapped, final batch padded to the mesh
+    and the padding rows dropped — and works on DP, client, and
+    ("data", "model") TP meshes alike."""
+    images = np.asarray(images)
+    if len(images) == 0:
+        # Keras model.predict returns an empty array, not a crash; the
+        # trailing shape comes from an abstract single-example eval
+        # (batch 0 itself would break flatten's reshape(-1) inference)
+        shape = jax.eval_shape(
+            lambda x: model.apply(state.params, state.model_state, x,
+                                  train=False)[0],
+            jax.ShapeDtypeStruct((1,) + images.shape[1:],
+                                 jnp.float32)).shape
+        return np.zeros((0,) + shape[1:], np.float32)
+    ds = ArrayDataset(images, np.zeros((len(images),), np.int32))
+    placed = place_state(mesh, state)
+    step = jit_data_parallel(
+        lambda s, x, y: model.apply(s.params, s.model_state,
+                                    x.astype(compute_dtype),
+                                    train=False)[0].astype(jnp.float32),
+        mesh, donate_state=False)
+    gather = jax.jit(lambda x: x, out_shardings=meshlib.replicated(mesh))
+    return _batched_logits(mesh, gather, ds, batch_size, None,
+                           lambda x, y: step(placed, x, y))
 
 
 def fit(model: core.Module, optimizer: optax.GradientTransformation,

@@ -78,6 +78,27 @@ def test_channel_rule_and_placement():
     assert state.params["head"]["kernel"].sharding.spec == P()
 
 
+def test_predict_matches_direct_apply_on_dp_and_tp_mesh():
+    """train.predict returns every example's logits in order — equal to
+    a direct un-sharded apply — on a DP mesh and a ("data","model") TP
+    mesh, including a final partial batch that needs padding."""
+    from idc_models_tpu.train import create_train_state, predict
+
+    model = small_cnn(10, 3, 1)
+    state = create_train_state(model, rmsprop(1e-3), jax.random.key(0))
+    imgs, _ = synthetic.make_idc_like(70, size=10, seed=5)  # 70 % 16 != 0
+    want, _ = model.apply(state.params, state.model_state,
+                          jnp.asarray(imgs), train=False)
+    for mesh in (meshlib.data_mesh(8), tp.dp_tp_mesh(4)):
+        got = predict(model, state, imgs, mesh, batch_size=16)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+    # empty input returns an empty array with the right trailing shape
+    empty = predict(model, state, imgs[:0], meshlib.data_mesh(8))
+    assert empty.shape == (0,) + want.shape[1:]
+
+
 def test_dp_tp_mesh_validates_degree():
     import pytest
 
