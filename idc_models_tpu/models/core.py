@@ -513,6 +513,73 @@ def count_params(params: Params) -> int:
     return sum(x.size for x in jax.tree.leaves(params))
 
 
+def summary(module: Module, variables: Variables | None = None, *,
+            trainable_mask=None) -> str:
+    """A Keras-`model.summary()`-style table: one row per layer (in
+    `layer_names` order when the module records it, flat param-tree
+    order otherwise) with parameter shapes and counts, plus the
+    trainable/non-trainable totals when a mask is given.
+
+    The explicit-pytree analogue of the inspection surface Keras users
+    lean on (`Sequential.summary()`); purely host-side.
+    """
+    if variables is None:
+        # abstract init: shapes/sizes without allocating a real model
+        # (Variables itself is not a pytree, so trace to a (p, s) pair)
+        p, s = jax.eval_shape(
+            lambda rng: (lambda v: (v.params, v.state))(module.init(rng)),
+            jax.random.key(0))
+        variables = Variables(p, s)
+
+    def leaf_rows(tree, mask):
+        rows: dict[str, list] = {}  # layer -> [n_params, shapes, n_trainable]
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        mask_leaves = (jax.tree.leaves(mask) if mask is not None
+                       else [True] * len(flat))
+        for (path, leaf), trainable in zip(flat, mask_leaves,
+                                           strict=True):
+            keys = tuple(p.key for p in path)
+            layer, var = ".".join(keys[:-1]) or keys[-1], keys[-1]
+            row = rows.setdefault(layer, [0, [], 0])
+            row[0] += leaf.size
+            row[1].append(f"{var}{list(leaf.shape)}")
+            row[2] += leaf.size if trainable else 0
+        return rows
+
+    rows = leaf_rows(variables.params, trainable_mask)
+    state_rows = leaf_rows(variables.state, None)
+    order = list(rows)
+    if module.layer_names:
+        ranked = {n: i for i, n in enumerate(module.layer_names)}
+        order.sort(key=lambda l: ranked.get(l, len(ranked)))
+
+    name_w = max([len(l) for l in order + list(state_rows)] + [5]) + 2
+    lines = [f"Model: {module.name}",
+             f"{'Layer':<{name_w}}{'Params':>10}  Variables"]
+    total = trainable = 0
+    for layer in order:
+        n, shapes, n_train = rows[layer]
+        total += n
+        trainable += n_train
+        suffix = ("" if trainable_mask is None or n_train == n
+                  else "  (frozen)" if n_train == 0
+                  else f"  ({n_train:,} trainable)")
+        lines.append(f"{layer:<{name_w}}{n:>10,}  "
+                     f"{', '.join(shapes)}{suffix}")
+    state_total = 0
+    for layer, (n, shapes, _) in state_rows.items():
+        state_total += n
+        lines.append(f"{layer:<{name_w}}{n:>10,}  "
+                     f"{', '.join(shapes)}  (state)")
+    lines.append(f"Total params: {total:,}")
+    if trainable_mask is not None:
+        lines.append(f"Trainable params: {trainable:,}")
+        lines.append(f"Non-trainable params: {total - trainable:,}")
+    if state_total:
+        lines.append(f"State (BN statistics): {state_total:,}")
+    return "\n".join(lines)
+
+
 def head_only_mask(params: Params):
     """Phase-1 transfer-learning mask: only the "head" subtree trains."""
     return trainability_mask(params, lambda p: p[0] == "head")

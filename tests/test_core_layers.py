@@ -66,6 +66,32 @@ def test_dropout_train_eval():
     assert float(jnp.max(y_tr)) == 2.0
 
 
+def test_summary_counts_and_freeze_annotations():
+    """core.summary: Keras-style table with exact totals; the fine-tune
+    mask's trainable split matches the Keras arithmetic (block5 convs
+    3x(3*3*512*512+512) + head 513 = 7,079,937)."""
+    from idc_models_tpu.models.vgg import fine_tune_mask, vgg16
+
+    s = core.summary(small_cnn(10, 3, 1))
+    assert "Total params: 1,937" in s
+    assert "conv1" in s and "kernel[3, 3, 3, 32]" in s
+
+    model = vgg16(1)
+    variables = model.init(jax.random.key(0))
+    s = core.summary(model, variables,
+                     trainable_mask=fine_tune_mask(variables.params, 15))
+    assert "Total params: 14,715,201" in s      # pinned vs Keras
+    assert "Trainable params: 7,079,937" in s
+    assert "Non-trainable params: 7,635,264" in s
+    assert "(frozen)" in s
+    # layer_names order: block1 before block5 before head
+    lines = s.splitlines()
+    idx = {name: next(i for i, ln in enumerate(lines)
+                      if ln.split() and ln.split()[0].endswith(name))
+           for name in ("block1_conv1", "block5_conv3", "head")}
+    assert idx["block1_conv1"] < idx["block5_conv3"] < idx["head"]
+
+
 def test_small_cnn_forward_and_param_count():
     m = small_cnn(10, 3, 1)
     v = m.init(jax.random.key(0))
