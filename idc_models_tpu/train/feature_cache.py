@@ -34,12 +34,10 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.data.idc import ArrayDataset
-from idc_models_tpu.data.pipeline import prefetch_eval_batches
 from idc_models_tpu.models import core
 from idc_models_tpu.train.step import jit_data_parallel, replicate
 
@@ -153,16 +151,14 @@ def compute_features(plan: FeatureCachePlan, params, model_state,
 
     def fwd(p, s, x):
         h, _ = plan.prefix.apply(p, s, x.astype(compute_dtype), train=False)
-        return {"features": h.astype(jnp.float32)}
+        return h.astype(jnp.float32)
 
     step = jit_data_parallel(lambda st, x, y: fwd(st["p"], st["s"], x),
                              mesh, donate_state=False)
     st = replicate(mesh, {"p": prefix_params, "s": prefix_state})
-    parts = []
     gather = jax.jit(lambda x: x, out_shardings=meshlib.replicated(mesh))
-    for x, y, size in prefetch_eval_batches(ds, mesh, batch_size):
-        out = step(st, x, y)["features"]
-        if not out.is_fully_addressable:
-            out = gather(out)
-        parts.append(np.asarray(out)[:size])
-    return ArrayDataset(np.concatenate(parts), ds.labels)
+    from idc_models_tpu.train.loop import batched_forward
+
+    features = batched_forward(mesh, gather, ds, batch_size, None,
+                               lambda x, y: step(st, x, y))
+    return ArrayDataset(features, ds.labels)

@@ -75,7 +75,7 @@ class Evaluator:
     def __call__(self, state: TrainState, ds: ArrayDataset, *,
                  steps: int | None = None) -> dict[str, float]:
         state = place_state(self.mesh, state)
-        logits = jnp.asarray(_batched_logits(
+        logits = jnp.asarray(batched_forward(
             self.mesh, self._gather, ds, self.batch_size, steps,
             lambda x, y: self._step(state, x, y)["logits"]))
         # the kept rows are exactly the first len(logits) examples
@@ -90,7 +90,7 @@ class Evaluator:
         return out
 
 
-def _batched_logits(mesh: Mesh, gather, ds: ArrayDataset, batch_size: int,
+def batched_forward(mesh: Mesh, gather, ds: ArrayDataset, batch_size: int,
                     steps: int | None, run) -> np.ndarray:
     """Shared eval/predict logits loop: batches of `ds` through `run(x, y)
     -> logits` on the sharded pipeline, padding rows dropped, results
@@ -144,7 +144,7 @@ def predict(model: core.Module, state: TrainState, images, mesh: Mesh, *,
                                     train=False)[0].astype(jnp.float32),
         mesh, donate_state=False)
     gather = jax.jit(lambda x: x, out_shardings=meshlib.replicated(mesh))
-    return _batched_logits(mesh, gather, ds, batch_size, None,
+    return batched_forward(mesh, gather, ds, batch_size, None,
                            lambda x, y: step(placed, x, y))
 
 
