@@ -1,0 +1,32 @@
+"""The examples/ scripts must stay runnable — each is executed as a
+subprocess exactly as the README tells users to run them (they
+self-configure the virtual 8-device CPU pod)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = sorted((Path(__file__).parent.parent / "examples").glob("*.py"))
+# share the suite's persistent compilation cache (conftest.py) with the
+# subprocesses so repeat runs skip the example models' compiles too
+_ENV = dict(
+    os.environ,
+    JAX_COMPILATION_CACHE_DIR=str(Path(__file__).parent / ".jax_cache"),
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5",
+    JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="-1",
+)
+
+
+@pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)
+def test_example_runs(script):
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, timeout=600, env=_ENV)
+    assert r.returncode == 0, f"{script.name} failed:\n{r.stdout}\n{r.stderr}"
+    assert r.stdout.strip(), f"{script.name} printed nothing"
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 3
