@@ -13,8 +13,8 @@ from idc_models_tpu import collectives, mesh as meshlib
 N = 8
 
 
-def _run(body, vals, out_specs=P()):
-    mesh = meshlib.data_mesh(N)
+def _run(body, vals, out_specs=P(), n=N):
+    mesh = meshlib.data_mesh(n)
     f = jax.jit(jax.shard_map(body, mesh=mesh,
                               in_specs=P(meshlib.DATA_AXIS),
                               out_specs=out_specs, check_vma=False))
@@ -139,6 +139,13 @@ def test_ring_psum_equals_psum():
     out2 = _run(body2, vals2)
     np.testing.assert_allclose(np.asarray(out2), vals2.sum(0), rtol=1e-5,
                                atol=1e-5)
+
+    # odd ring sizes (different wrap/ownership patterns than n=8), incl.
+    # a size-1 "ring" (the identity early-return)
+    for n in (3, 5, 1):
+        valsn = rng.normal(size=(n, 11)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(_run(body2, valsn, n=n)),
+                                   valsn.sum(0), rtol=1e-5, atol=1e-5)
 
 
 def test_reduce_scatter_shards_the_sum():
