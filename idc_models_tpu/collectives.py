@@ -112,6 +112,13 @@ def ring_psum(x, axis_name: str):
     Equal to `psum` up to summation order: bit-exact for integer dtypes
     (the secure-aggregation masks rely on int32 wrap-around, which is
     order-free), within fp tolerance for floats.
+
+    Compile-time scaling: the 2(n-1) hops are unrolled in Python, so HLO
+    size (and the dynamic-index `.at[].set` chain) grows linearly with
+    ring size — fine for ICI-scale rings (n <= 64), deliberate for
+    per-hop fusion control. A pod-of-pods ring would want the loop
+    restructured as `lax.fori_loop` over rotating blocks; do that when
+    such a ring becomes a real use case, not before.
     """
     n = lax.axis_size(axis_name)
     if n == 1:

@@ -151,7 +151,9 @@ def finite_clients(k: int, *trees) -> jax.Array:
     drop and the secure round's replace."""
     ok = jnp.ones((k,), bool)
     for leaf in jax.tree.leaves(trees):
-        ok &= jnp.all(jnp.isfinite(leaf.reshape(k, -1)), axis=1)
+        # axis-wise reduce (not reshape(k, -1)): stays well-defined for
+        # zero-size leaves and any trailing shape
+        ok &= jnp.all(jnp.isfinite(leaf), axis=tuple(range(1, leaf.ndim)))
     return ok
 
 

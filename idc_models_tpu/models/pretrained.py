@@ -85,7 +85,11 @@ def merge_pretrained(params, loaded, *, strict: bool = False):
 
 
 _KERAS_SUFFIX = {
-    "kernel:0": "kernel", "bias:0": "bias",
+    "kernel:0": "kernel",
+    # Keras DepthwiseConv2D names its variable depthwise_kernel:0 (the
+    # real keras.applications MobileNetV2 h5 layout), stored (kh, kw, C, 1)
+    "depthwise_kernel:0": "kernel",
+    "bias:0": "bias",
     "gamma:0": "scale", "beta:0": "bias",
     "moving_mean:0": "mean", "moving_variance:0": "var",
 }
@@ -110,7 +114,8 @@ def load_keras_h5(path: str | Path):
                 if key is None:
                     continue
                 layer_name = name.split("/")[-2]
-                if "depthwise" in layer_name and key == "kernel":
+                if key == "kernel" and (suffix == "depthwise_kernel:0"
+                                        or "depthwise" in layer_name):
                     arr = np.transpose(arr, (0, 1, 3, 2))
                 dest = state if suffix.startswith("moving") else params
                 dest.setdefault(layer_name, {})[key] = arr

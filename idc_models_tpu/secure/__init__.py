@@ -2,6 +2,7 @@ from idc_models_tpu.secure.masking import (  # noqa: F401
     choose_scale_bits,
     dequantize,
     first_fraction_selection,
+    first_fraction_selection_weights,
     pairwise_mask,
     quantize,
 )

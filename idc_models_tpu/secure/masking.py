@@ -144,15 +144,41 @@ def first_fraction_selection(tree, percent: float,
     Without it, jax's (alphabetical) flatten order is used; that is a
     well-defined deterministic order but NOT the reference's, so callers
     wanting parity must pass the order.
+
+    For models with mutable state (BatchNorm), use
+    `first_fraction_selection_weights` — the reference slices the FULL
+    get_weights() list, which interleaves moving statistics.
     """
-    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    paths = [tuple(k.key for k in p) for p, _ in paths_and_leaves]
+    return first_fraction_selection_weights(tree, {}, percent,
+                                            layer_order)[0]
+
+
+def first_fraction_selection_weights(params, state, percent: float,
+                                     layer_order: tuple[str, ...] | None
+                                     = None):
+    """`first_fraction_selection` over the FULL get_weights() enumeration:
+    trainable params AND mutable state (BN moving statistics) interleaved
+    in model layer order, which is what the reference actually slices —
+    Keras get_weights() yields gamma, beta, moving_mean, moving_var per
+    BatchNorm layer and `self.weights[:num_enc]` cuts across that list
+    (secure_fed_model.py:115-121). Selecting over params alone would
+    protect a different tensor set for any BN-bearing model.
+
+    Returns ``(params_flags, state_flags)`` boolean pytrees; the count of
+    True flags across both is ``int((P + S) * percent)``. For stateless
+    models this degrades to exactly `first_fraction_selection(params)`.
+    """
+    p_paths = leaf_paths(params)
+    s_paths = leaf_paths(state)
+    paths = p_paths + s_paths
     n_enc = int(len(paths) * percent)
-    ranked = ranked_indices(paths, layer_order)
     flags = [False] * len(paths)
-    for i in ranked[:n_enc]:
+    for i in ranked_indices(paths, layer_order)[:n_enc]:
         flags[i] = True
-    return jax.tree.unflatten(treedef, flags)
+    _, p_def = jax.tree.flatten(params)
+    _, s_def = jax.tree.flatten(state)
+    return (jax.tree.unflatten(p_def, flags[:len(p_paths)]),
+            jax.tree.unflatten(s_def, flags[len(p_paths):]))
 
 
 def ranked_indices(paths: list[tuple[str, ...]],

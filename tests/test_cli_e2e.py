@@ -126,6 +126,16 @@ def test_cli_fed_checkpoint_gate_and_resume(tmp_path, capsys):
     assert "resuming federated training from round 2" in second
     assert "\n2, " in second and "\n1, " not in second
 
+    # the append-only run.jsonl must hold exactly ONE record per round
+    # across both runs (replayed rounds after an every-N checkpoint
+    # resume print but do not re-log)
+    import json
+
+    recs = [json.loads(line) for line in
+            (tmp_path / "logs" / "run.jsonl").read_text().splitlines()]
+    rounds = [r["round"] for r in recs if r.get("event") == "round"]
+    assert sorted(rounds) == [0, 1, 2]
+
 
 def test_cli_secure_fed_masked(capsys):
     out = _run(["secure-fed", "--host-devices", "8",

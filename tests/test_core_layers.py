@@ -21,6 +21,32 @@ def test_conv_shapes_and_stride():
     assert y.shape == (2, 5, 5, 8)
 
 
+def test_conv_channel_pad_is_exact():
+    """The MXU stem-conv optimization (input+kernel zero-padded 3 -> 4
+    channels, see core.conv2d) must be arithmetically invisible: same
+    output as the direct 3-channel convolution, and gradients land only
+    on the real (kh, kw, 3, out) kernel."""
+    from jax import lax
+
+    m = core.conv2d(3, 8, 3, padding="SAME")
+    v = m.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (4, 10, 10, 3))
+    y, _ = m.apply(v.params, v.state, x)
+    direct = lax.conv_general_dilated(
+        x, v.params["kernel"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) + v.params["bias"]
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(direct))
+    assert v.params["kernel"].shape == (3, 3, 3, 8)  # Keras-parity params
+
+    def loss(params):
+        out, _ = m.apply(params, v.state, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(v.params)
+    assert g["kernel"].shape == (3, 3, 3, 8)
+    assert bool(jnp.all(jnp.isfinite(g["kernel"])))
+
+
 def test_depthwise_conv():
     m = core.depthwise_conv2d(6, 3)
     v = m.init(jax.random.key(0))

@@ -146,6 +146,100 @@ def test_keras_h5_depthwise_transpose_and_bn_state(tmp_path):
         state["block_1_depthwise_BN"]["var"], np.full((4,), 3.0))
 
 
+def _full_zoo_h5(model_name, path, seed=7):
+    """Structurally-faithful keras.applications `save_weights` fixture for
+    a zoo backbone: EVERY parameterized layer of the backbone emitted with
+    the real Keras variable names and storage shapes — nested
+    `layer/layer/var:0` dataset paths, `depthwise_kernel:0` stored
+    (kh, kw, C, 1), BN as gamma/beta/moving_mean/moving_variance — so the
+    conversion path is rehearsed against the layout the real ImageNet
+    files use (VERDICT r2 #8; no network egress here, so layout fidelity
+    is the strongest available evidence). Returns the (params, state)
+    trees in OUR naming/shapes for direct comparison after conversion."""
+    from idc_models_tpu.models import registry
+
+    spec = registry.get_model(model_name)
+
+    def init_shapes():
+        v = spec.build(1, 3).init(jax.random.key(0))
+        return {"p": v.params, "s": v.state}
+
+    sh = jax.eval_shape(init_shapes)
+    bb_p, bb_s = sh["p"]["backbone"], sh["s"].get("backbone", {})
+    rng = np.random.default_rng(seed)
+
+    def val(shape, positive=False):
+        a = rng.normal(0.0, 0.05, shape).astype(np.float32)
+        return np.abs(a) + 0.5 if positive else a
+
+    layers: dict = {}
+    expected_p: dict = {}
+    expected_s: dict = {}
+    for layer, leaves in bb_p.items():
+        entry: dict = {}
+        exp: dict = {}
+        if "kernel" in leaves:
+            k = val(tuple(leaves["kernel"].shape))
+            exp["kernel"] = k
+            kh, kw, cin, cout = k.shape
+            if cin == 1 and cout > 3:  # DepthwiseConv2D
+                entry["depthwise_kernel"] = np.transpose(k, (0, 1, 3, 2))
+            else:
+                entry["kernel"] = k
+            if "bias" in leaves:
+                exp["bias"] = entry["bias"] = val(tuple(leaves["bias"].shape))
+        elif "scale" in leaves:  # BatchNorm: gamma/beta + moving stats
+            exp["scale"] = entry["gamma"] = val(tuple(leaves["scale"].shape))
+            exp["bias"] = entry["beta"] = val(tuple(leaves["bias"].shape))
+            st = bb_s[layer]
+            mean = val(tuple(st["mean"].shape))
+            var = val(tuple(st["var"].shape), positive=True)
+            entry["moving_mean"], entry["moving_variance"] = mean, var
+            expected_s[layer] = {"mean": mean, "var": var}
+        layers[layer] = entry
+        expected_p[layer] = exp
+    _write_keras_h5(path, layers)
+    return expected_p, expected_s
+
+
+@pytest.mark.parametrize("name", ["mobilenet_v2", "densenet201"])
+def test_full_zoo_h5_convert_validate_load(tmp_path, capsys, name):
+    """convert-weights on a FULL real-layout h5 for the BN-bearing zoo
+    backbones: zero mismatches on params AND state, and the loaded
+    artifact grafts every tensor bit-exactly (moving stats included)."""
+    from idc_models_tpu import cli
+    from idc_models_tpu.models import registry
+
+    h5 = tmp_path / f"{name}.h5"
+    expected_p, expected_s = _full_zoo_h5(name, h5)
+    npz = tmp_path / f"{name}.npz"
+    assert cli.main(["convert-weights", str(h5), str(npz),
+                     "--model", name]) == 0
+    out = capsys.readouterr().out
+    assert out.count(", 0 mismatches") == 2  # params and state both clean
+
+    model = registry.get_model(name).build(1, 3)
+    variables = model.init(jax.random.key(0))
+    params, state = pretrained.maybe_load_pretrained(
+        variables.params, npz, state=variables.state)
+    for layer, leaves in expected_p.items():
+        for k, v in leaves.items():
+            np.testing.assert_array_equal(
+                np.asarray(params["backbone"][layer][k]), v,
+                err_msg=f"{name} {layer}/{k}")
+    for layer, leaves in expected_s.items():
+        for k, v in leaves.items():
+            np.testing.assert_array_equal(
+                np.asarray(state["backbone"][layer][k]), v,
+                err_msg=f"{name} state {layer}/{k}")
+    # nothing was silently skipped: every backbone leaf came from the h5
+    n_expected = (sum(len(v) for v in expected_p.values())
+                  + sum(len(v) for v in expected_s.values()))
+    n_model = (len(jax.tree.leaves(variables.params["backbone"]))
+               + len(jax.tree.leaves(variables.state["backbone"])))
+    assert n_expected == n_model
+
+
 def test_convert_weights_cli_then_train_from_artifact(tmp_path, capsys):
     """End-to-end C5 parity: convert-weights CLI produces an .npz, and a
     two-phase fit demonstrably starts from it (baseline eval differs from
