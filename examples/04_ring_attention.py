@@ -1,0 +1,43 @@
+"""Long-context ring attention as a library: a sequence 8x longer than
+any single device holds, attended EXACTLY over the "seq" mesh axis.
+
+`python examples/04_ring_attention.py` runs on a virtual 8-device CPU
+pod; the same code on a TPU pod keeps O(T/n) activations per chip and
+rotates K/V blocks over ICI, one ppermute hop per ring step.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from idc_models_tpu import mesh as meshlib
+
+meshlib.force_cpu_pod(8)          # delete this line on real TPU hardware
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu.ring_attention import full_attention, make_ring_attention
+
+B, T, H, D = 2, 512, 4, 32        # T is sharded 8 ways: 64 per device
+mesh = meshlib.seq_mesh()
+rng = np.random.default_rng(0)
+q, k, v = (jnp.asarray(rng.normal(0, 1, (B, T, H, D)), jnp.float32)
+           for _ in range(3))
+
+# place the sequence shards: no device ever holds the full T
+seq_sharding = meshlib.sharding(mesh, None, meshlib.SEQ_AXIS)
+q, k, v = (jax.device_put(x, seq_sharding) for x in (q, k, v))
+
+attn = make_ring_attention(mesh, causal=True)
+out = attn(q, k, v)
+print("ring attention out:", out.shape, "sharded over", out.sharding.spec)
+
+# exact, not approximate: gather and compare against full attention
+ref = full_attention(jax.device_get(q), jax.device_get(k),
+                     jax.device_get(v), causal=True)
+err = float(jnp.max(jnp.abs(out - ref)))
+print(f"max |ring - full| = {err:.2e}")
+assert err < 1e-5

@@ -13,6 +13,8 @@ Axis conventions used throughout the framework:
 - ``"model"``   tensor-parallel axis — channel-wise weight sharding via
   GSPMD (tp.py, CLI --model-parallel); beyond reference parity
 - ``"client"``  federated-client axis — one client per device (reference D3)
+- ``"seq"``     sequence-parallel axis — long-context ring attention
+  (ring_attention.py); beyond reference parity
 """
 
 from __future__ import annotations
@@ -28,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 CLIENT_AXIS = "client"
+SEQ_AXIS = "seq"
 
 
 def force_host_devices(n: int) -> None:
@@ -115,6 +118,16 @@ def client_mesh(n_clients: int | None = None) -> Mesh:
     if n_clients is not None:
         devs = devs[:n_clients]
     return make_mesh({CLIENT_AXIS: len(devs)}, devices=devs)
+
+
+def seq_mesh(n: int | None = None) -> Mesh:
+    """1-D sequence-parallel mesh (axis "seq") over n (default: all)
+    devices — the ring for `ring_attention` over context-sharded
+    sequences."""
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return make_mesh({SEQ_AXIS: len(devs)}, devices=devs)
 
 
 def largest_dividing_mesh(n_clients: int, n_devices: int | None = None) -> int:

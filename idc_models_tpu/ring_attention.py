@@ -1,0 +1,162 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context sequence parallelism for this framework (SURVEY.md §5 names
+the explicit ring schedule as the forward-looking reason `collectives`
+exposes `ppermute`; the reference has no attention at all, so this is
+beyond-parity capability, designed TPU-first):
+
+- the sequence axis is sharded over a 1-D ``"seq"`` mesh
+  (`mesh.seq_mesh`): every device holds the query block it owns for the
+  whole computation plus ONE rotating key/value block;
+- each of the n ring steps computes blockwise attention between the
+  resident queries and the visiting K/V block, folded into a numerically
+  stable online softmax (running max `m`, normalizer `l`, weighted
+  accumulator `acc` — the flash-attention recurrence), then passes the
+  K/V block to the next neighbor with a single `ppermute` hop riding ICI;
+- per-device memory: q/k/v/acc are O(T/n), plus ONE [B,H,T/n,T/n] score
+  tile alive per ring step (the blockwise tiling here is across devices,
+  not within a block — tile the inner block with a Pallas flash kernel
+  if local blocks grow past ~8k); a sequence n times longer than one
+  device could hold still attends exactly, with compute and
+  communication overlapped by XLA's async collectives.
+
+Causal throughput caveat: with the plain contiguous layout device i owns
+queries that can see only blocks 0..i, yet every device executes all n
+block steps in SPMD lockstep, so ~half the causal FLOPs land on fully
+masked blocks (p == 0) and the ring's wall-clock is set by the last
+device. The known fix is a striped ("zigzag") sequence layout — device i
+holding stripes i and 2n-1-i balances visible work — kept as future work
+and called out here so nobody sizes a causal run assuming 2x better.
+
+The loop is a `lax.fori_loop`, so the traced program is O(1) in ring
+size (one hop + one block-attention in the body; ring_psum's unrolled
+form documents why that matters for compile time).  The result is
+bit-for-bit independent of ring size in exact arithmetic and matches
+single-device full attention to fp tolerance — pinned by tests,
+including gradients (`jax.grad` flows through `ppermute` and
+`fori_loop` natively).
+
+Causal masking uses GLOBAL positions: device i's queries sit at offset
+i*T_local, and after s rotations it is visiting the K/V block of device
+(i - s) mod n, so the mask depends only on (axis_index, step) — no
+position tensors are communicated.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from idc_models_tpu import collectives
+from idc_models_tpu import mesh as meshlib
+
+shard_map = jax.shard_map
+
+
+# Masked scores use a large finite negative instead of -inf: exp() of it
+# is exactly 0.0 in f32 (no NaN-producing inf arithmetic on the backward
+# pass), and the one pathological case — the FIRST visited block fully
+# masked, making p momentarily exp(0)=1 — self-heals because the next
+# unmasked block's corr = exp(_MASKED - real_max) = 0 wipes the bogus
+# partial sums. Causal masking guarantees every query eventually sees an
+# unmasked block (its own position).
+_MASKED = -1e30
+
+
+def _block_attend(q, k, v, m, l, acc, *, scale, mask=None):
+    """One online-softmax update of (m, l, acc) with a visiting K/V block.
+
+    q [B,Tq,H,D]; k,v [B,Tk,H,D]; m,l [B,H,Tq]; acc [B,Tq,H,D].
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, _MASKED)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    p = jnp.exp(scores - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = (acc * jnp.transpose(corr, (0, 2, 1))[..., None]
+               + jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                            preferred_element_type=jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def full_attention(q, k, v, *, causal: bool = False, scale: float | None
+                   = None):
+    """Single-device reference: softmax(q k^T / sqrt(d)) v, [B,T,H,D]."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk",
+                        q.astype(jnp.float32), k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
+                        causal: bool = False, scale: float | None = None):
+    """Build ``fn(q, k, v) -> out`` with q/k/v/out [B, T, H, D] sharded on
+    T over `axis`; jitted, exact (not approximate) attention."""
+    n = mesh.shape[axis]
+
+    def per_device(q, k, v):
+        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+        me = collectives.axis_index(axis)
+        b, t_local, h, d = q.shape
+        qf = q.astype(jnp.float32)
+        m0 = jnp.full((b, h, t_local), _MASKED, jnp.float32)
+        l0 = jnp.zeros((b, h, t_local), jnp.float32)
+        acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+        perm = collectives.ring_perm(n)
+
+        def body(s, carry):
+            kc, vc, m, l, acc = carry
+            mask = None
+            if causal:
+                # after s hops we hold the block of device (me - s) mod n
+                kv_dev = jnp.mod(me - s, n)
+                qpos = me * t_local + jnp.arange(t_local)
+                kpos = kv_dev * t_local + jnp.arange(t_local)
+                mask = qpos[:, None] >= kpos[None, :]  # [Tq, Tk]
+                mask = mask[None, None]
+            m, l, acc = _block_attend(qf, kc.astype(jnp.float32),
+                                      vc.astype(jnp.float32), m, l, acc,
+                                      scale=scale_, mask=mask)
+            # one neighbor hop per step; the last hop returns the blocks
+            # to their owners (harmless, keeps the loop body uniform)
+            kc = collectives.ppermute(kc, axis, perm)
+            vc = collectives.ppermute(vc, axis, perm)
+            return kc, vc, m, l, acc
+
+        _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+        norm = jnp.transpose(l, (0, 2, 1))[..., None]
+        return (acc / jnp.maximum(norm, 1e-37)).astype(q.dtype)
+
+    spec = P(None, axis, None, None)
+    mapped = shard_map(per_device, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return jax.jit(mapped)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
+                   causal: bool = False, scale: float | None = None):
+    """One-shot convenience wrapper around `make_ring_attention`.
+
+    For hot loops build the function once with `make_ring_attention`
+    (the jit cache keys on the python callable identity)."""
+    fn = _cached_ring(mesh, axis, causal, scale)
+    return fn(q, k, v)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_ring(mesh, axis, causal, scale):
+    return make_ring_attention(mesh, axis=axis, causal=causal, scale=scale)
