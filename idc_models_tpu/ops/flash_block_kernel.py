@@ -1,0 +1,197 @@
+"""Pallas TPU kernel: fused flash-attention block update for the ring.
+
+`ring_attention._block_attend` is the ring's hot op: per visiting K/V
+block it materializes a [B,H,Tq,Tk] score tensor in HBM, then separate
+max/exp/matmul passes re-read it. This kernel fuses the whole online-
+softmax update — scores, running max `m`, normalizer `l`, accumulator
+`acc` — into one grid cell per (batch, head, q-tile, k-chunk), with the
+K axis innermost so the output refs carry the recurrence across chunks:
+scores never leave VMEM, and the only HBM traffic is q/k/v in and
+(m, l, acc) out. That converts the per-step score memory from O(Tq*Tk)
+HBM to one [q-tile, k-chunk] VMEM tile, which is what lets local blocks
+grow past the jnp path's comfort zone (the module docstring of
+ring_attention.py states the (T/n)^2 caveat this kernel removes on the
+forward).
+
+Semantics are EXACTLY `_block_attend`'s recurrence (same _MASKED
+sentinel, same self-healing first-block property); the causal mask is
+reconstructed inside the kernel from two scalar offsets (global q / kv
+block starts) — no mask tensor is built or shipped.
+
+Measured on one TPU v5 lite chip (causal, B=1 H=8 D=64 bf16, ring of 1
+so t_local == T; 20 chained calls per timing window so the tunneled
+runtime's ~90 ms dispatch overhead is amortized out): t_local=4096
+even (7.7 vs 8.1 ms/call), 8192 1.15x (10.7 vs 12.3 ms), 16384 1.52x
+(26.0 vs 39.4 ms) — the jnp path's t_local^2 f32 score tensor goes
+HBM-bound exactly where the fused kernel keeps scores in VMEM. The
+kernel is the right choice once t_local reaches the many-thousands;
+`block_impl="jnp"` stays the default for the moderate blocks typical
+of many-device rings. Gradients: the
+public `flash_block_update` carries a custom_vjp whose backward
+recomputes the block with the plain-jnp reference and differentiates
+that, so `jax.grad` through a ring using this kernel works and matches
+the jnp path (pinned in tests; interpret mode covers CPU). Be precise
+about what that buys: the BACKWARD materializes the block's
+[B,H,Tq,Tk] score tensor in HBM — the same per-step memory as the jnp
+path — so the VMEM-resident scores are a FORWARD/inference win; a
+blockwise flash backward kernel is the known follow-up if training at
+very long local blocks matters.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from idc_models_tpu.ring_attention import _MASKED, _block_attend
+
+TILE_MIN = 128   # hard floor: Mosaic tile alignment
+REP = 128        # lane replication width for the per-query scalars m/l
+
+
+def _pick_tile(t, prefer):
+    for cand in prefer:
+        if t % cand == 0:
+            return cand
+    return 0
+
+
+def _kernel(off_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
+            om_ref, ol_ref, oacc_ref, *, scale, causal, tq, ck):
+    """One (q-tile, k-chunk) cell. The K axis is the INNERMOST grid dim,
+    so the output refs act as the online-softmax carry across k-chunks
+    (revisited blocks stay resident in VMEM); only one [TQ, CK] score
+    tile and one [CK, D] K/V chunk are ever live — VMEM use is O(tiles),
+    independent of the local block length."""
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _seed_carry():
+        om_ref[0, 0] = m_ref[0, 0]
+        ol_ref[0, 0] = l_ref[0, 0]
+        oacc_ref[0, 0] = acc_ref[0, 0]
+
+    q = q_ref[0, 0]                    # [TQ, D]
+    # m/l ride with REP(=128) identical lanes (the layout Mosaic accepts
+    # for per-query scalars); arithmetic uses the [TQ, 1] column slice
+    # so the score chunk width CK is free to differ from REP
+    m = om_ref[0, 0][:, 0:1]           # [TQ, 1]
+    l = ol_ref[0, 0][:, 0:1]
+    acc = oacc_ref[0, 0]               # [TQ, D]
+    k = k_ref[0, 0]                    # [CK, D]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [TQ, CK]
+    if causal:
+        q_pos = (off_ref[0] + iq * tq
+                 + jax.lax.broadcasted_iota(jnp.int32, (tq, ck), 0))
+        k_pos = (off_ref[1] + ik * ck
+                 + jax.lax.broadcasted_iota(jnp.int32, (tq, ck), 1))
+        s = jnp.where(q_pos >= k_pos, s, _MASKED)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [TQ, 1]
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    om_ref[0, 0] = jnp.broadcast_to(m_new, (tq, REP))
+    ol_ref[0, 0] = jnp.broadcast_to(
+        l * corr + jnp.sum(p, axis=-1, keepdims=True), (tq, REP))
+    oacc_ref[0, 0] = acc * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _pallas_impl(q, k, v, m, l, acc, offsets, *, scale, causal, interpret):
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    # bigger chunks amortize grid overhead (measured: a 128x128 grid of
+    # cells loses to the jnp path; 512-wide K chunks win at T=8k)
+    tq = _pick_tile(t_q, (256, 128))
+    ck = _pick_tile(t_k, (512, 256, 128))
+    if not tq or not ck:
+        raise ValueError(
+            f"flash block kernel needs T_local multiples of {TILE_MIN} "
+            f"(got q {t_q}, k {t_k}); use the jnp block impl instead")
+    n_q = t_q // tq
+    n_k = t_k // ck
+    # K is the innermost (fastest) grid dim: the out refs carry (m, l,
+    # acc) across its iterations — the flash accumulation pattern
+    grid = (b, h, n_q, n_k)
+    kern = functools.partial(_kernel, scale=float(scale),
+                             causal=bool(causal), tq=tq, ck=ck)
+    # Mosaic wants the last two BLOCK dims (8, 128)-aligned or equal to
+    # the array dims: everything is laid out [B, H, T, D] (blocks
+    # (1, 1, T-tile, D)), and the per-query scalars m/l travel as
+    # [B, H, T, 128] with identical lanes (the layout the official TPU
+    # flash kernels use); lane 0 is peeled back off on the way out.
+    bht = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # [B,T,H,D]->[B,H,T,D]
+    rep = lambda x: jnp.broadcast_to(x[..., None], x.shape + (REP,))
+    q_spec = pl.BlockSpec((1, 1, tq, d),
+                          lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, ck, d),
+                           lambda bi, hi, qi, ki: (bi, hi, ki, 0))
+    ml_spec = pl.BlockSpec((1, 1, tq, REP),
+                           lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    om, ol, oacc = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            q_spec, kv_spec, kv_spec,
+            ml_spec, ml_spec, q_spec,
+        ],
+        out_specs=[ml_spec, ml_spec, q_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t_q, REP), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, t_q, REP), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, t_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offsets.astype(jnp.int32), bht(q.astype(jnp.float32)),
+      bht(k.astype(jnp.float32)), bht(v.astype(jnp.float32)),
+      rep(m), rep(l), bht(acc))
+    return (om[..., 0], ol[..., 0], jnp.transpose(oacc, (0, 2, 1, 3)))
+
+
+def reference_impl(q, k, v, m, l, acc, offsets, *, scale, causal):
+    """The jnp recurrence — delegates to ring_attention's
+    `_block_attend` (ONE implementation of the math, so the two block
+    impls cannot silently diverge), building the mask from the same two
+    offsets the kernel uses."""
+    mask = None
+    if causal:
+        q_pos = offsets[0] + jnp.arange(q.shape[1])
+        k_pos = offsets[1] + jnp.arange(k.shape[1])
+        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+    return _block_attend(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32), m, l, acc, scale=scale,
+                         mask=mask)
+
+
+def make_flash_block_update(*, scale, causal, interpret=False):
+    """Differentiable fused block update: forward runs the Pallas kernel,
+    backward rematerializes through `reference_impl` (flash tradeoff)."""
+
+    @jax.custom_vjp
+    def update(q, k, v, m, l, acc, offsets):
+        return _pallas_impl(q, k, v, m, l, acc, offsets, scale=scale,
+                            causal=causal, interpret=interpret)
+
+    def fwd(q, k, v, m, l, acc, offsets):
+        return update(q, k, v, m, l, acc, offsets), (q, k, v, m, l, acc,
+                                                     offsets)
+
+    def bwd(res, g):
+        q, k, v, m, l, acc, offsets = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_, m_, l_, acc_: reference_impl(
+                q_, k_, v_, m_, l_, acc_, offsets, scale=scale,
+                causal=causal),
+            q, k, v, m, l, acc)
+        return vjp(g) + (None,)
+
+    update.defvjp(fwd, bwd)
+    return update

@@ -144,6 +144,29 @@ def main() -> int:
     tp_digest = param_digest(tstate.params, tpmesh)
     tp_loss = float(tm["loss"])
 
+    # Ring attention across processes: the "seq" ring spans both hosts,
+    # so the per-step K/V ppermute hop between device 3 and device 4
+    # rides the DCN stand-in link; the result must equal single-device
+    # full attention computed from the (identical) host copies.
+    from idc_models_tpu.ring_attention import (
+        full_attention, make_ring_attention,
+    )
+
+    rng_sp = np.random.default_rng(11)
+    sq, sk, sv = (jnp.asarray(rng_sp.normal(0, 1, (2, 32, 2, 8)),
+                              jnp.float32) for _ in range(3))
+    smesh = meshlib.seq_mesh()
+    ssh = meshlib.sharding(smesh, None, meshlib.SEQ_AXIS)
+    qs = meshlib.put_with_sharding(sq, ssh)
+    ks = meshlib.put_with_sharding(sk, ssh)
+    vs = meshlib.put_with_sharding(sv, ssh)
+    sp_out = make_ring_attention(smesh, causal=True)(qs, ks, vs)
+    sp_digest = float(jax.jit(
+        lambda t: jnp.sum(t.astype(jnp.float32)),
+        out_shardings=meshlib.replicated(smesh))(sp_out))
+    ref_digest = float(jnp.sum(full_attention(sq, sk, sv, causal=True)))
+    assert abs(sp_digest - ref_digest) < 1e-3, (sp_digest, ref_digest)
+
     # Checkpointed fit across processes: orbax save is a collective, so
     # this hangs (not just fails) if any process skips it. The dir is
     # shared (same host in this stand-in, like GCS/NFS on a real pod).
@@ -167,7 +190,7 @@ def main() -> int:
           f"fed_loss={fed_loss:.8f} fed_digest={fed_digest:.8f} "
           f"sec_loss={sec_loss:.8f} sec_digest={sec_digest:.8f} "
           f"ckpt_loss={ckpt_loss:.8f} tp_loss={tp_loss:.8f} "
-          f"tp_digest={tp_digest:.8f}",
+          f"tp_digest={tp_digest:.8f} sp_digest={sp_digest:.8f}",
           flush=True)
     return 0
 

@@ -1,0 +1,106 @@
+"""The fused flash block kernel == the jnp online-softmax recurrence,
+standalone and inside the ring, values and gradients (interpret mode on
+the CPU mesh; the same kernel compiles for real on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.ops import flash_block_kernel as fbk
+from idc_models_tpu.ring_attention import full_attention, make_ring_attention
+
+B, T, H, D = 2, 256, 2, 32
+
+
+def _inputs(seed=0, t_q=T, t_k=T):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(0, 1, (B, t_q, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (B, t_k, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (B, t_k, H, D)), jnp.float32)
+    # a mid-stream carry (as if one block was already folded in), so the
+    # test covers the corr-rescale path, not just the fresh-start one
+    m = jnp.asarray(rng.normal(0, 1, (B, H, t_q)), jnp.float32)
+    l = jnp.asarray(rng.uniform(0.5, 2.0, (B, H, t_q)), jnp.float32)
+    acc = jnp.asarray(rng.normal(0, 1, (B, t_q, H, D)), jnp.float32)
+    return q, k, v, m, l, acc
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_matches_reference(causal):
+    q, k, v, m, l, acc = _inputs()
+    offsets = jnp.asarray([128, 0], jnp.int32)
+    upd = fbk.make_flash_block_update(scale=D ** -0.5, causal=causal,
+                                      interpret=True)
+    got = upd(q, k, v, m, l, acc, offsets)
+    want = fbk.reference_impl(q, k, v, m, l, acc, offsets,
+                              scale=D ** -0.5, causal=causal)
+    for g, w, name in zip(got, want, ("m", "l", "acc")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)
+
+
+def test_kernel_gradients_match_reference():
+    q, k, v, m, l, acc = _inputs(seed=2)
+    offsets = jnp.asarray([0, 0], jnp.int32)
+    upd = fbk.make_flash_block_update(scale=D ** -0.5, causal=True,
+                                      interpret=True)
+
+    def loss_of(fn):
+        def loss(q, k, v):
+            m2, l2, a2 = fn(q, k, v, m, l, acc, offsets)
+            return jnp.sum(a2 ** 2) + jnp.sum(l2 ** 2) + jnp.sum(m2)
+        return loss
+
+    ref = lambda *a: fbk.reference_impl(*a, scale=D ** -0.5, causal=True)
+    g_k = jax.grad(loss_of(upd), (0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_of(ref), (0, 1, 2))(q, k, v)
+    # the kernel's chunked forward and the reference differ by fp
+    # reassociation; those tiny output deltas feed the cotangents, so
+    # the comparison is to fp tolerance, not bitwise
+    for a, b, name in zip(g_k, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_with_pallas_blocks_matches_full(devices, causal):
+    """T=1024 over 8 devices -> t_local=128 (the kernel's tile): the
+    pallas-block ring must equal full attention AND the jnp-block ring."""
+    rng = np.random.default_rng(5)
+    t = 1024
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (1, t, 2, 32)), jnp.float32)
+               for _ in range(3))
+    mesh = meshlib.seq_mesh(8)
+    out_p = make_ring_attention(mesh, causal=causal,
+                                block_impl="pallas")(q, k, v)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_pallas_gradients(devices):
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (1, 1024, 2, 32)),
+                           jnp.float32) for _ in range(3))
+    mesh = meshlib.seq_mesh(8)
+    ring_p = make_ring_attention(mesh, causal=True, block_impl="pallas")
+    g_p = jax.grad(lambda a, b, c: jnp.sum(ring_p(a, b, c) ** 2),
+                   (0, 1, 2))(q, k, v)
+    g_f = jax.grad(lambda a, b, c: jnp.sum(
+        full_attention(a, b, c, causal=True) ** 2), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_p, g_f, "qkv"):
+        assert bool(jnp.all(jnp.isfinite(a))), name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_non_tile_multiple_rejected(devices):
+    q, k, v, m, l, acc = _inputs(t_q=96, t_k=96)
+    upd = fbk.make_flash_block_update(scale=D ** -0.5, causal=False,
+                                      interpret=True)
+    with pytest.raises(ValueError, match="multiples of 128"):
+        upd(q, k, v, m, l, acc, jnp.asarray([0, 0], jnp.int32))
