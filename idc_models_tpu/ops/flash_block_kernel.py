@@ -7,7 +7,8 @@ softmax update — scores, running max `m`, normalizer `l`, accumulator
 `acc` — into one grid cell per (batch, head, q-tile, k-chunk), with the
 K axis innermost so the output refs carry the recurrence across chunks:
 scores never leave VMEM, and the only HBM traffic is q/k/v in and
-(m, l, acc) out. That converts the per-step score memory from O(Tq*Tk)
+(m, l, acc) out — q/k/v ship in their OWN dtype (bf16 stays bf16 in
+HBM; each tile upcasts to f32 on load). That converts the per-step score memory from O(Tq*Tk)
 HBM to one [q-tile, k-chunk] VMEM tile, which is what lets local blocks
 grow past the jnp path's comfort zone (the module docstring of
 ring_attention.py states the (T/n)^2 caveat this kernel removes on the
@@ -21,8 +22,8 @@ block starts) — no mask tensor is built or shipped.
 Measured on one TPU v5 lite chip (causal, B=1 H=8 D=64 bf16, ring of 1
 so t_local == T; 20 chained calls per timing window so the tunneled
 runtime's ~90 ms dispatch overhead is amortized out): t_local=4096
-even (7.7 vs 8.1 ms/call), 8192 1.15x (10.7 vs 12.3 ms), 16384 1.52x
-(26.0 vs 39.4 ms) — the jnp path's t_local^2 f32 score tensor goes
+1.07x (6.2 vs 6.7 ms/call), 8192 1.41x (10.2 vs 14.4 ms), 16384 1.62x
+(25.5 vs 41.4 ms) — the jnp path's t_local^2 f32 score tensor goes
 HBM-bound exactly where the fused kernel keeps scores in VMEM. The
 kernel is the right choice once t_local reaches the many-thousands;
 `block_impl="jnp"` stays the default for the moderate blocks typical
@@ -47,7 +48,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from idc_models_tpu.ring_attention import _MASKED, _block_attend
+from idc_models_tpu.ring_attention import (
+    _MASKED, _block_attend, causal_block_mask,
+)
 
 TILE_MIN = 128   # hard floor: Mosaic tile alignment
 REP = 128        # lane replication width for the per-query scalars m/l
@@ -75,15 +78,15 @@ def _kernel(off_ref, q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
         ol_ref[0, 0] = l_ref[0, 0]
         oacc_ref[0, 0] = acc_ref[0, 0]
 
-    q = q_ref[0, 0]                    # [TQ, D]
+    q = q_ref[0, 0].astype(jnp.float32)   # [TQ, D] (tile-local upcast)
     # m/l ride with REP(=128) identical lanes (the layout Mosaic accepts
     # for per-query scalars); arithmetic uses the [TQ, 1] column slice
     # so the score chunk width CK is free to differ from REP
     m = om_ref[0, 0][:, 0:1]           # [TQ, 1]
     l = ol_ref[0, 0][:, 0:1]
     acc = oacc_ref[0, 0]               # [TQ, D]
-    k = k_ref[0, 0]                    # [CK, D]
-    v = v_ref[0, 0]
+    k = k_ref[0, 0].astype(jnp.float32)   # [CK, D]
+    v = v_ref[0, 0].astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [TQ, CK]
@@ -150,8 +153,7 @@ def _pallas_impl(q, k, v, m, l, acc, offsets, *, scale, causal, interpret):
             jax.ShapeDtypeStruct((b, h, t_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(offsets.astype(jnp.int32), bht(q.astype(jnp.float32)),
-      bht(k.astype(jnp.float32)), bht(v.astype(jnp.float32)),
+    )(offsets.astype(jnp.int32), bht(q), bht(k), bht(v),
       rep(m), rep(l), bht(acc))
     return (om[..., 0], ol[..., 0], jnp.transpose(oacc, (0, 2, 1, 3)))
 
@@ -161,11 +163,8 @@ def reference_impl(q, k, v, m, l, acc, offsets, *, scale, causal):
     `_block_attend` (ONE implementation of the math, so the two block
     impls cannot silently diverge), building the mask from the same two
     offsets the kernel uses."""
-    mask = None
-    if causal:
-        q_pos = offsets[0] + jnp.arange(q.shape[1])
-        k_pos = offsets[1] + jnp.arange(k.shape[1])
-        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+    mask = (causal_block_mask(q.shape[1], k.shape[1], offsets[0],
+                              offsets[1]) if causal else None)
     return _block_attend(q.astype(jnp.float32), k.astype(jnp.float32),
                          v.astype(jnp.float32), m, l, acc, scale=scale,
                          mask=mask)

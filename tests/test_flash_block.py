@@ -79,6 +79,10 @@ def test_ring_with_pallas_blocks_matches_full(devices, causal):
     ref = full_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+    out_j = make_ring_attention(mesh, causal=causal,
+                                block_impl="jnp")(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_j),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_ring_pallas_gradients(devices):
