@@ -22,13 +22,25 @@ beyond-parity capability, designed TPU-first):
   times longer than one device could hold attends exactly, with compute
   and communication overlapped by XLA's async collectives.
 
-Causal throughput caveat: with the plain contiguous layout device i owns
-queries that can see only blocks 0..i, yet every device executes all n
-block steps in SPMD lockstep, so ~half the causal FLOPs land on fully
-masked blocks (p == 0) and the ring's wall-clock is set by the last
-device. The known fix is a striped ("zigzag") sequence layout — device i
-holding stripes i and 2n-1-i balances visible work — kept as future work
-and called out here so nobody sizes a causal run assuming 2x better.
+Causal layouts: with the plain contiguous layout device i owns queries
+that can see only blocks 0..i, yet every device executes all n block
+steps in SPMD lockstep, so ~half the causal FLOPs land on fully masked
+blocks (p == 0) and the ring's wall-clock is set by the last device.
+``layout="zigzag"`` fixes this: the sequence is split into 2n stripes
+and device i holds stripes (i, 2n-1-i) — permute inputs with
+`to_zigzag` and invert the output with `from_zigzag`. Under that layout
+every device's causal schedule is IDENTICAL and dense: three
+quarter-block attends on its own block (two stripe diagonals plus the
+always-visible hi-vs-lo quarter; the lo-vs-hi quarter is provably empty
+and never computed), then exactly two fully-visible half-attends per
+ring hop. Total causal work drops from 4n quarter-blocks per device to
+2n+1 — the ~2x the contiguous docstring used to concede. Measured on a
+v5 lite chip (emulated ring-of-8 per-device schedule, pallas blocks,
+`experiments/zigzag_bench.py`): 1.52x at t_local=4096, 1.74x at 8192,
+1.76x at 16384 vs the contiguous schedule (ideal 4n/(2n+1) = 1.88x at
+n=8); the executed-FLOP ratio is gated by an XLA-cost-analysis test.
+Without `causal` the layout changes nothing (dense attention is
+permutation-equivariant), so zigzag only matters for causal runs.
 
 The loop is a `lax.fori_loop`, so the traced program is O(1) in ring
 size (one hop + one block-attention in the body; ring_psum's unrolled
@@ -98,6 +110,40 @@ def causal_block_mask(t_q, t_k, q_offset, k_offset):
     return (q_pos[:, None] >= k_pos[None, :])[None, None]
 
 
+def zigzag_indices(t: int, n: int):
+    """Global gather indices realizing the zigzag layout: the sequence is
+    cut into 2n equal stripes and device i's contiguous shard becomes
+    [stripe i, stripe 2n-1-i]. `t` must divide by 2n. Returns a numpy
+    int array `p` with ``x_zig = x.take(p, axis=seq)``; the layout is an
+    involution-free permutation whose inverse is `argsort(p)`
+    (`from_zigzag`)."""
+    import numpy as np
+
+    if t % (2 * n):
+        raise ValueError(f"sequence length {t} not divisible by 2*{n}")
+    sw = t // (2 * n)
+    stripes = np.arange(t).reshape(2 * n, sw)
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    return stripes[order].reshape(-1)
+
+
+def to_zigzag(x, n: int, *, axis: int = 1):
+    """Permute a sequence axis into the zigzag layout for an n-device
+    ring (see `zigzag_indices`)."""
+    return jnp.take(x, jnp.asarray(zigzag_indices(x.shape[axis], n)),
+                    axis=axis)
+
+
+def from_zigzag(x, n: int, *, axis: int = 1):
+    """Inverse of `to_zigzag` — restore natural sequence order."""
+    import numpy as np
+
+    inv = np.argsort(zigzag_indices(x.shape[axis], n))
+    return jnp.take(x, jnp.asarray(inv), axis=axis)
+
+
 def full_attention(q, k, v, *, causal: bool = False, scale: float | None
                    = None):
     """Single-device reference: softmax(q k^T / sqrt(d)) v, [B,T,H,D]."""
@@ -116,7 +162,9 @@ def full_attention(q, k, v, *, causal: bool = False, scale: float | None
 
 def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
                         causal: bool = False, scale: float | None = None,
-                        block_impl: str = "jnp"):
+                        block_impl: str = "jnp",
+                        layout: str = "contiguous",
+                        unroll: bool = False):
     """Build ``fn(q, k, v) -> out`` with q/k/v/out [B, T, H, D] sharded on
     T over `axis`; jitted, exact (not approximate) attention.
 
@@ -124,12 +172,49 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     plain jnp ops (XLA-fused, fine up to moderate local block lengths);
     ``"pallas"`` runs the fused flash kernel
     (`ops.flash_block_kernel`) — scores stay in VMEM, removing the
-    per-step (T/n)^2 HBM score tensor; requires T/n a multiple of 128,
-    interpret mode off-TPU, gradients via rematerialized backward.
+    per-step (T/n)^2 HBM score tensor; requires T/n a multiple of 128
+    (256 under ``layout="zigzag"``, whose kernel calls operate on
+    half-blocks), interpret mode off-TPU, gradients via rematerialized
+    backward.
+
+    ``layout``: how the global sequence maps to device shards.
+    ``"contiguous"`` (default) is the identity; ``"zigzag"`` expects
+    inputs pre-permuted with `to_zigzag(x, n)` and returns the output in
+    the same zigzag order — for `causal` runs it executes the balanced
+    schedule from the module docstring (~2x fewer FLOPs, every device
+    identical work). Positions in the causal mask are always GLOBAL
+    (natural-order) positions, so zigzag output equals
+    `to_zigzag(full_attention(...))` exactly.
+
+    ``unroll``: replace the `fori_loop` with a Python loop over the n
+    ring steps. The traced program grows O(n), but XLA can then overlap
+    step s+1's `ppermute` hop with step s's block compute (a while-loop
+    body is a scheduling barrier between iterations) — worth it for
+    ICI-scale rings; it is also what lets XLA cost analysis see the full
+    schedule (the FLOP-ratio gate in tests uses it).
     """
     if block_impl not in ("jnp", "pallas"):
         raise ValueError(f"unknown block_impl {block_impl!r}")
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
     n = mesh.shape[axis]
+
+    def interp_mode():
+        # interpret keys on the MESH's devices, not the process default
+        # backend — a CPU-device mesh on a TPU-backed host must
+        # interpret, not lower Mosaic for CPU
+        return mesh.devices.flat[0].platform not in ("tpu", "axon")
+
+    def run_steps(body, carry, start):
+        if unroll:
+            for s in range(start, n):
+                carry = body(s, carry)
+            return carry
+        return lax.fori_loop(start, n, body, carry)
+
+    def finalize(l, acc, dtype):
+        norm = jnp.transpose(l, (0, 2, 1))[..., None]
+        return (acc / jnp.maximum(norm, 1e-37)).astype(dtype)
 
     def per_device(q, k, v):
         scale_ = scale if scale is not None else q.shape[-1] ** -0.5
@@ -143,13 +228,8 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         if block_impl == "pallas":
             from idc_models_tpu.ops import flash_block_kernel as fbk
 
-            # interpret keys on the MESH's devices, not the process
-            # default backend — a CPU-device mesh on a TPU-backed host
-            # must interpret, not lower Mosaic for CPU
-            interp = (mesh.devices.flat[0].platform
-                      not in ("tpu", "axon"))
             flash_upd = fbk.make_flash_block_update(
-                scale=scale_, causal=causal, interpret=interp)
+                scale=scale_, causal=causal, interpret=interp_mode())
 
         def body(s, carry):
             kc, vc, m, l, acc = carry
@@ -175,26 +255,141 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
             vc = collectives.ppermute(vc, axis, perm)
             return kc, vc, m, l, acc
 
-        _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
-        norm = jnp.transpose(l, (0, 2, 1))[..., None]
-        return (acc / jnp.maximum(norm, 1e-37)).astype(q.dtype)
+        _, _, m, l, acc = run_steps(body, (k, v, m0, l0, acc0), 0)
+        return finalize(l, acc, q.dtype)
 
+    def per_device_zigzag(q, k, v):
+        """Balanced causal schedule for the zigzag layout: the local block
+        is [stripe me, stripe 2n-1-me]; per hop exactly two of the four
+        stripe-pair quarters are (fully) visible, so both are computed
+        dense and UNMASKED — all masking lives in the two step-0 stripe
+        diagonals. Every device runs the identical 2n+1-quarter program,
+        so no device waits on a longer peer."""
+        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+        me = collectives.axis_index(axis)
+        b, t_local, h, d = q.shape
+        if t_local % 2:
+            raise ValueError(
+                f"zigzag layout needs an even local block, got {t_local}")
+        th = t_local // 2
+        perm = collectives.ring_perm(n)
+        if block_impl == "pallas":
+            from idc_models_tpu.ops import flash_block_kernel as fbk
+
+            if th % fbk.TILE_MIN:
+                raise ValueError(
+                    f"zigzag + pallas operates on half-blocks: t_local "
+                    f"{t_local} gives quarters of {th}, need a multiple "
+                    f"of {fbk.TILE_MIN} (t_local % 256 == 0)")
+            flash_diag = fbk.make_flash_block_update(
+                scale=scale_, causal=True, interpret=interp_mode())
+            flash_full = fbk.make_flash_block_update(
+                scale=scale_, causal=False, interpret=interp_mode())
+            qq = q  # native dtype through the kernel (per-tile upcast)
+        else:
+            qq = q.astype(jnp.float32)
+        q_lo, q_hi = qq[:, :th], qq[:, th:]
+        lo_off = me * th                    # global start of stripe me
+        hi_off = (2 * n - 1 - me) * th      # ... and of stripe 2n-1-me
+
+        def quarter(m, l, acc, row0, qh, kh, vh, q_off, k_off, diag):
+            """Fold one [th, th] quarter attend into carry rows
+            [row0, row0+th); row0 may be a traced scalar (attend B picks
+            its half at run time)."""
+            ms = lax.dynamic_slice(m, (0, 0, row0), (b, h, th))
+            ls = lax.dynamic_slice(l, (0, 0, row0), (b, h, th))
+            accs = lax.dynamic_slice(acc, (0, row0, 0, 0), (b, th, h, d))
+            if block_impl == "pallas":
+                upd = flash_diag if diag else flash_full
+                offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                                  jnp.asarray(k_off, jnp.int32)])
+                ms, ls, accs = upd(qh, kh, vh, ms, ls, accs, offs)
+            else:
+                mask = (causal_block_mask(th, th, q_off, k_off)
+                        if diag else None)
+                ms, ls, accs = _block_attend(
+                    qh, kh.astype(jnp.float32), vh.astype(jnp.float32),
+                    ms, ls, accs, scale=scale_, mask=mask)
+            return (lax.dynamic_update_slice(m, ms, (0, 0, row0)),
+                    lax.dynamic_update_slice(l, ls, (0, 0, row0)),
+                    lax.dynamic_update_slice(acc, accs, (0, row0, 0, 0)))
+
+        m = jnp.full((b, h, t_local), _MASKED, jnp.float32)
+        l = jnp.zeros((b, h, t_local), jnp.float32)
+        acc = jnp.zeros((b, t_local, h, d), jnp.float32)
+
+        # Step 0, own block: both stripe diagonals plus the always-
+        # visible (hi queries, lo keys) quarter; (lo, hi) is provably
+        # empty (lo stripe < n <= hi stripe) and never computed. Every
+        # diagonal row sees its own position, so no row's first fold is
+        # fully masked — the contiguous path's self-healing case cannot
+        # even arise here.
+        k_lo, k_hi = k[:, :th], k[:, th:]
+        v_lo, v_hi = v[:, :th], v[:, th:]
+        m, l, acc = quarter(m, l, acc, 0, q_lo, k_lo, v_lo,
+                            lo_off, lo_off, True)
+        m, l, acc = quarter(m, l, acc, th, q_hi, k_hi, v_hi,
+                            hi_off, hi_off, True)
+        m, l, acc = quarter(m, l, acc, th, q_hi, k_lo, v_lo,
+                            hi_off, lo_off, False)
+
+        def body(s, carry):
+            kc, vc, m, l, acc = carry
+            kc = collectives.ppermute(kc, axis, perm)
+            vc = collectives.ppermute(vc, axis, perm)
+            c = jnp.mod(me - s, n)          # owner of the visiting block
+            kc_lo, kc_hi = kc[:, :th], kc[:, th:]
+            vc_lo, vc_hi = vc[:, :th], vc[:, th:]
+            c_lo = c * th
+            c_hi = (2 * n - 1 - c) * th
+            # A: hi queries vs visiting lo stripe — always fully visible
+            # (hi stripe >= n > any lo stripe index).
+            m, l, acc = quarter(m, l, acc, th, q_hi, kc_lo, vc_lo,
+                                hi_off, c_lo, False)
+            # B: exactly one of (lo q, lo k) / (hi q, hi k) is fully
+            # visible — (lo, lo) iff c < me, else (hi, hi) since
+            # 2n-1-c < 2n-1-me iff c > me; the other is fully masked and
+            # skipped. Selected by value so the loop body stays uniform.
+            cond = c < me
+            qs = jnp.where(cond, q_lo, q_hi)
+            ks = jnp.where(cond, kc_lo, kc_hi)
+            vs = jnp.where(cond, vc_lo, vc_hi)
+            row0 = jnp.where(cond, 0, th)
+            qo = jnp.where(cond, lo_off, hi_off)
+            ko = jnp.where(cond, c_lo, c_hi)
+            m, l, acc = quarter(m, l, acc, row0, qs, ks, vs, qo, ko,
+                                False)
+            return kc, vc, m, l, acc
+
+        _, _, m, l, acc = run_steps(body, (k, v, m, l, acc), 1)
+        return finalize(l, acc, q.dtype)
+
+    body_fn = per_device_zigzag if (layout == "zigzag" and causal) \
+        else per_device
     spec = P(None, axis, None, None)
-    mapped = shard_map(per_device, mesh=mesh, in_specs=(spec, spec, spec),
+    mapped = shard_map(body_fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return jax.jit(mapped)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
-                   causal: bool = False, scale: float | None = None):
-    """One-shot convenience wrapper around `make_ring_attention`.
+                   causal: bool = False, scale: float | None = None,
+                   block_impl: str = "jnp", layout: str = "contiguous",
+                   unroll: bool = False):
+    """One-shot convenience wrapper around `make_ring_attention` —
+    every knob of the builder (the pallas fast path, the zigzag causal
+    layout, unrolling) is reachable from here too.
 
     For hot loops build the function once with `make_ring_attention`
     (the jit cache keys on the python callable identity)."""
-    fn = _cached_ring(mesh, axis, causal, scale)
+    fn = _cached_ring(mesh, axis, causal, scale, block_impl, layout,
+                      unroll)
     return fn(q, k, v)
 
 
 @functools.lru_cache(maxsize=32)
-def _cached_ring(mesh, axis, causal, scale):
-    return make_ring_attention(mesh, axis=axis, causal=causal, scale=scale)
+def _cached_ring(mesh, axis, causal, scale, block_impl="jnp",
+                 layout="contiguous", unroll=False):
+    return make_ring_attention(mesh, axis=axis, causal=causal, scale=scale,
+                               block_impl=block_impl, layout=layout,
+                               unroll=unroll)
