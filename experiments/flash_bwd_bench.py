@@ -1,0 +1,82 @@
+"""Training-step (fwd+bwd) timing for the ring block impls on the chip.
+
+Companion to ring_attention_bench.py (forward-only): this times
+`grad(sum(ring(q,k,v)^2))` — the full forward + backward — ring of 1
+(t_local == T) so the single chip runs the whole schedule. The pallas
+path now uses the blockwise flash backward (no [T, T] HBM tensor in
+either direction); the jnp path's autodiff rematerializes the f32
+score tensor, which at T=16384 is 8.6 GB (B=1, H=8) and may not fit
+alongside its backward — an OOM there is itself the datapoint.
+
+Methodology as ring_attention_bench.py: chained calls (dq, renormed,
+feeds back as q), best-of-3 windows, host fetch of a dependent scalar.
+Run: python experiments/flash_bwd_bench.py
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.ring_attention import make_ring_attention
+
+B, H, D = 1, 8, 64
+ITERS = 6
+
+
+def main():
+    mesh = meshlib.seq_mesh(1)
+    rows = []
+    for T in (4096, 8192, 16384):
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(rng.normal(0, 1, (B, T, H, D)),
+                               jnp.bfloat16) for _ in range(3))
+        row = {"t_local": T}
+        for impl in ("jnp", "pallas"):
+            ring = make_ring_attention(mesh, causal=True, block_impl=impl)
+            gfn = jax.jit(jax.grad(
+                lambda a, b, c: jnp.sum(ring(a, b, c)
+                                        .astype(jnp.float32) ** 2)))
+            try:
+                dq = gfn(q, k, v)
+                _ = float(jnp.sum(dq.astype(jnp.float32)))
+                best = 1e9
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    a = q
+                    for _ in range(ITERS):
+                        dq = gfn(a, k, v)
+                        scl = jax.lax.rsqrt(
+                            jnp.mean(dq.astype(jnp.float32) ** 2) + 1e-9)
+                        a = (dq.astype(jnp.float32) * scl
+                             ).astype(jnp.bfloat16)
+                    _ = float(jnp.sum(a.astype(jnp.float32)))
+                    best = min(best, (time.perf_counter() - t0) / ITERS)
+                row[impl] = best
+            except Exception as e:  # noqa: BLE001 — OOM is a datapoint
+                row[impl] = None
+                row[f"{impl}_error"] = type(e).__name__
+        rows.append(row)
+        jn, pa = row.get("jnp"), row.get("pallas")
+        msg = (f"t_local={T}: fwd+bwd jnp "
+               f"{jn*1e3:.1f} ms" if jn else f"t_local={T}: fwd+bwd jnp "
+               f"{row.get('jnp_error')}")
+        msg += (f"  pallas {pa*1e3:.1f} ms" if pa
+                else f"  pallas {row.get('pallas_error')}")
+        if jn and pa:
+            msg += f"  speedup {jn/pa:.2f}x"
+        print(msg, flush=True)
+    out = pathlib.Path(__file__).parent / "flash_bwd_bench.jsonl"
+    with out.open("w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

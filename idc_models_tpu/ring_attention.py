@@ -170,12 +170,17 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
 
     ``block_impl``: ``"jnp"`` (default) computes each visiting block with
     plain jnp ops (XLA-fused, fine up to moderate local block lengths);
-    ``"pallas"`` runs the fused flash kernel
-    (`ops.flash_block_kernel`) — scores stay in VMEM, removing the
-    per-step (T/n)^2 HBM score tensor; requires T/n a multiple of 128
-    (256 under ``layout="zigzag"``, whose kernel calls operate on
-    half-blocks), interpret mode off-TPU, gradients via rematerialized
-    backward.
+    ``"pallas"`` runs the fused flash kernels
+    (`ops.flash_block_kernel`) — scores stay in VMEM in BOTH
+    directions: the forward ring folds blocks with the fused online-
+    softmax kernel, and the whole per-device ring carries a custom_vjp
+    whose backward is a second ring built on the blockwise flash
+    backward (`make_flash_block_grads`: p recomputed per tile from the
+    saved logsumexp; dk/dv accumulators ride the ring home). No
+    [t_local, t_local] tensor exists in HBM forward or backward —
+    asserted by a jaxpr test. Requires T/n a multiple of 128 (256 under
+    ``layout="zigzag"``, whose kernel calls operate on half-blocks),
+    interpret mode off-TPU.
 
     ``layout``: how the global sequence maps to device shards.
     ``"contiguous"`` (default) is the identity; ``"zigzag"`` expects
@@ -216,56 +221,70 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         norm = jnp.transpose(l, (0, 2, 1))[..., None]
         return (acc / jnp.maximum(norm, 1e-37)).astype(dtype)
 
-    def per_device(q, k, v):
-        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    def make_attend(scale_, use_pallas):
+        """The one block-fold primitive both layouts walk their
+        schedules with: ``attend(qh, kh, vh, m, l, acc, q_off, k_off,
+        masked)`` folds one visiting block (or quarter) into the
+        carry; `masked` applies causal masking by the two GLOBAL block
+        offsets. jnp flavor: dense `_block_attend` (per-call f32
+        upcast). pallas flavor: fused flash kernel, native dtypes in
+        HBM, per-tile upcast."""
+        if use_pallas:
+            from idc_models_tpu.ops import flash_block_kernel as fbk
+
+            upds = {masked: fbk.make_flash_block_update(
+                        scale=scale_, causal=masked,
+                        interpret=interp_mode())
+                    for masked in (False, True)}
+
+            def attend(qh, kh, vh, m, l, acc, q_off, k_off, masked):
+                offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                                  jnp.asarray(k_off, jnp.int32)])
+                return upds[masked](qh, kh, vh, m, l, acc, offs)
+        else:
+            def attend(qh, kh, vh, m, l, acc, q_off, k_off, masked):
+                mask = (causal_block_mask(qh.shape[1], kh.shape[1],
+                                          q_off, k_off)
+                        if masked else None)
+                return _block_attend(
+                    qh.astype(jnp.float32), kh.astype(jnp.float32),
+                    vh.astype(jnp.float32), m, l, acc, scale=scale_,
+                    mask=mask)
+        return attend
+
+    def contiguous_fold(q, k, v, attend):
+        """The contiguous ring walk: n lockstep steps, each folding the
+        visiting full block then hopping it on (the last hop returns
+        blocks to their owners — harmless, keeps the body uniform).
+        Returns the raw (m, l, acc) carry so callers can keep L."""
         me = collectives.axis_index(axis)
         b, t_local, h, d = q.shape
-        qf = q.astype(jnp.float32)
+        perm = collectives.ring_perm(n)
         m0 = jnp.full((b, h, t_local), _MASKED, jnp.float32)
         l0 = jnp.zeros((b, h, t_local), jnp.float32)
         acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
-        perm = collectives.ring_perm(n)
-        if block_impl == "pallas":
-            from idc_models_tpu.ops import flash_block_kernel as fbk
-
-            flash_upd = fbk.make_flash_block_update(
-                scale=scale_, causal=causal, interpret=interp_mode())
 
         def body(s, carry):
             kc, vc, m, l, acc = carry
             # after s hops we hold the block of device (me - s) mod n
             kv_dev = jnp.mod(me - s, n)
-            if block_impl == "pallas":
-                # native dtypes straight through: bf16 q/k/v stay bf16
-                # in HBM and over the ppermute hops; the kernel upcasts
-                # per VMEM tile
-                offsets = jnp.stack([me * t_local, kv_dev * t_local])
-                m, l, acc = flash_upd(q, kc, vc, m, l, acc, offsets)
-            else:
-                mask = (causal_block_mask(t_local, t_local,
-                                          me * t_local,
-                                          kv_dev * t_local)
-                        if causal else None)
-                m, l, acc = _block_attend(qf, kc.astype(jnp.float32),
-                                          vc.astype(jnp.float32), m, l,
-                                          acc, scale=scale_, mask=mask)
-            # one neighbor hop per step; the last hop returns the blocks
-            # to their owners (harmless, keeps the loop body uniform)
+            m, l, acc = attend(q, kc, vc, m, l, acc, me * t_local,
+                               kv_dev * t_local, causal)
             kc = collectives.ppermute(kc, axis, perm)
             vc = collectives.ppermute(vc, axis, perm)
             return kc, vc, m, l, acc
 
         _, _, m, l, acc = run_steps(body, (k, v, m0, l0, acc0), 0)
-        return finalize(l, acc, q.dtype)
+        return m, l, acc
 
-    def per_device_zigzag(q, k, v):
-        """Balanced causal schedule for the zigzag layout: the local block
-        is [stripe me, stripe 2n-1-me]; per hop exactly two of the four
-        stripe-pair quarters are (fully) visible, so both are computed
-        dense and UNMASKED — all masking lives in the two step-0 stripe
-        diagonals. Every device runs the identical 2n+1-quarter program,
-        so no device waits on a longer peer."""
-        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    def zigzag_fold(q, k, v, attend):
+        """The balanced causal schedule (one copy, walked by both block
+        impls): the local block is [stripe me, stripe 2n-1-me]; per hop
+        exactly two of the four stripe-pair quarters are (fully)
+        visible, so both are computed dense and UNMASKED — all masking
+        lives in the two step-0 stripe diagonals. Every device runs the
+        identical 2n+1-quarter program, so no device waits on a longer
+        peer. Returns the raw (m, l, acc) carry."""
         me = collectives.axis_index(axis)
         b, t_local, h, d = q.shape
         if t_local % 2:
@@ -273,22 +292,7 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
                 f"zigzag layout needs an even local block, got {t_local}")
         th = t_local // 2
         perm = collectives.ring_perm(n)
-        if block_impl == "pallas":
-            from idc_models_tpu.ops import flash_block_kernel as fbk
-
-            if th % fbk.TILE_MIN:
-                raise ValueError(
-                    f"zigzag + pallas operates on half-blocks: t_local "
-                    f"{t_local} gives quarters of {th}, need a multiple "
-                    f"of {fbk.TILE_MIN} (t_local % 256 == 0)")
-            flash_diag = fbk.make_flash_block_update(
-                scale=scale_, causal=True, interpret=interp_mode())
-            flash_full = fbk.make_flash_block_update(
-                scale=scale_, causal=False, interpret=interp_mode())
-            qq = q  # native dtype through the kernel (per-tile upcast)
-        else:
-            qq = q.astype(jnp.float32)
-        q_lo, q_hi = qq[:, :th], qq[:, th:]
+        q_lo, q_hi = q[:, :th], q[:, th:]
         lo_off = me * th                    # global start of stripe me
         hi_off = (2 * n - 1 - me) * th      # ... and of stripe 2n-1-me
 
@@ -299,17 +303,8 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
             ms = lax.dynamic_slice(m, (0, 0, row0), (b, h, th))
             ls = lax.dynamic_slice(l, (0, 0, row0), (b, h, th))
             accs = lax.dynamic_slice(acc, (0, row0, 0, 0), (b, th, h, d))
-            if block_impl == "pallas":
-                upd = flash_diag if diag else flash_full
-                offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
-                                  jnp.asarray(k_off, jnp.int32)])
-                ms, ls, accs = upd(qh, kh, vh, ms, ls, accs, offs)
-            else:
-                mask = (causal_block_mask(th, th, q_off, k_off)
-                        if diag else None)
-                ms, ls, accs = _block_attend(
-                    qh, kh.astype(jnp.float32), vh.astype(jnp.float32),
-                    ms, ls, accs, scale=scale_, mask=mask)
+            ms, ls, accs = attend(qh, kh, vh, ms, ls, accs, q_off,
+                                  k_off, diag)
             return (lax.dynamic_update_slice(m, ms, (0, 0, row0)),
                     lax.dynamic_update_slice(l, ls, (0, 0, row0)),
                     lax.dynamic_update_slice(acc, accs, (0, row0, 0, 0)))
@@ -362,10 +357,214 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
             return kc, vc, m, l, acc
 
         _, _, m, l, acc = run_steps(body, (k, v, m, l, acc), 1)
+        return m, l, acc
+
+    def per_device(q, k, v):
+        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+        _, l, acc = contiguous_fold(q, k, v, make_attend(scale_, False))
         return finalize(l, acc, q.dtype)
 
-    body_fn = per_device_zigzag if (layout == "zigzag" and causal) \
-        else per_device
+    def per_device_pallas(q, k, v):
+        """Contiguous pallas ring with a ring-level custom_vjp: the
+        forward folds visiting blocks with the fused flash kernel
+        (native dtypes in HBM, per-tile upcast) and saves only
+        (q, k, v, out, L); the backward is a SECOND ring driving the
+        blockwise flash backward kernels, with the dk/dv accumulators
+        riding the ppermute hops back to their owners. Per-device
+        memory stays O(t_local) in both directions."""
+        from idc_models_tpu.ops import flash_block_kernel as fbk
+
+        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+        b, t_local, h, d = q.shape
+        perm = collectives.ring_perm(n)
+        attend = make_attend(scale_, True)
+        gfn = fbk.make_flash_block_grads(
+            scale=scale_, causal=causal, interpret=interp_mode())
+
+        def offsets_for(me, s):
+            return jnp.stack([me * t_local,
+                              jnp.mod(me - s, n) * t_local])
+
+        def fwd_loop(q, k, v):
+            return contiguous_fold(q, k, v, attend)
+
+        # me/axis_index is taken INSIDE fwd/bwd (both run under the
+        # shard_map trace) — custom_vjp must not close over tracers.
+        @jax.custom_vjp
+        def attn(q, k, v):
+            _, l, acc = fwd_loop(q, k, v)
+            return finalize(l, acc, q.dtype)
+
+        def attn_fwd(q, k, v):
+            m, l, acc = fwd_loop(q, k, v)
+            out = finalize(l, acc, q.dtype)
+            L = m + jnp.log(jnp.maximum(l, 1e-37))
+            return out, (q, k, v, out, L)
+
+        def attn_bwd(res, dout):
+            q, k, v, out, L = res
+            me = collectives.axis_index(axis)
+            Dr = jnp.einsum("bqhd,bqhd->bhq", dout.astype(jnp.float32),
+                            out.astype(jnp.float32))
+
+            def body(s, carry):
+                kc, vc, dk, dv, dq = carry
+                dqp, dkb, dvb = gfn(q, kc, vc, dout, L, Dr,
+                                    offsets_for(me, s))
+                dq = dq + dqp
+                dk = dk + dkb
+                dv = dv + dvb
+                # dk/dv travel WITH their block; after the n-th hop the
+                # fully-accumulated grads are back at the block's owner
+                kc, vc, dk, dv = (collectives.ppermute(x, axis, perm)
+                                  for x in (kc, vc, dk, dv))
+                return kc, vc, dk, dv, dq
+
+            zf = lambda x: jnp.zeros(x.shape, jnp.float32)
+            _, _, dk, dv, dq = run_steps(
+                body, (k, v, zf(k), zf(v), zf(q)), 0)
+            return (dq.astype(q.dtype), dk.astype(k.dtype),
+                    dv.astype(v.dtype))
+
+        attn.defvjp(attn_fwd, attn_bwd)
+        return attn(q, k, v)
+
+    def per_device_zigzag(q, k, v):
+        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+        _, l, acc = zigzag_fold(q, k, v, make_attend(scale_, False))
+        return finalize(l, acc, q.dtype)
+
+    def per_device_zigzag_pallas(q, k, v):
+        """Zigzag schedule on the fused kernels, ring-level custom_vjp.
+
+        Forward: the per_device_zigzag quarter schedule, each quarter a
+        fused flash kernel call (diag quarters causal, hop quarters
+        unmasked). Backward: the SAME schedule re-walked with the
+        blockwise flash backward kernels — each quarter contributes a
+        dq update at its query half and dk/dv updates at the visiting
+        half, with dk/dv riding the hops; one trailing hop delivers the
+        accumulators to their owners (the forward's n-1 hops leave them
+        one device short)."""
+        from idc_models_tpu.ops import flash_block_kernel as fbk
+
+        scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+        b, t_local, h, d = q.shape
+        if t_local % 2:
+            raise ValueError(
+                f"zigzag layout needs an even local block, got {t_local}")
+        th = t_local // 2
+        if th % fbk.TILE_MIN:
+            raise ValueError(
+                f"zigzag + pallas operates on half-blocks: t_local "
+                f"{t_local} gives quarters of {th}, need a multiple "
+                f"of {fbk.TILE_MIN} (t_local % 256 == 0)")
+        perm = collectives.ring_perm(n)
+        interp = interp_mode()
+        attend = make_attend(scale_, True)
+        g_diag = fbk.make_flash_block_grads(
+            scale=scale_, causal=True, interpret=interp)
+        g_full = fbk.make_flash_block_grads(
+            scale=scale_, causal=False, interpret=interp)
+
+        def stripe_offs(me):
+            return me * th, (2 * n - 1 - me) * th
+
+        def fwd_loop(q, k, v):
+            return zigzag_fold(q, k, v, attend)
+
+        @jax.custom_vjp
+        def attn(q, k, v):
+            _, l, acc = fwd_loop(q, k, v)
+            return finalize(l, acc, q.dtype)
+
+        def attn_fwd(q, k, v):
+            m, l, acc = fwd_loop(q, k, v)
+            out = finalize(l, acc, q.dtype)
+            L = m + jnp.log(jnp.maximum(l, 1e-37))
+            return out, (q, k, v, out, L)
+
+        def attn_bwd(res, dout):
+            q, k, v, out, L = res
+            me = collectives.axis_index(axis)
+            lo_off, hi_off = stripe_offs(me)
+            Dr = jnp.einsum("bqhd,bqhd->bhq", dout.astype(jnp.float32),
+                            out.astype(jnp.float32))
+
+            def gquarter(dq, dk, dv, kc, vc, row0, krow0, q_off, k_off,
+                         diag):
+                """One quarter's grad contributions: rows [row0,
+                row0+th) of q/dout/L/D against the [krow0, krow0+th)
+                half of the visiting block."""
+                qs = lax.dynamic_slice(q, (0, row0, 0, 0),
+                                       (b, th, h, d))
+                dos = lax.dynamic_slice(dout, (0, row0, 0, 0),
+                                        (b, th, h, d))
+                Ls = lax.dynamic_slice(L, (0, 0, row0), (b, h, th))
+                Ds = lax.dynamic_slice(Dr, (0, 0, row0), (b, h, th))
+                ks = lax.dynamic_slice(kc, (0, krow0, 0, 0),
+                                       (b, th, h, d))
+                vs = lax.dynamic_slice(vc, (0, krow0, 0, 0),
+                                       (b, th, h, d))
+                offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                                  jnp.asarray(k_off, jnp.int32)])
+                gf = g_diag if diag else g_full
+                dqp, dkb, dvb = gf(qs, ks, vs, dos, Ls, Ds, offs)
+                dq = lax.dynamic_update_slice(
+                    dq, lax.dynamic_slice(dq, (0, row0, 0, 0),
+                                          (b, th, h, d)) + dqp,
+                    (0, row0, 0, 0))
+                dk = lax.dynamic_update_slice(
+                    dk, lax.dynamic_slice(dk, (0, krow0, 0, 0),
+                                          (b, th, h, d)) + dkb,
+                    (0, krow0, 0, 0))
+                dv = lax.dynamic_update_slice(
+                    dv, lax.dynamic_slice(dv, (0, krow0, 0, 0),
+                                          (b, th, h, d)) + dvb,
+                    (0, krow0, 0, 0))
+                return dq, dk, dv
+
+            zf = lambda x: jnp.zeros(x.shape, jnp.float32)
+            dq, dk, dv = zf(q), zf(k), zf(v)
+            dq, dk, dv = gquarter(dq, dk, dv, k, v, 0, 0,
+                                  lo_off, lo_off, True)
+            dq, dk, dv = gquarter(dq, dk, dv, k, v, th, th,
+                                  hi_off, hi_off, True)
+            dq, dk, dv = gquarter(dq, dk, dv, k, v, th, 0,
+                                  hi_off, lo_off, False)
+
+            def body(s, carry):
+                kc, vc, dk, dv, dq = carry
+                kc, vc, dk, dv = (collectives.ppermute(x, axis, perm)
+                                  for x in (kc, vc, dk, dv))
+                c = jnp.mod(me - s, n)
+                c_lo, c_hi = c * th, (2 * n - 1 - c) * th
+                dq, dk, dv = gquarter(dq, dk, dv, kc, vc, th, 0,
+                                      hi_off, c_lo, False)
+                cond = c < me
+                start = jnp.where(cond, 0, th)
+                qo = jnp.where(cond, lo_off, hi_off)
+                ko = jnp.where(cond, c_lo, c_hi)
+                dq, dk, dv = gquarter(dq, dk, dv, kc, vc, start, start,
+                                      qo, ko, False)
+                return kc, vc, dk, dv, dq
+
+            _, _, dk, dv, dq = run_steps(body, (k, v, dk, dv, dq), 1)
+            # the forward's n-1 hops leave each accumulator one device
+            # before its owner; one trailing hop delivers it
+            dk = collectives.ppermute(dk, axis, perm)
+            dv = collectives.ppermute(dv, axis, perm)
+            return (dq.astype(q.dtype), dk.astype(k.dtype),
+                    dv.astype(v.dtype))
+
+        attn.defvjp(attn_fwd, attn_bwd)
+        return attn(q, k, v)
+
+    if layout == "zigzag" and causal:
+        body_fn = (per_device_zigzag_pallas if block_impl == "pallas"
+                   else per_device_zigzag)
+    else:
+        body_fn = (per_device_pallas if block_impl == "pallas"
+                   else per_device)
     spec = P(None, axis, None, None)
     mapped = shard_map(body_fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
