@@ -25,6 +25,25 @@ def make_idc_like(n: int, size: int = 50, *, seed: int = 0,
     return np.clip(imgs, 0.0, 1.0), labels
 
 
+def make_sequence_task(n: int, seq_len: int, features: int = 8, *,
+                       seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Position-sensitive sequence task for the attention classifier:
+    noise sequences with one marker spike on channel 0; label = whether
+    the marker sits in the LATE half. GAP over raw inputs cannot solve
+    it (the marker's value is position-independent) — the model must
+    move positional information into the pooled features, which is
+    exactly what attention + learned positions provide.
+
+    Returns (x [n, seq_len, features] float32, labels [n] int32).
+    """
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0.0, 0.3, (n, seq_len, features)).astype(np.float32)
+    pos = rng.integers(0, seq_len, n)
+    x[np.arange(n), pos, 0] += 3.0
+    labels = (pos >= seq_len // 2).astype(np.int32)
+    return x, labels
+
+
 def make_cifar_like(n: int, *, seed: int = 0,
                     num_classes: int = 10) -> tuple[np.ndarray, np.ndarray]:
     """32x32x3 images with class-dependent mean shift, labels in [0, C)."""

@@ -130,6 +130,18 @@ def seq_mesh(n: int | None = None) -> Mesh:
     return make_mesh({SEQ_AXIS: len(devs)}, devices=devs)
 
 
+def data_seq_mesh(n_seq: int, n_data: int | None = None) -> Mesh:
+    """2-D ("data", "seq") mesh: batch shards over "data", the sequence
+    (ring-attention) axis over "seq". With n_data omitted, every
+    remaining device joins the data axis. Lay the seq axis innermost so
+    ring hops ride ICI neighbors."""
+    devs = jax.devices()
+    if n_data is None:
+        n_data = len(devs) // n_seq
+    return make_mesh({DATA_AXIS: n_data, SEQ_AXIS: n_seq},
+                     devices=devs[:n_data * n_seq])
+
+
 def largest_dividing_mesh(n_clients: int, n_devices: int | None = None) -> int:
     """The largest device count <= n_devices that divides n_clients —
     the mesh size for k-clients-per-device programs whose aggregation

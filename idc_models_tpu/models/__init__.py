@@ -1,4 +1,7 @@
-from idc_models_tpu.models import core, densenet, mobilenet, registry, vgg  # noqa: F401
+from idc_models_tpu.models import (  # noqa: F401
+    attention, core, densenet, mobilenet, registry, vgg,
+)
+from idc_models_tpu.models.attention import attention_classifier  # noqa: F401
 from idc_models_tpu.models.densenet import densenet201  # noqa: F401
 from idc_models_tpu.models.mobilenet import mobilenet_v2  # noqa: F401
 from idc_models_tpu.models.registry import REGISTRY, get_model  # noqa: F401

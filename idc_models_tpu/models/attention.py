@@ -1,0 +1,175 @@
+"""Ring-attention sequence classifier — SP as a TRAINING capability.
+
+The reference has no attention models at all (its models are the CNN
+backbones of SURVEY.md §3.5), so this module is beyond-parity: it
+exists to prove the framework's sequence parallelism is a first-class
+training path, not a standalone library demo. The classifier is the
+smallest honest transformer — token embed + learned positions, pre-LN
+blocks whose self-attention runs through `make_ring_attention` over a
+mesh's "seq" axis, GAP over positions, dense head — built from the same
+explicit-pytree `core.Module` contract as every CNN here, so the
+existing train step, optimizer, freeze machinery
+(`core.head_only_mask`), checkpointing, and eval loop drive it
+unchanged (gated by tests/test_attention_model.py's golden-learning
+test on a ("data", "seq") 2-D mesh).
+
+Mesh composition: pass the SAME mesh the train step runs on. The batch
+dimension shards over every non-"seq" axis and each data-mesh row runs
+an independent ring (ring_attention.py); with `mesh=None` the model
+falls back to single-device `full_attention` — identical function,
+pinned by a test — so the model also runs un-meshed (e.g. export or
+CPU debugging).
+
+Zigzag: with ``layout="zigzag"`` the model permutes the embedded
+sequence into the balanced causal layout ONCE after adding positions
+and never permutes back — LayerNorm/MLP are per-position, the causal
+masks use global natural-order positions internally, and the final GAP
+is permutation-invariant, so the only cost of the ~2x-faster causal
+schedule is one gather at the bottom of the network.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.models import core
+from idc_models_tpu.ring_attention import (
+    full_attention, make_ring_attention, to_zigzag,
+)
+
+
+def multi_head_attention(embed_dim: int, num_heads: int, *,
+                         mesh: Mesh | None = None,
+                         axis: str = meshlib.SEQ_AXIS,
+                         causal: bool = True,
+                         block_impl: str = "jnp",
+                         layout: str = "contiguous",
+                         name: str = "mha") -> core.Module:
+    """Multi-head self-attention [B, T, E] -> [B, T, E]; the attention
+    itself is a sequence-parallel ring over `mesh`'s `axis` (or
+    single-device full attention when mesh is None)."""
+    if embed_dim % num_heads:
+        raise ValueError(f"embed_dim {embed_dim} not divisible by "
+                         f"num_heads {num_heads}")
+    head_dim = embed_dim // num_heads
+    if mesh is None:
+        attn = lambda q, k, v: full_attention(q, k, v, causal=causal)
+    else:
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh {mesh.axis_names} has no {axis!r} axis for the "
+                f"attention ring — build one with mesh.data_seq_mesh / "
+                f"mesh.seq_mesh, or pass mesh=None for single-device "
+                f"full attention")
+        attn = make_ring_attention(mesh, axis=axis, causal=causal,
+                                   block_impl=block_impl, layout=layout)
+
+    def init(rng):
+        ks = jax.random.split(rng, 4)
+        proj = lambda r: core.glorot_uniform(
+            r, (embed_dim, embed_dim), embed_dim, embed_dim)
+        return core.Variables(
+            {"wq": proj(ks[0]), "wk": proj(ks[1]), "wv": proj(ks[2]),
+             "wo": proj(ks[3]), "bo": jnp.zeros((embed_dim,))}, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        b, t, _ = x.shape
+        split = lambda y: y.reshape(b, t, num_heads, head_dim)
+        q = split(x @ params["wq"].astype(x.dtype))
+        k = split(x @ params["wk"].astype(x.dtype))
+        v = split(x @ params["wv"].astype(x.dtype))
+        o = attn(q, k, v).reshape(b, t, embed_dim)
+        return o @ params["wo"].astype(x.dtype) + params["bo"], state
+
+    return core.Module(init, apply, name)
+
+
+def transformer_block(embed_dim: int, num_heads: int, mlp_dim: int, *,
+                      mesh: Mesh | None = None, causal: bool = True,
+                      block_impl: str = "jnp",
+                      layout: str = "contiguous",
+                      name: str = "block") -> core.Module:
+    """Pre-LN transformer block: x + MHA(LN(x)), then + MLP(LN(.))."""
+    ln1 = core.layer_norm(embed_dim, name="ln1")
+    ln2 = core.layer_norm(embed_dim, name="ln2")
+    mha = multi_head_attention(embed_dim, num_heads, mesh=mesh,
+                               causal=causal, block_impl=block_impl,
+                               layout=layout)
+    fc1 = core.dense(embed_dim, mlp_dim, name="fc1")
+    fc2 = core.dense(mlp_dim, embed_dim, name="fc2")
+    parts = (("ln1", ln1), ("mha", mha), ("ln2", ln2), ("fc1", fc1),
+             ("fc2", fc2))
+
+    def init(rng):
+        rngs = jax.random.split(rng, len(parts))
+        return core.Variables(
+            {k: m.init(r).params for (k, m), r in zip(parts, rngs)}, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        h, _ = ln1.apply(params["ln1"], {}, x, train=train)
+        h, _ = mha.apply(params["mha"], {}, h, train=train)
+        x = x + h
+        h, _ = ln2.apply(params["ln2"], {}, x, train=train)
+        h, _ = fc1.apply(params["fc1"], {}, h, train=train)
+        h = jax.nn.gelu(h)
+        h, _ = fc2.apply(params["fc2"], {}, h, train=train)
+        return x + h, state
+
+    return core.Module(init, apply, name, children=parts)
+
+
+def attention_classifier(seq_len: int, features_in: int, *,
+                         embed_dim: int = 64, num_heads: int = 4,
+                         mlp_dim: int = 128, num_blocks: int = 2,
+                         num_outputs: int = 1,
+                         mesh: Mesh | None = None,
+                         causal: bool = True,
+                         block_impl: str = "jnp",
+                         layout: str = "contiguous") -> core.Module:
+    """Sequence classifier over [B, T, F] inputs: dense embed + learned
+    positions -> `num_blocks` ring-attention transformer blocks -> GAP
+    over positions -> dense head. Inputs are always NATURAL order; the
+    zigzag permutation (if any) is internal (see module docstring)."""
+    embed = core.dense(features_in, embed_dim, name="embed")
+    blocks = [transformer_block(embed_dim, num_heads, mlp_dim, mesh=mesh,
+                                causal=causal, block_impl=block_impl,
+                                layout=layout, name=f"block{i}")
+              for i in range(num_blocks)]
+    ln_f = core.layer_norm(embed_dim, name="ln_f")
+    head = core.dense(embed_dim, num_outputs, name="head")
+    n_ring = mesh.shape[meshlib.SEQ_AXIS] if mesh is not None else 1
+    zig = layout == "zigzag" and causal
+
+    def init(rng):
+        rngs = jax.random.split(rng, num_blocks + 3)
+        params = {"embed": embed.init(rngs[0]).params,
+                  "pos": 0.02 * jax.random.normal(
+                      rngs[1], (seq_len, embed_dim))}
+        for i, (blk, r) in enumerate(zip(blocks, rngs[2:])):
+            params[f"block{i}"] = blk.init(r).params
+        params["ln_f"] = ln_f.init(rngs[-1]).params
+        params["head"] = head.init(rngs[-1]).params
+        return core.Variables(params, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        h, _ = embed.apply(params["embed"], {}, x, train=train)
+        h = h + params["pos"].astype(h.dtype)
+        if zig:
+            h = to_zigzag(h, n_ring)
+        for i, blk in enumerate(blocks):
+            h, _ = blk.apply(params[f"block{i}"], {}, h, train=train)
+        h, _ = ln_f.apply(params["ln_f"], {}, h, train=train)
+        pooled = jnp.mean(h, axis=1)   # GAP — permutation-invariant
+        y, _ = head.apply(params["head"], {}, pooled, train=train)
+        return y, state
+
+    names = (("embed", "pos")
+             + tuple(f"block{i}" for i in range(num_blocks))
+             + ("ln_f", "head"))
+    return core.Module(init, apply, "attention_classifier",
+                       layer_names=names,
+                       children=tuple((f"block{i}", b)
+                                      for i, b in enumerate(blocks)))

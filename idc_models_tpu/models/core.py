@@ -235,6 +235,30 @@ def batch_norm(features: int, *, momentum: float = 0.99, eps: float = 1e-3,
     return Module(init, apply, name)
 
 
+def layer_norm(features: int, *, eps: float = 1e-6,
+               name: str = "ln") -> Module:
+    """LayerNorm over the trailing feature axis (Keras
+    LayerNormalization defaults: scale+bias, trailing-axis stats).
+    Unlike batch_norm it carries no cross-replica state, so it is the
+    normalization of choice for sequence models running under
+    sequence-sharded meshes (ring_attention): every position normalizes
+    itself."""
+
+    def init(rng):
+        return Variables({"scale": jnp.ones((features,)),
+                          "bias": jnp.zeros((features,))}, {})
+
+    def apply(params, state, x, *, train=False, rng=None):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + eps)
+        y = y * params["scale"] + params["bias"]
+        return y.astype(x.dtype), state
+
+    return Module(init, apply, name)
+
+
 def relu(name: str = "relu") -> Module:
     return _stateless(lambda x: jax.nn.relu(x), name)
 

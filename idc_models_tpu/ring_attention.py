@@ -168,6 +168,12 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     """Build ``fn(q, k, v) -> out`` with q/k/v/out [B, T, H, D] sharded on
     T over `axis`; jitted, exact (not approximate) attention.
 
+    `mesh` may be multi-dimensional: the ring runs over `axis` and the
+    batch dimension shards over every other mesh axis (e.g. a
+    ("data", "seq") mesh from `mesh.data_seq_mesh` composes data
+    parallelism with sequence parallelism — no resharding, one ring per
+    data-mesh row).
+
     ``block_impl``: ``"jnp"`` (default) computes each visiting block with
     plain jnp ops (XLA-fused, fine up to moderate local block lengths);
     ``"pallas"`` runs the fused flash kernels
@@ -565,7 +571,12 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     else:
         body_fn = (per_device_pallas if block_impl == "pallas"
                    else per_device)
-    spec = P(None, axis, None, None)
+    # The ring runs over `axis`; every OTHER mesh axis shards the batch
+    # dimension, so a 2-D ("data", "seq") mesh composes DP x SP without
+    # resharding — each (data, seq) submesh row runs an independent ring
+    # over its batch shard.
+    others = tuple(a for a in mesh.axis_names if a != axis)
+    spec = P(others if others else None, axis, None, None)
     mapped = shard_map(body_fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return jax.jit(mapped)

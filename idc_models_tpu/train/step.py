@@ -107,9 +107,17 @@ def jit_data_parallel(step_fn, mesh: Mesh, *, donate_state: bool = True,
     batch = meshlib.sharding(mesh, _batch_axis(mesh, axis))
     n_batch = 2 + extra_batch_args
     in_shardings = (state_sh,) + (batch,) * n_batch
+    # Pin the RETURNED state to the same layout as the input state:
+    # without this, GSPMD may shard an updated param over whatever axis
+    # its gradient arrived on (e.g. a positional embedding over "seq"
+    # when the model runs ring attention in-step), and the next call
+    # rejects the now-mismatched donated input. Only train-shaped steps
+    # ((state, metrics) returns) donate state; eval-shaped steps return
+    # arbitrary pytrees and stay unconstrained.
     return jax.jit(
         step_fn,
         in_shardings=in_shardings + (repl,) if _wants_rng(step_fn) else in_shardings,
+        out_shardings=(state_sh, None) if donate_state else None,
         donate_argnums=(0,) if donate_state else (),
     )
 

@@ -1,0 +1,120 @@
+"""Ring attention as a TRAINING capability (VERDICT r3 #4): the
+attention classifier — whose every self-attention is a sequence-
+parallel ring over a ("data", "seq") 2-D mesh — must LEARN a
+position-sensitive synthetic task to >=0.9 train accuracy through the
+REAL train step (optimizer, freeze machinery, jit_data_parallel), and
+must compute the same function as its un-meshed full-attention
+counterpart."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.models import core
+from idc_models_tpu.models.attention import attention_classifier
+from idc_models_tpu.train import (
+    TrainState, jit_data_parallel, make_train_step, replicate, rmsprop,
+    shard_batch,
+)
+from idc_models_tpu.train.losses import binary_cross_entropy
+from idc_models_tpu.train.state import freeze_where
+
+SEQ, FEAT = 32, 8
+THRESHOLD = 0.9
+
+
+def _model(mesh, **kw):
+    return attention_classifier(SEQ, FEAT, embed_dim=32, num_heads=2,
+                                mlp_dim=64, num_blocks=2, num_outputs=1,
+                                mesh=mesh, causal=True, **kw)
+
+
+def _train(mesh, model, steps=250, batch=64, lr=1e-3, seed=0):
+    x, y = synthetic.make_sequence_task(512, SEQ, FEAT, seed=5)
+    opt = rmsprop(lr)
+    variables = model.init(jax.random.key(seed))
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    step = jit_data_parallel(
+        make_train_step(model, opt, binary_cross_entropy), mesh,
+        axis="data")
+    state = replicate(mesh, state)
+    key = jax.random.key(1)
+    accs = []
+    rng = np.random.default_rng(7)
+    for i in range(steps):
+        sel = rng.integers(0, len(x), batch)
+        bx, by = shard_batch(mesh, x[sel], y[sel], axis="data")
+        key, sub = jax.random.split(key)
+        state, m = step(state, bx, by, sub)
+        accs.append(float(m["accuracy"]))
+    return state, accs
+
+
+def test_attention_classifier_learns_on_2d_mesh(devices):
+    """Golden learning: >=0.9 train accuracy within 250 steps on the
+    ("data", "seq") mesh — every attention call is a 4-device ring, the
+    batch is sharded 2-way, and the step is the standard DP train step
+    (XLA inserts the cross-"data" grad reduction around the in-step
+    ring collectives)."""
+    mesh = meshlib.data_seq_mesh(4, 2)
+    _, accs = _train(mesh, _model(mesh))
+    assert max(accs[-20:]) >= THRESHOLD, accs[-20:]
+
+
+def test_attention_classifier_learns_zigzag(devices):
+    """The same task learns through the zigzag causal layout (the
+    internal one-time permutation must not break learning)."""
+    mesh = meshlib.data_seq_mesh(4, 2)
+    _, accs = _train(mesh, _model(mesh, layout="zigzag"))
+    assert max(accs[-20:]) >= THRESHOLD, accs[-20:]
+
+
+def test_meshed_model_equals_unmeshed(devices):
+    """The ("data", "seq")-meshed model computes the SAME function as
+    the mesh=None full-attention model on identical params."""
+    mesh = meshlib.data_seq_mesh(4, 2)
+    meshed = _model(mesh)
+    plain = _model(None)
+    variables = plain.init(jax.random.key(3))
+    x, _ = synthetic.make_sequence_task(8, SEQ, FEAT, seed=9)
+    y_plain, _ = plain.apply(variables.params, {}, jnp.asarray(x))
+    y_mesh, _ = meshed.apply(variables.params, {}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y_mesh), np.asarray(y_plain),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_freeze_machinery_applies(devices):
+    """head_only_mask freezes everything but the head THROUGH the ring:
+    one step with the masked optimizer moves head params and nothing
+    else."""
+    mesh = meshlib.data_seq_mesh(4, 2)
+    model = _model(mesh)
+    variables = model.init(jax.random.key(0))
+    mask = core.head_only_mask(variables.params)
+    opt = freeze_where(rmsprop(1e-2), mask)
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    step = jit_data_parallel(
+        make_train_step(model, opt, binary_cross_entropy), mesh,
+        axis="data")
+    state = replicate(mesh, state)
+    # host copies: the step donates the state, invalidating its buffers
+    before = jax.tree.map(np.asarray, variables.params)
+    x, y = synthetic.make_sequence_task(16, SEQ, FEAT, seed=11)
+    bx, by = shard_batch(mesh, x, y, axis="data")
+    new_state, _ = step(state, bx, by, jax.random.key(2))
+    after = new_state.params
+    assert not np.allclose(np.asarray(after["head"]["kernel"]),
+                           np.asarray(before["head"]["kernel"]))
+    for name in ("embed", "pos", "block0", "block1", "ln_f"):
+        for a, b in zip(jax.tree.leaves(after[name]),
+                        jax.tree.leaves(before[name])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
