@@ -96,6 +96,11 @@ def _parse(argv):
                              "fly (datasets larger than host RAM) "
                              "instead of materializing the train split; "
                              "needs a real --data-dir IDC tree")
+        sp.add_argument("--decode-workers", type=int, default=0,
+                        help="with --stream: fan batch decoding out to "
+                             "N worker processes (round-robin whole "
+                             "batches; bit-identical stream, scales "
+                             "with host cores)")
         sp.add_argument("--model-parallel", type=int, default=1,
                         help="shard weights channel-wise over a 'model' "
                              "mesh axis of this size (tensor parallelism "
@@ -212,7 +217,8 @@ def _streamed_idc_splits(ns, preset, global_batch):
         sys.exit(f"--stream: {n} files are too few for an 80/10/10 split "
                  f"at global batch {global_batch}")
     train = FileStream(pairs[:n_tr], preset.image_size, global_batch,
-                       seed=ns.seed, repeat=preset.repeats)
+                       seed=ns.seed, repeat=preset.repeats,
+                       decode_workers=ns.decode_workers)
 
     def materialize(subset):
         labels = np.asarray([l for _, l in subset], np.int32)
@@ -486,9 +492,11 @@ def _run_fed(ns):
     # Round-loop checkpoint/resume: the reference checkpoints only the
     # pretrainer (SURVEY.md §5); here the federated loop resumes too.
     server_ckpt = Path(ns.path) / "fed_server" if ns.path else None
+    resumed = False
     if server_ckpt is not None and checkpoint_exists(server_ckpt):
         server = restore_checkpoint(server_ckpt, jax.device_get(server))
         print(f"resuming federated training from round {int(server.round)}")
+        resumed = int(server.round) > 0
     # restored/pretrained arrays may live on a single device; the round
     # program wants them replicated over the client mesh
     server = jax.device_put(server, meshlib.replicated(mesh))
@@ -502,9 +510,11 @@ def _run_fed(ns):
     # rounds after the last save (same fold_in(round) rng). Replayed
     # rounds print again (this process really runs them) but must NOT
     # append duplicate records to the append-only run.jsonl — consumers
-    # aggregating by event=round would double-count them.
+    # aggregating by event=round would double-count them. Only an ACTUAL
+    # resume replays rounds: a fresh run pointed at a reused --log-dir
+    # must log every round, not inherit the old file's high-water mark.
     logged_through = -1
-    if logger is not None and logger.path.exists():
+    if resumed and logger is not None and logger.path.exists():
         import json as _json
 
         for line in logger.path.read_text().splitlines():

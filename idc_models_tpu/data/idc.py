@@ -162,6 +162,15 @@ def decode_pairs(pairs: list[tuple[str, int]], image_size: int, *,
     return np.stack(imgs)
 
 
+def decode_task(args):
+    """Worker-process entry for `pipeline.FileStream`'s multi-process
+    decode (one whole batch per task). Lives in this numpy-only module
+    so spawn-started workers never import jax on the hot path."""
+    pairs, image_size, backend, workers = args
+    return decode_pairs(pairs, image_size, workers=workers,
+                        backend=backend)
+
+
 def train_val_test_split(ds: ArrayDataset,
                          fractions: tuple[float, float, float] = (0.8, 0.1, 0.1),
                          *, seed: int | None = None,

@@ -22,16 +22,23 @@ against each other.
 Mask cancellation: signs are antisymmetric per pair and addition wraps
 mod 2^32 (int32 two's complement), exactly like secure/masking.py.
 
-Status: integrated into `secure.make_secure_fedavg_round(...,
-mask_impl="pallas")` — the round packs all protected tensors into ONE
-flat buffer, so the kernel runs once per round over everything.
-Measured on one TPU v5 lite chip (percent=1.0, 1 local epoch, bf16):
-VGG16-sized flat buffer (14.7M elements) 7.96 ms/round fused vs 8.34 ms
-threefry; small_cnn 7.62 ms vs 3.36 ms. The fused pass wins once the
-buffer is large enough to amortize its fixed overhead; threefry (also a
-cryptographically stronger PRG; 32-bit integer multiplies are
-VPU-emulated, making the hash compute-bound) stays the default. Both
-impls aggregate bit-identically (tests/test_secure.py pins this).
+Status: integrated into `secure.make_secure_fedavg_round` behind the
+explicit opt-in ``mask_impl="auto"``: pallas on TPU once the protected
+buffer reaches `masking.MASK_PALLAS_MIN_ELEMS` (4.2M elements),
+threefry below it and off-TPU. The round DEFAULT remains threefry
+because the masks are a privacy primitive and this hash PRG is not
+cryptographic (see make_secure_fedavg_round's threat-model note) —
+"auto" buys throughput where that trade is acceptable.
+The crossover is measured, not assumed
+(`experiments/mask_crossover.jsonl`, sweep with dispatch amortized
+inside one jit on a v5 lite chip): the fused pass never loses —
+1.04x at 262k elements, 1.48x at 4.2M, 1.89x at VGG16's 14.7M, 2.48x
+at 33.5M — but below the threshold the absolute win (~0.1 ms) is
+noise while the round pays one kernel call per local client, and
+threefry is also the cryptographically stronger PRG. (Round 3's
+"threefry wins small" reading came from per-call timings dominated by
+the tunneled runtime's ~10 ms dispatch; the in-jit sweep replaces it.)
+Both impls aggregate bit-identically (tests/test_secure.py pins this).
 """
 
 from __future__ import annotations

@@ -8,4 +8,5 @@ from idc_models_tpu.secure.masking import (  # noqa: F401
 )
 from idc_models_tpu.secure.fedavg import (  # noqa: F401
     make_secure_fedavg_round,
+    resolve_mask_impl,
 )

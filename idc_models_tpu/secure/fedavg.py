@@ -65,6 +65,34 @@ shard_map = jax.shard_map
 _STATE_PRESCALE = 256.0
 
 
+def resolve_mask_impl(model: core.Module, percent: float, *,
+                      platform: str | None = None) -> str:
+    """Resolve ``mask_impl="auto"``: the fused Pallas kernel iff we are
+    on a TPU backend AND the protected buffer (the first `percent` of
+    the full get_weights() enumeration) reaches
+    `masking.MASK_PALLAS_MIN_ELEMS` — the crossover measured in
+    experiments/mask_crossover.jsonl (see the constant's comment).
+    Pure and cheap: element counts come from `jax.eval_shape`, no
+    arrays are materialized. "auto" is an explicit opt-in, not the
+    round default: it trades threefry's cryptographic mask stream for
+    the hash-PRG kernel's throughput (see make_secure_fedavg_round's
+    docstring for the threat-model caveat)."""
+    platform = platform if platform is not None else jax.default_backend()
+    if platform not in ("tpu", "axon"):
+        return "threefry"
+    p, s = jax.eval_shape(
+        lambda rng: (lambda v: (v.params, v.state))(model.init(rng)),
+        jax.random.key(0))
+    pf, sf = masking.first_fraction_selection_weights(
+        p, s, percent, model.layer_names)
+    n_prot = sum(
+        leaf.size for leaf, flag in zip(
+            jax.tree.leaves(p) + jax.tree.leaves(s),
+            jax.tree.leaves(pf) + jax.tree.leaves(sf)) if flag)
+    return ("pallas" if n_prot >= masking.MASK_PALLAS_MIN_ELEMS
+            else "threefry")
+
+
 def make_secure_fedavg_round(
     model: core.Module,
     optimizer: optax.GradientTransformation,
@@ -99,10 +127,20 @@ def make_secure_fedavg_round(
     ``"threefry"`` (default) is XLA's threefry PRG via
     `masking.pairwise_mask`; ``"pallas"`` is the fused single-pass Pallas
     kernel (`ops.secure_masking_kernel.fused_masked_quantize`, hash-PRG,
-    interpret mode off-TPU). Both cancel exactly under psum; they produce
-    different (each internally consistent) mask streams, so all clients
-    of one aggregation must use the same impl — guaranteed here since the
-    whole round is one program.
+    interpret mode off-TPU); ``"auto"`` resolves at build time via
+    `resolve_mask_impl` — pallas on TPU when the protected buffer
+    reaches `masking.MASK_PALLAS_MIN_ELEMS` (the measured crossover,
+    BASELINE.md), threefry otherwise. The default stays threefry ON
+    PURPOSE: the Pallas kernel's murmur-style hash PRG is fast but NOT
+    cryptographic, and mask unpredictability against a curious
+    aggregator — not just exact cancellation — is the property the
+    protocol exists for. Opt into "auto"/"pallas" only where the threat
+    model tolerates a non-cryptographic mask stream (e.g. benchmarking,
+    or aggregators trusted not to attack masks). Both impls cancel
+    exactly under psum; they produce different (each internally
+    consistent) mask streams, so all clients of one aggregation must use
+    the same impl — guaranteed here since the whole round is one
+    program.
 
     `scale_bits` defaults to the largest fixed-point precision whose
     cross-client sum of clipped (+-clip_abs) values cannot overflow int32
@@ -121,8 +159,10 @@ def make_secure_fedavg_round(
     ``metrics["clients_recovered"]`` reports the count. The reference
     has no failure handling at all (SURVEY.md §5).
     """
-    if mask_impl not in ("threefry", "pallas"):
+    if mask_impl not in ("auto", "threefry", "pallas"):
         raise ValueError(f"unknown mask_impl {mask_impl!r}")
+    if mask_impl == "auto":
+        mask_impl = resolve_mask_impl(model, percent)
     n_devices = mesh.shape[meshlib.CLIENT_AXIS]
     local_train = make_local_trainer(
         model, optimizer, loss_fn, local_epochs=local_epochs,
@@ -196,11 +236,21 @@ def make_secure_fedavg_round(
             # -- protected: quantize+mask per client, local int32 sum
             #    (mod 2^32, exactly like psum), then ONE psum ----------
             prot_agg: list = []
+            clip_saturated = jnp.zeros((), jnp.float32)
             if prot:
                 flat_k, meta = masking.pack_leaves(prot, lead_axes=1)
                 # dummies contribute exactly zero (quantize(0) == 0), so
                 # only their masks enter the sum — and those cancel
                 flat_k = jnp.where(real[:, None], flat_k, 0.0)
+                # Saturation detection (advisor r3): a protected value at
+                # the clip boundary — e.g. a BN moving variance beyond
+                # clip_abs * _STATE_PRESCALE on unnormalized inputs — is
+                # silently truncated into the aggregate; count and
+                # surface it so callers can raise clip_abs/prescale
+                # instead of debugging corrupted server BN state.
+                clip_saturated = collectives.psum(
+                    jnp.sum(jnp.abs(flat_k) >= clip_abs)
+                    .astype(jnp.float32), meshlib.CLIENT_AXIS)
                 if mask_impl == "pallas":
                     from idc_models_tpu.ops import secure_masking_kernel as smk
 
@@ -256,6 +306,7 @@ def make_secure_fedavg_round(
                 lambda x: jnp.where(alive > 0, x, jnp.float32(jnp.nan)),
                 metrics)
             metrics["clients_recovered"] = recovered
+            metrics["clip_saturated"] = clip_saturated
             return agg_params, agg_state, metrics
 
         return per_device
@@ -285,6 +336,7 @@ def make_secure_fedavg_round(
         return jax.jit(round_fn, donate_argnums=(0,))
 
     rounds: dict[int, Callable] = {}
+    warned_pad: list = []  # one-time flag for the host-resident pad path
 
     def round_fn(server: ServerState, images, labels, rng, *,
                  n_real: int | None = None):
@@ -303,6 +355,17 @@ def make_secure_fedavg_round(
             n_real = images.shape[0]
         pad = -images.shape[0] % n_devices
         if pad:
+            if not isinstance(images, jax.Array) and not warned_pad:
+                import warnings
+
+                warnings.warn(
+                    f"secure round_fn is padding {images.shape[0]} "
+                    f"host-resident clients to {images.shape[0] + pad} "
+                    f"every round, re-uploading the stacked dataset "
+                    f"each call; pre-pad once on device and pass "
+                    f"n_real={n_real} (see cli._run_secure) for the "
+                    f"steady-state path", stacklevel=2)
+                warned_pad.append(True)
             images = jnp.asarray(images)  # settles host dtypes (f64->f32)
             labels = jnp.asarray(labels)
             images = jnp.concatenate(

@@ -221,6 +221,38 @@ def test_filestream_matches_materialized_loader(png_tree):
     stream.close()
 
 
+def test_filestream_decode_workers_bit_identical(png_tree):
+    """Multi-process decode fan-out (--decode-workers): round-robin
+    whole batches over 2 spawned worker processes must yield a stream
+    BIT-IDENTICAL to the single-process one, across epochs and repeat
+    passes — the parallelism changes throughput, never the data."""
+    from idc_models_tpu.data.idc import list_labeled_files
+
+    pairs = list_labeled_files(png_tree)
+    base = pipeline.FileStream(pairs, 50, 8, seed=3, repeat=2)
+    fanout = pipeline.FileStream(pairs, 50, 8, seed=3, repeat=2,
+                                 decode_workers=2)
+    try:
+        assert len(fanout) == len(base) == 6
+        for ep in (0, 1):
+            for (sx, sy), (fx, fy) in zip(base.epoch(ep),
+                                          fanout.epoch(ep),
+                                          strict=True):
+                np.testing.assert_array_equal(fx, sx)
+                np.testing.assert_array_equal(fy, sy)
+        # replace() copies share the worker pool and stay identical
+        half = fanout.replace(batch_size=4)
+        halfb = base.replace(batch_size=4)
+        for (sx, sy), (fx, fy) in zip(halfb.epoch(0), half.epoch(0),
+                                      strict=True):
+            np.testing.assert_array_equal(fx, sx)
+            np.testing.assert_array_equal(fy, sy)
+        assert half._proc_box is fanout._proc_box
+    finally:
+        fanout.close()
+        fanout.close()  # idempotent, terminates worker processes once
+
+
 def test_fit_on_filestream_equals_materialized(png_tree, devices):
     """End-to-end: training from the stream lands on exactly the state
     the materialized path produces."""

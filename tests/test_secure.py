@@ -672,3 +672,24 @@ def test_paillier_clients_full_protocol(keypair):
             np.testing.assert_allclose(g, e, rtol=1e-5, atol=1e-7)
     m = clients[0].evaluate(imgs, labels, binary_cross_entropy)
     assert np.isfinite(m["loss"]) and 0 <= m["accuracy"] <= 1
+
+
+def test_resolve_mask_impl_auto():
+    """mask_impl="auto" picks the fused kernel exactly when (a) a TPU
+    backend is live and (b) the protected buffer reaches the measured
+    crossover (masking.MASK_PALLAS_MIN_ELEMS) — threefry everywhere
+    else, including always off-TPU (interpret mode is unusable)."""
+    from idc_models_tpu.models.vgg import vgg16
+    from idc_models_tpu.secure import resolve_mask_impl
+
+    big = vgg16(1)           # ~14.7M params >> 4.2M crossover
+    small = small_cnn(10, 3, 1)
+    assert resolve_mask_impl(big, 1.0, platform="tpu") == "pallas"
+    assert resolve_mask_impl(big, 1.0, platform="axon") == "pallas"
+    # a small protected slice of a big model stays under the crossover
+    assert resolve_mask_impl(big, 0.05, platform="tpu") == "threefry"
+    assert resolve_mask_impl(small, 1.0, platform="tpu") == "threefry"
+    # off-TPU: always threefry, regardless of size
+    assert resolve_mask_impl(big, 1.0, platform="cpu") == "threefry"
+    # this suite runs on the CPU pod, so "auto" rounds build threefry
+    assert resolve_mask_impl(big, 1.0) == "threefry"
