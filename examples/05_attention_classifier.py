@@ -1,0 +1,62 @@
+"""Train a transformer classifier with sequence-parallel attention on a
+2-D ("data", "seq") mesh — DP x SP composed, driven by the SAME train
+step every CNN in this framework uses.
+
+`python examples/05_attention_classifier.py` runs on a virtual 8-device
+CPU pod (batch sharded 2 ways, every self-attention a 4-device ring);
+on a TPU pod the identical code shards batch over DCN/ICI rows and
+rotates K/V blocks over ICI within each ring.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from idc_models_tpu import mesh as meshlib
+
+meshlib.force_cpu_pod(8)          # delete this line on real TPU hardware
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.models.attention import attention_classifier
+from idc_models_tpu.train import (
+    TrainState, jit_data_parallel, make_train_step, replicate, rmsprop,
+    shard_batch,
+)
+from idc_models_tpu.train.losses import binary_cross_entropy
+
+SEQ, FEAT = 32, 8
+mesh = meshlib.data_seq_mesh(4, 2)           # ("data": 2, "seq": 4)
+model = attention_classifier(SEQ, FEAT, embed_dim=32, num_heads=2,
+                             mlp_dim=64, num_blocks=2, num_outputs=1,
+                             mesh=mesh, causal=True)
+
+opt = rmsprop(1e-3)
+variables = model.init(jax.random.key(0))
+state = TrainState(step=jnp.zeros((), jnp.int32), params=variables.params,
+                   model_state=variables.state,
+                   opt_state=opt.init(variables.params))
+step = jit_data_parallel(make_train_step(model, opt, binary_cross_entropy),
+                         mesh, axis="data")
+state = replicate(mesh, state)
+
+# position-sensitive task: label = marker in the late half — unsolvable
+# without attention moving positional information into the pooled features
+x, y = synthetic.make_sequence_task(512, SEQ, FEAT, seed=5)
+key = jax.random.key(1)
+sel_rng = np.random.default_rng(7)
+for i in range(150):
+    sel = sel_rng.integers(0, len(x), 64)
+    key, sub = jax.random.split(key)
+    state, m = step(state, *shard_batch(mesh, x[sel], y[sel], axis="data"),
+                    sub)
+    if i % 30 == 0 or i == 149:
+        print(f"step {i:3d}  loss {float(m['loss']):.3f}  "
+              f"acc {float(m['accuracy']):.3f}")
+
+assert float(m["accuracy"]) > 0.8, "should be well above chance by now"
+print("OK: ring-attention transformer trained on a (data, seq) mesh")

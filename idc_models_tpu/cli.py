@@ -34,6 +34,7 @@ def main(argv: list[str] | None = None) -> int:
         meshlib.force_cpu_pod(ns.host_devices)  # warns if ineffective
     runner = {"vgg": _run_dist, "mobile": _run_dist, "dense": _run_dist,
               "fed": _run_fed, "secure_fed": _run_secure,
+              "attention": _run_attention,
               "convert_weights": _run_convert}[ns.preset_key]
     runner(ns)
     return 0
@@ -132,9 +133,37 @@ def _parse(argv):
                     help="host-side Paillier parity mode instead of "
                              "pairwise masks")
     sp.add_argument("--mask-impl", default="threefry",
-                    choices=("threefry", "pallas"),
+                    choices=("threefry", "pallas", "auto"),
                     help="PRG for the pairwise masks: XLA threefry "
-                         "(default) or the fused Pallas kernel")
+                         "(default; cryptographic), the fused Pallas "
+                         "hash-PRG kernel, or auto (pallas on TPU above "
+                         "the measured crossover — see the threat-model "
+                         "note in secure.make_secure_fedavg_round)")
+
+    sp = sub.add_parser("attention",
+                        help="sequence-parallel transformer classifier "
+                             "(beyond-reference: ring attention as a "
+                             "training workload)")
+    common(sp)
+    sp.add_argument("--seq-len", type=int, default=128)
+    sp.add_argument("--features", type=int, default=8)
+    sp.add_argument("--embed-dim", type=int, default=64)
+    sp.add_argument("--num-heads", type=int, default=4)
+    sp.add_argument("--mlp-dim", type=int, default=128)
+    sp.add_argument("--num-blocks", type=int, default=2)
+    sp.add_argument("--steps", type=int, default=300)
+    sp.add_argument("--seq-parallel", type=int, default=0,
+                    help="ring size over the 'seq' mesh axis; remaining "
+                         "devices form the 'data' axis (0 = largest "
+                         "power of two <= device count, capped at 4)")
+    sp.add_argument("--layout", choices=("contiguous", "zigzag"),
+                    default="contiguous",
+                    help="causal sequence layout (zigzag balances the "
+                         "causal ring schedule, ~2x fewer FLOPs)")
+    sp.add_argument("--block-impl", choices=("jnp", "pallas"),
+                    default="jnp",
+                    help="ring block engine (pallas keeps scores in "
+                         "VMEM; needs t_local multiples of 128/256)")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -398,6 +427,101 @@ def _loss_for(num_outputs):
 
     return (binary_cross_entropy if num_outputs == 1
             else sparse_categorical_cross_entropy)
+
+
+def _run_attention(ns):
+    """Beyond-reference workload: the ring-attention transformer
+    classifier on the position-sensitive synthetic sequence task, over a
+    ("data", "seq") mesh — sequence parallelism from the command line,
+    under the same step/eval/logging machinery as every other preset."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.data import synthetic
+    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.models.attention import attention_classifier
+    from idc_models_tpu.observe import Timer, profile_trace
+    from idc_models_tpu.train import (
+        TrainState, jit_data_parallel, make_train_step, replicate,
+        rmsprop, shard_batch,
+    )
+    from idc_models_tpu.train.loop import Evaluator
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    if ns.data_dir:
+        print("[idc_models_tpu] attention: --data-dir is not used by "
+              "this workload (it trains on the synthetic "
+              "position-sensitive sequence task); ignoring it",
+              file=sys.stderr)
+    n_dev = len(jax.devices())
+    # auto ring size: the largest power of two that DIVIDES the device
+    # count (capped at 4), so the default never aborts on e.g. 6 devices
+    n_seq = ns.seq_parallel or max(
+        p for p in (4, 2, 1) if n_dev % p == 0)
+    if n_dev % n_seq:
+        sys.exit(f"--seq-parallel {n_seq} must divide the device "
+                 f"count ({n_dev})")
+    stripes = 2 * n_seq if ns.layout == "zigzag" else n_seq
+    if ns.seq_len % stripes:
+        sys.exit(f"--seq-len {ns.seq_len} must divide into {stripes} "
+                 f"equal stripes for --layout {ns.layout} at ring "
+                 f"size {n_seq}")
+    mesh = meshlib.data_seq_mesh(n_seq)
+    print(f"Number of devices: {mesh.devices.size} "
+          f"(data={mesh.shape[meshlib.DATA_AXIS]}, seq={n_seq})")
+
+    model = attention_classifier(
+        ns.seq_len, ns.features, embed_dim=ns.embed_dim,
+        num_heads=ns.num_heads, mlp_dim=ns.mlp_dim,
+        num_blocks=ns.num_blocks, num_outputs=1, mesh=mesh, causal=True,
+        layout=ns.layout, block_impl=ns.block_impl)
+    batch = ns.batch_size or 64
+    lr = ns.lr if ns.lr is not None else 1e-3
+    n_train = max(ns.synthetic_examples, 4 * batch)
+    x, y = synthetic.make_sequence_task(n_train, ns.seq_len, ns.features,
+                                        seed=ns.seed)
+    vx, vy = synthetic.make_sequence_task(max(n_train // 4, batch),
+                                          ns.seq_len, ns.features,
+                                          seed=ns.seed + 1)
+
+    opt = rmsprop(lr)
+    variables = model.init(jax.random.key(ns.seed))
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    step = jit_data_parallel(
+        make_train_step(model, opt, binary_cross_entropy), mesh,
+        axis=meshlib.DATA_AXIS)
+    state = replicate(mesh, state)
+    logger = _logger(ns)
+    key = jax.random.key(ns.seed + 1)
+    sel_rng = np.random.default_rng(ns.seed + 2)
+    with Timer("Attention training", logger=logger), \
+            profile_trace(ns.profile_dir):
+        for i in range(ns.steps):
+            sel = sel_rng.integers(0, len(x), batch)
+            key, sub = jax.random.split(key)
+            state, m = step(state, *shard_batch(mesh, x[sel], y[sel],
+                                                axis=meshlib.DATA_AXIS),
+                            sub)
+            if i % 50 == 0 or i == ns.steps - 1:
+                m = _fetch_scalars(m)
+                print(f"step {i}, loss={float(m['loss']):.4f}, "
+                      f"accuracy={float(m['accuracy']):.4f}")
+                if logger:
+                    logger.log(event="step", step=i,
+                               loss=float(m["loss"]),
+                               accuracy=float(m["accuracy"]))
+    ev = Evaluator(model, binary_cross_entropy, mesh, batch_size=batch,
+                   with_auroc=True)
+    vm = ev(state, ArrayDataset(vx, vy))
+    print("val:", " ".join(f"{k}={v:.4f}" for k, v in vm.items()))
+    if logger:
+        logger.log(event="val", **vm)
+        logger.close()
 
 
 def _run_fed(ns):

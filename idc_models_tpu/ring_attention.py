@@ -19,8 +19,13 @@ beyond-parity capability, designed TPU-first):
   long, pass ``block_impl="pallas"``: the fused flash kernel
   (`ops.flash_block_kernel`) keeps scores in VMEM — measured 1.41x at
   T/n=8k and 1.62x at 16k on a v5 lite chip. Either way a sequence n
-  times longer than one device could hold attends exactly, with compute
-  and communication overlapped by XLA's async collectives.
+  times longer than one device could hold attends exactly.
+  Comm/compute overlap within a step (the hop and the block attend read
+  the same kc and are independent) is left to XLA's async collectives —
+  an EXPECTATION from the dependence structure, not a measured result:
+  a single-chip environment cannot time a real multi-hop ring, and no
+  pod measurement exists yet. `unroll=True` additionally removes the
+  while-loop barrier between steps (see `make_ring_attention`).
 
 Causal layouts: with the plain contiguous layout device i owns queries
 that can see only blocks 0..i, yet every device executes all n block
@@ -365,6 +370,37 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         _, _, m, l, acc = run_steps(body, (k, v, m, l, acc), 1)
         return m, l, acc
 
+    def pallas_ring_vjp(fwd_loop, bwd_impl):
+        """The ring-level custom_vjp scaffolding shared by both pallas
+        layouts: forward runs `fwd_loop` (a fold returning the raw
+        (m, l, acc) carry) and saves only (q, k, v, out, L); backward
+        computes D = rowsum(dout*out) and hands off to the layout's
+        `bwd_impl(q, k, v, dout, L, D)` backward ring. me/axis_index is
+        taken INSIDE fwd/bwd (both run under the shard_map trace) —
+        custom_vjp must not close over tracers."""
+
+        @jax.custom_vjp
+        def attn(q, k, v):
+            _, l, acc = fwd_loop(q, k, v)
+            return finalize(l, acc, q.dtype)
+
+        def attn_fwd(q, k, v):
+            m, l, acc = fwd_loop(q, k, v)
+            out = finalize(l, acc, q.dtype)
+            L = m + jnp.log(jnp.maximum(l, 1e-37))
+            return out, (q, k, v, out, L)
+
+        def attn_bwd(res, dout):
+            q, k, v, out, L = res
+            Dr = jnp.einsum("bqhd,bqhd->bhq", dout.astype(jnp.float32),
+                            out.astype(jnp.float32))
+            dq, dk, dv = bwd_impl(q, k, v, dout, L, Dr)
+            return (dq.astype(q.dtype), dk.astype(k.dtype),
+                    dv.astype(v.dtype))
+
+        attn.defvjp(attn_fwd, attn_bwd)
+        return attn
+
     def per_device(q, k, v):
         scale_ = scale if scale is not None else q.shape[-1] ** -0.5
         _, l, acc = contiguous_fold(q, k, v, make_attend(scale_, False))
@@ -394,24 +430,8 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         def fwd_loop(q, k, v):
             return contiguous_fold(q, k, v, attend)
 
-        # me/axis_index is taken INSIDE fwd/bwd (both run under the
-        # shard_map trace) — custom_vjp must not close over tracers.
-        @jax.custom_vjp
-        def attn(q, k, v):
-            _, l, acc = fwd_loop(q, k, v)
-            return finalize(l, acc, q.dtype)
-
-        def attn_fwd(q, k, v):
-            m, l, acc = fwd_loop(q, k, v)
-            out = finalize(l, acc, q.dtype)
-            L = m + jnp.log(jnp.maximum(l, 1e-37))
-            return out, (q, k, v, out, L)
-
-        def attn_bwd(res, dout):
-            q, k, v, out, L = res
+        def bwd_ring(q, k, v, dout, L, Dr):
             me = collectives.axis_index(axis)
-            Dr = jnp.einsum("bqhd,bqhd->bhq", dout.astype(jnp.float32),
-                            out.astype(jnp.float32))
 
             def body(s, carry):
                 kc, vc, dk, dv, dq = carry
@@ -429,11 +449,9 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
             zf = lambda x: jnp.zeros(x.shape, jnp.float32)
             _, _, dk, dv, dq = run_steps(
                 body, (k, v, zf(k), zf(v), zf(q)), 0)
-            return (dq.astype(q.dtype), dk.astype(k.dtype),
-                    dv.astype(v.dtype))
+            return dq, dk, dv
 
-        attn.defvjp(attn_fwd, attn_bwd)
-        return attn(q, k, v)
+        return pallas_ring_vjp(fwd_loop, bwd_ring)(q, k, v)
 
     def per_device_zigzag(q, k, v):
         scale_ = scale if scale is not None else q.shape[-1] ** -0.5
@@ -478,23 +496,9 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         def fwd_loop(q, k, v):
             return zigzag_fold(q, k, v, attend)
 
-        @jax.custom_vjp
-        def attn(q, k, v):
-            _, l, acc = fwd_loop(q, k, v)
-            return finalize(l, acc, q.dtype)
-
-        def attn_fwd(q, k, v):
-            m, l, acc = fwd_loop(q, k, v)
-            out = finalize(l, acc, q.dtype)
-            L = m + jnp.log(jnp.maximum(l, 1e-37))
-            return out, (q, k, v, out, L)
-
-        def attn_bwd(res, dout):
-            q, k, v, out, L = res
+        def bwd_ring(q, k, v, dout, L, Dr):
             me = collectives.axis_index(axis)
             lo_off, hi_off = stripe_offs(me)
-            Dr = jnp.einsum("bqhd,bqhd->bhq", dout.astype(jnp.float32),
-                            out.astype(jnp.float32))
 
             def gquarter(dq, dk, dv, kc, vc, row0, krow0, q_off, k_off,
                          diag):
@@ -559,11 +563,9 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
             # before its owner; one trailing hop delivers it
             dk = collectives.ppermute(dk, axis, perm)
             dv = collectives.ppermute(dv, axis, perm)
-            return (dq.astype(q.dtype), dk.astype(k.dtype),
-                    dv.astype(v.dtype))
+            return dq, dk, dv
 
-        attn.defvjp(attn_fwd, attn_bwd)
-        return attn(q, k, v)
+        return pallas_ring_vjp(fwd_loop, bwd_ring)(q, k, v)
 
     if layout == "zigzag" and causal:
         body_fn = (per_device_zigzag_pallas if block_impl == "pallas"

@@ -95,6 +95,54 @@ def test_cli_vgg_streamed(tmp_path, capsys):
     assert "epoch 2/2" in out and "test:" in out
 
 
+def test_cli_vgg_streamed_decode_workers(tmp_path, capsys):
+    """--decode-workers 2 fans decoding over worker processes and the
+    run still trains (the stream itself is pinned bit-identical in
+    test_data.py; this drives the CLI wiring)."""
+    from PIL import Image
+
+    data = tmp_path / "idc"
+    rng = np.random.default_rng(1)
+    for label in ("0", "1"):
+        d = data / label
+        d.mkdir(parents=True)
+        for i in range(40):
+            arr = (rng.random((50, 50, 3)) * 200).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"p{i}.png")
+    out = _run(["vgg", "--path", str(tmp_path), "--data-dir", str(data),
+                "--host-devices", "8", "--batch-size", "8", "--stream",
+                "--decode-workers", "2", "--epochs", "1",
+                "--fine-tune-epochs", "0"], capsys)
+    assert "epoch 1/1" in out and "test:" in out
+
+
+def test_cli_attention(tmp_path, capsys):
+    """The sequence-parallel transformer workload from the product
+    surface: trains on a ("data", "seq") mesh and reports val metrics
+    incl. AUROC; the zigzag layout works through the same flags."""
+    out = _run(["attention", "--host-devices", "8", "--steps", "40",
+                "--seq-len", "32", "--embed-dim", "16", "--num-heads",
+                "2", "--mlp-dim", "32", "--num-blocks", "1",
+                "--batch-size", "32", "--path", str(tmp_path)], capsys)
+    assert "(data=2, seq=4)" in out
+    assert "val:" in out and "auroc=" in out
+    assert (tmp_path / "logs" / "run.jsonl").exists()
+    out = _run(["attention", "--host-devices", "8", "--steps", "10",
+                "--seq-len", "64", "--embed-dim", "16", "--num-heads",
+                "2", "--mlp-dim", "32", "--num-blocks", "1",
+                "--layout", "zigzag", "--batch-size", "32"], capsys)
+    assert "val:" in out
+
+
+def test_cli_attention_rejects_bad_ring(capsys):
+    with pytest.raises(SystemExit):
+        cli.main(["attention", "--host-devices", "8",
+                  "--seq-parallel", "3"])
+    with pytest.raises(SystemExit):
+        cli.main(["attention", "--host-devices", "8", "--seq-len", "30",
+                  "--layout", "zigzag"])
+
+
 def test_cli_mobile(capsys):
     out = _run(["mobile", "--host-devices", "8", "--synthetic-examples",
                 "64", "--batch-size", "8", "--epochs", "1",
