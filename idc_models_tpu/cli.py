@@ -155,7 +155,8 @@ def _parse(argv):
     sp.add_argument("--seq-parallel", type=int, default=0,
                     help="ring size over the 'seq' mesh axis; remaining "
                          "devices form the 'data' axis (0 = largest "
-                         "power of two <= device count, capped at 4)")
+                         "power of two that divides the device count, "
+                         "capped at 4)")
     sp.add_argument("--layout", choices=("contiguous", "zigzag"),
                     default="contiguous",
                     help="causal sequence layout (zigzag balances the "
@@ -460,9 +461,9 @@ def _run_attention(ns):
     # count (capped at 4), so the default never aborts on e.g. 6 devices
     n_seq = ns.seq_parallel or max(
         p for p in (4, 2, 1) if n_dev % p == 0)
-    if n_dev % n_seq:
-        sys.exit(f"--seq-parallel {n_seq} must divide the device "
-                 f"count ({n_dev})")
+    if n_seq < 1 or n_dev % n_seq:
+        sys.exit(f"--seq-parallel {n_seq} must be a positive divisor "
+                 f"of the device count ({n_dev})")
     stripes = 2 * n_seq if ns.layout == "zigzag" else n_seq
     if ns.seq_len % stripes:
         sys.exit(f"--seq-len {ns.seq_len} must divide into {stripes} "

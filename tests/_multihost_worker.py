@@ -167,6 +167,51 @@ def main() -> int:
     ref_digest = float(jnp.sum(full_attention(sq, sk, sv, causal=True)))
     assert abs(sp_digest - ref_digest) < 1e-3, (sp_digest, ref_digest)
 
+    # ... the zigzag layout's balanced schedule over the same
+    # cross-process ring (its hop pattern differs: half-blocks are
+    # selected per step, and the dk/dv trailing hop crosses the
+    # boundary on the backward), including gradients THROUGH the ring:
+    # grads must match full-attention autodiff computed from host copies.
+    from idc_models_tpu.ring_attention import from_zigzag, to_zigzag
+
+    n_ring = smesh.shape[meshlib.SEQ_AXIS]
+    zring = make_ring_attention(smesh, causal=True, layout="zigzag")
+
+    def zz_loss(q, k, v):
+        zz = [to_zigzag(x, n_ring) for x in (q, k, v)]
+        return jnp.sum(jnp.square(from_zigzag(zring(*zz), n_ring)
+                                  .astype(jnp.float32)))
+
+    # (grad must run under jit: eager ops on non-fully-addressable
+    # arrays are rejected outside the global-semantics program)
+    gq = jax.jit(jax.grad(zz_loss),
+                 out_shardings=meshlib.replicated(smesh))(qs, ks, vs)
+    zz_grad_digest = float(jnp.sum(jax.device_get(gq)))
+    gq_ref = jax.grad(lambda q, k, v: jnp.sum(jnp.square(
+        full_attention(q, k, v, causal=True))))(sq, sk, sv)
+    assert abs(zz_grad_digest - float(jnp.sum(gq_ref))) < 1e-3, (
+        zz_grad_digest, float(jnp.sum(gq_ref)))
+
+    # ... and the PALLAS path's backward ring (the custom_vjp whose
+    # dk/dv accumulators ride the ppermute hops home) with the k-grad,
+    # so a riding accumulator itself crosses the process boundary.
+    # T=1024 over 8 devices = the kernel's 128 tile; interpret mode on
+    # the CPU devices.
+    rng_pl = np.random.default_rng(13)
+    pq, pk, pv = (jnp.asarray(rng_pl.normal(0, 1, (1, 1024, 2, 32)),
+                              jnp.float32) for _ in range(3))
+    pqs, pks, pvs = (meshlib.put_with_sharding(x, ssh)
+                     for x in (pq, pk, pv))
+    pring = make_ring_attention(smesh, causal=True, block_impl="pallas")
+    gk = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.square(
+        pring(q, k, v).astype(jnp.float32))), argnums=1),
+        out_shardings=meshlib.replicated(smesh))(pqs, pks, pvs)
+    flash_bwd_digest = float(jnp.sum(jax.device_get(gk)))
+    gk_ref = jax.grad(lambda q, k, v: jnp.sum(jnp.square(
+        full_attention(q, k, v, causal=True))), argnums=1)(pq, pk, pv)
+    assert abs(flash_bwd_digest - float(jnp.sum(gk_ref))) < 1e-2, (
+        flash_bwd_digest, float(jnp.sum(gk_ref)))
+
     # Checkpointed fit across processes: orbax save is a collective, so
     # this hangs (not just fails) if any process skips it. The dir is
     # shared (same host in this stand-in, like GCS/NFS on a real pod).
