@@ -165,6 +165,10 @@ def _parse(argv):
                     default="jnp",
                     help="ring block engine (pallas keeps scores in "
                          "VMEM; needs t_local multiples of 128/256)")
+    sp.add_argument("--remat", action="store_true",
+                    help="jax.checkpoint each transformer block: the "
+                         "backward recomputes block activations instead "
+                         "of storing them (long-context memory lever)")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -477,7 +481,7 @@ def _run_attention(ns):
         ns.seq_len, ns.features, embed_dim=ns.embed_dim,
         num_heads=ns.num_heads, mlp_dim=ns.mlp_dim,
         num_blocks=ns.num_blocks, num_outputs=1, mesh=mesh, causal=True,
-        layout=ns.layout, block_impl=ns.block_impl)
+        layout=ns.layout, block_impl=ns.block_impl, remat=ns.remat)
     batch = ns.batch_size or 64
     lr = ns.lr if ns.lr is not None else 1e-3
     n_train = max(ns.synthetic_examples, 4 * batch)

@@ -128,11 +128,19 @@ def attention_classifier(seq_len: int, features_in: int, *,
                          mesh: Mesh | None = None,
                          causal: bool = True,
                          block_impl: str = "jnp",
-                         layout: str = "contiguous") -> core.Module:
+                         layout: str = "contiguous",
+                         remat: bool = False) -> core.Module:
     """Sequence classifier over [B, T, F] inputs: dense embed + learned
     positions -> `num_blocks` ring-attention transformer blocks -> GAP
     over positions -> dense head. Inputs are always NATURAL order; the
-    zigzag permutation (if any) is internal (see module docstring)."""
+    zigzag permutation (if any) is internal (see module docstring).
+
+    ``remat=True`` wraps each transformer block in `jax.checkpoint`:
+    the backward recomputes block activations instead of storing them,
+    so residual memory is O(num_blocks) block BOUNDARIES rather than
+    every intermediate — the standard long-context lever, composing
+    with the flash kernels' own VMEM-resident scores (identical values
+    and gradients, pinned by test)."""
     embed = core.dense(features_in, embed_dim, name="embed")
     blocks = [transformer_block(embed_dim, num_heads, mlp_dim, mesh=mesh,
                                 causal=causal, block_impl=block_impl,
@@ -160,7 +168,12 @@ def attention_classifier(seq_len: int, features_in: int, *,
         if zig:
             h = to_zigzag(h, n_ring)
         for i, blk in enumerate(blocks):
-            h, _ = blk.apply(params[f"block{i}"], {}, h, train=train)
+            def run_block(p, h, _blk=blk):
+                return _blk.apply(p, {}, h, train=train)[0]
+
+            if remat:
+                run_block = jax.checkpoint(run_block)
+            h = run_block(params[f"block{i}"], h)
         h, _ = ln_f.apply(params["ln_f"], {}, h, train=train)
         pooled = jnp.mean(h, axis=1)   # GAP — permutation-invariant
         y, _ = head.apply(params["head"], {}, pooled, train=train)

@@ -9,6 +9,7 @@ counterpart."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.data import synthetic
@@ -86,6 +87,44 @@ def test_meshed_model_equals_unmeshed(devices):
     y_mesh, _ = meshed.apply(variables.params, {}, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(y_mesh), np.asarray(y_plain),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("block_impl", ["jnp", "pallas"])
+def test_remat_identical_values_and_grads(devices, block_impl):
+    """remat=True (jax.checkpoint per block) must change MEMORY only:
+    outputs and gradients are identical to the stored-activation
+    model on the same params — on BOTH block engines (checkpoint's
+    forward recompute re-enters the pallas custom_vjp ring under
+    shard_map) — and the rematerialized backward still flows through
+    the ring collectives."""
+    seq = 2048 if block_impl == "pallas" else SEQ  # kernel tile minimum
+    mesh = meshlib.data_seq_mesh(4, 2)
+
+    def build(**kw):
+        return attention_classifier(seq, FEAT, embed_dim=32, num_heads=2,
+                                    mlp_dim=64, num_blocks=2,
+                                    num_outputs=1, mesh=mesh, causal=True,
+                                    block_impl=block_impl, **kw)
+
+    plain = build()
+    rem = build(remat=True)
+    variables = plain.init(jax.random.key(7))
+    x, y = synthetic.make_sequence_task(8, seq, FEAT, seed=15)
+    x = jnp.asarray(x)
+
+    def loss(model, params):
+        out, _ = model.apply(params, {}, x, train=True,
+                             rng=jax.random.key(0))
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    l_p, g_p = jax.value_and_grad(lambda p: loss(plain, p))(
+        variables.params)
+    l_r, g_r = jax.value_and_grad(lambda p: loss(rem, p))(
+        variables.params)
+    np.testing.assert_allclose(float(l_r), float(l_p), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_r), jax.tree.leaves(g_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
 
 
 def test_freeze_machinery_applies(devices):

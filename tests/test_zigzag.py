@@ -109,14 +109,17 @@ def test_zigzag_pallas_matches_full(devices, n_dev):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("block_impl", ["jnp", "pallas"])
 @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
-def test_unrolled_ring_matches_full(devices, layout):
+def test_unrolled_ring_matches_full(devices, layout, block_impl):
     """`unroll=True` trades program size for cross-step overlap; the
-    result must be identical to the fori_loop form."""
-    q, k, v = _qkv(seed=13)
+    result must be identical to the fori_loop form — on both block
+    engines (the pallas custom_vjp paths share the same run_steps)."""
+    t = 2048 if block_impl == "pallas" else T  # kernel tile minimum
+    q, k, v = _qkv(seed=13, t=t)
     mesh = meshlib.seq_mesh(8)
     ring = make_ring_attention(mesh, causal=True, layout=layout,
-                               unroll=True)
+                               block_impl=block_impl, unroll=True)
     if layout == "zigzag":
         args = tuple(to_zigzag(x, 8) for x in (q, k, v))
         out = from_zigzag(ring(*args), 8)
