@@ -97,12 +97,18 @@ def test_remat_identical_values_and_grads(devices, block_impl):
     forward recompute re-enters the pallas custom_vjp ring under
     shard_map) — and the rematerialized backward still flows through
     the ring collectives."""
-    seq = 2048 if block_impl == "pallas" else SEQ  # kernel tile minimum
+    # pallas: T=512 over the 4-ring = the kernel's exact 128 tile, ONE
+    # block — interpret mode is pure-Python slow and checkpoint's
+    # recompute doubles it; any bigger risks the XLA CPU collective
+    # rendezvous abort (>40 s to a collective on a contended 1-core
+    # host — the simulator limit README documents)
+    seq = 512 if block_impl == "pallas" else SEQ
+    blocks = 1 if block_impl == "pallas" else 2
     mesh = meshlib.data_seq_mesh(4, 2)
 
     def build(**kw):
         return attention_classifier(seq, FEAT, embed_dim=32, num_heads=2,
-                                    mlp_dim=64, num_blocks=2,
+                                    mlp_dim=64, num_blocks=blocks,
                                     num_outputs=1, mesh=mesh, causal=True,
                                     block_impl=block_impl, **kw)
 
