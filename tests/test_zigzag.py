@@ -49,6 +49,26 @@ def test_zigzag_rejects_indivisible():
         zigzag_indices(36, 8)
 
 
+def test_zigzag_permutation_properties():
+    """For every (t, n): the indices are a true permutation, and each
+    device's shard is [stripe i, stripe 2n-1-i] — so stripe i and its
+    mirror always land on the same device (the balance invariant the
+    causal schedule's FLOP count rests on)."""
+    for n in (1, 2, 3, 4, 5, 8):
+        for mult in (1, 2, 5):
+            t = 2 * n * mult
+            idx = zigzag_indices(t, n)
+            assert sorted(idx) == list(range(t))  # permutation
+            sw = t // (2 * n)
+            per_dev = idx.reshape(n, 2 * sw)
+            for i in range(n):
+                stripes = set(per_dev[i] // sw)
+                assert stripes == {i, 2 * n - 1 - i}, (n, t, i, stripes)
+            # inverse really inverts
+            inv = np.argsort(idx)
+            assert (idx[inv] == np.arange(t)).all()
+
+
 @pytest.mark.parametrize("n_dev", [8, 4, 1])
 def test_zigzag_causal_matches_full(devices, n_dev):
     q, k, v = _qkv()
