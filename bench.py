@@ -7,11 +7,13 @@
 3. Secure-FedAvg round wall-clock per chip (secure_fed_model.py:223).
 
 Prints exactly ONE JSON line; the headline metric is (1), with (2), (3)
-and the self-checks carried as extra keys:
+the sequence-parallel forward sample, and the self-checks carried as
+extra keys:
 
     {"metric": ..., "value": N, "unit": "patches/sec/chip",
      "vs_baseline": N, "mfu": f, "step_tflops": f, "peak_tflops": f,
-     "fed_round_s": f, "secure_round_s": f}
+     "fed_round_s": f, "secure_round_s": f, "ring_fwd_t": n,
+     "ring_fwd_pallas_ms": f, "ring_fwd_speedup_vs_jnp": f}
 
 Measurement methodology (hard-won, round 2): on this environment's
 tunneled TPU runtime, `jax.block_until_ready` can return WITHOUT waiting
@@ -374,6 +376,46 @@ def bench_secure_round(on_accelerator: bool):
     return dt / rounds
 
 
+def bench_ring_attention(on_accelerator: bool):
+    """Sequence-parallel evidence in the official record: forward ring
+    attention at a long local block (causal bf16 B=1 H=8 D=64, ring of
+    1 so t_local == T), fused pallas blocks vs the jnp path — the
+    BENCH-file version of experiments/ring_attention_bench.py's
+    amortized measurement (6 chained calls, best of 2 windows)."""
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.ring_attention import make_ring_attention
+
+    t = 16384 if on_accelerator else 512
+    iters = 6 if on_accelerator else 2
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (1, t, 8, 64)), jnp.bfloat16)
+               for _ in range(3))
+    mesh = meshlib.seq_mesh(1)
+    times = {}
+    for impl in ("pallas", "jnp"):
+        fn = make_ring_attention(mesh, causal=True, block_impl=impl)
+        o = fn(q, k, v)
+        _ = float(jnp.sum(o.astype(jnp.float32)))
+        best = 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            o = q
+            for _ in range(iters):
+                o = fn(o, k, v).astype(jnp.bfloat16)
+            _ = float(jnp.sum(o.astype(jnp.float32)))
+            best = min(best, (time.perf_counter() - t0) / iters)
+        times[impl] = best
+    return {"ring_fwd_t": t,
+            "ring_fwd_pallas_ms": round(times["pallas"] * 1e3, 2),
+            "ring_fwd_speedup_vs_jnp":
+                round(times["jnp"] / times["pallas"], 3)}
+
+
 def main() -> None:
     import jax
 
@@ -385,6 +427,7 @@ def main() -> None:
     cached_pps = bench_vgg_cached_throughput(on_accelerator)
     fed_round_s = bench_fed_round(on_accelerator)
     secure_round_s = bench_secure_round(on_accelerator)
+    ring = bench_ring_attention(on_accelerator)
     if on_accelerator:
         # second headline sample, minutes after the first (the shared
         # chip's load drifts on that timescale; back-to-back windows
@@ -443,6 +486,7 @@ def main() -> None:
         "cached_fine_tune_patches_per_sec_per_chip": round(cached_pps, 2),
         "fed_round_s": round(fed_round_s, 4),
         "secure_round_s": round(secure_round_s, 4),
+        **ring,
         "device_kind": dev.device_kind,
     }
     print(json.dumps(out))
