@@ -169,6 +169,9 @@ def _parse(argv):
                     help="jax.checkpoint each transformer block: the "
                          "backward recomputes block activations instead "
                          "of storing them (long-context memory lever)")
+    sp.add_argument("--dropout", type=float, default=0.0,
+                    help="residual dropout rate inside each block "
+                         "(after attention and after the MLP)")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -460,6 +463,8 @@ def _run_attention(ns):
               "this workload (it trains on the synthetic "
               "position-sensitive sequence task); ignoring it",
               file=sys.stderr)
+    if not 0.0 <= ns.dropout < 1.0:
+        sys.exit(f"--dropout {ns.dropout} must be in [0, 1)")
     n_dev = len(jax.devices())
     # auto ring size: the largest power of two that DIVIDES the device
     # count (capped at 4), so the default never aborts on e.g. 6 devices
@@ -481,7 +486,8 @@ def _run_attention(ns):
         ns.seq_len, ns.features, embed_dim=ns.embed_dim,
         num_heads=ns.num_heads, mlp_dim=ns.mlp_dim,
         num_blocks=ns.num_blocks, num_outputs=1, mesh=mesh, causal=True,
-        layout=ns.layout, block_impl=ns.block_impl, remat=ns.remat)
+        layout=ns.layout, block_impl=ns.block_impl, remat=ns.remat,
+        dropout_rate=ns.dropout)
     batch = ns.batch_size or 64
     lr = ns.lr if ns.lr is not None else 1e-3
     n_train = max(ns.synthetic_examples, 4 * batch)

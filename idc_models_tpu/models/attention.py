@@ -91,8 +91,12 @@ def transformer_block(embed_dim: int, num_heads: int, mlp_dim: int, *,
                       mesh: Mesh | None = None, causal: bool = True,
                       block_impl: str = "jnp",
                       layout: str = "contiguous",
+                      dropout_rate: float = 0.0,
                       name: str = "block") -> core.Module:
-    """Pre-LN transformer block: x + MHA(LN(x)), then + MLP(LN(.))."""
+    """Pre-LN transformer block: x + drop(MHA(LN(x))), then
+    + drop(MLP(LN(.))) — residual dropout in the two standard places
+    (attention-probability dropout would have to live inside the flash
+    kernels and is deliberately not offered)."""
     ln1 = core.layer_norm(embed_dim, name="ln1")
     ln2 = core.layer_norm(embed_dim, name="ln2")
     mha = multi_head_attention(embed_dim, num_heads, mesh=mesh,
@@ -100,6 +104,7 @@ def transformer_block(embed_dim: int, num_heads: int, mlp_dim: int, *,
                                layout=layout)
     fc1 = core.dense(embed_dim, mlp_dim, name="fc1")
     fc2 = core.dense(mlp_dim, embed_dim, name="fc2")
+    drop = core.dropout(dropout_rate)
     parts = (("ln1", ln1), ("mha", mha), ("ln2", ln2), ("fc1", fc1),
              ("fc2", fc2))
 
@@ -109,13 +114,18 @@ def transformer_block(embed_dim: int, num_heads: int, mlp_dim: int, *,
             {k: m.init(r).params for (k, m), r in zip(parts, rngs)}, {})
 
     def apply(params, state, x, *, train=False, rng=None):
+        r1 = r2 = None
+        if rng is not None:
+            r1, r2 = jax.random.split(rng)
         h, _ = ln1.apply(params["ln1"], {}, x, train=train)
         h, _ = mha.apply(params["mha"], {}, h, train=train)
+        h, _ = drop.apply({}, {}, h, train=train, rng=r1)
         x = x + h
         h, _ = ln2.apply(params["ln2"], {}, x, train=train)
         h, _ = fc1.apply(params["fc1"], {}, h, train=train)
         h = jax.nn.gelu(h)
         h, _ = fc2.apply(params["fc2"], {}, h, train=train)
+        h, _ = drop.apply({}, {}, h, train=train, rng=r2)
         return x + h, state
 
     return core.Module(init, apply, name, children=parts)
@@ -129,6 +139,7 @@ def attention_classifier(seq_len: int, features_in: int, *,
                          causal: bool = True,
                          block_impl: str = "jnp",
                          layout: str = "contiguous",
+                         dropout_rate: float = 0.0,
                          remat: bool = False) -> core.Module:
     """Sequence classifier over [B, T, F] inputs: dense embed + learned
     positions -> `num_blocks` ring-attention transformer blocks -> GAP
@@ -144,7 +155,9 @@ def attention_classifier(seq_len: int, features_in: int, *,
     embed = core.dense(features_in, embed_dim, name="embed")
     blocks = [transformer_block(embed_dim, num_heads, mlp_dim, mesh=mesh,
                                 causal=causal, block_impl=block_impl,
-                                layout=layout, name=f"block{i}")
+                                layout=layout,
+                                dropout_rate=dropout_rate,
+                                name=f"block{i}")
               for i in range(num_blocks)]
     ln_f = core.layer_norm(embed_dim, name="ln_f")
     head = core.dense(embed_dim, num_outputs, name="head")
@@ -167,9 +180,11 @@ def attention_classifier(seq_len: int, features_in: int, *,
         h = h + params["pos"].astype(h.dtype)
         if zig:
             h = to_zigzag(h, n_ring)
+        rngs = (jax.random.split(rng, num_blocks) if rng is not None
+                else [None] * num_blocks)
         for i, blk in enumerate(blocks):
-            def run_block(p, h, _blk=blk):
-                return _blk.apply(p, {}, h, train=train)[0]
+            def run_block(p, h, _blk=blk, _r=rngs[i]):
+                return _blk.apply(p, {}, h, train=train, rng=_r)[0]
 
             if remat:
                 run_block = jax.checkpoint(run_block)

@@ -319,6 +319,12 @@ def flatten(name: str = "flatten") -> Module:
 
 
 def dropout(rate: float, name: str = "dropout") -> Module:
+    if not 0.0 <= rate < 1.0:
+        raise ValueError(
+            f"dropout rate must be in [0, 1), got {rate} — negative "
+            f"rates silently rescale activations and rate >= 1 zeroes "
+            f"the branch entirely")
+
     def init(rng):
         return Variables({}, {})
 
