@@ -133,10 +133,18 @@ def seq_mesh(n: int | None = None) -> Mesh:
 def data_seq_mesh(n_seq: int, n_data: int | None = None) -> Mesh:
     """2-D ("data", "seq") mesh: batch shards over "data", the sequence
     (ring-attention) axis over "seq". With n_data omitted, every
-    remaining device joins the data axis. Lay the seq axis innermost so
-    ring hops ride ICI neighbors."""
+    remaining device joins the data axis — n_seq must then be a
+    positive divisor of the device count (silently idling leftover
+    devices would skew any throughput measurement; pass n_data
+    explicitly to use a subset on purpose). Lay the seq axis innermost
+    so ring hops ride ICI neighbors."""
     devs = jax.devices()
     if n_data is None:
+        if n_seq < 1 or len(devs) % n_seq:
+            raise ValueError(
+                f"n_seq {n_seq} must be a positive divisor of the "
+                f"device count ({len(devs)}); pass n_data explicitly "
+                f"to deliberately use a device subset")
         n_data = len(devs) // n_seq
     return make_mesh({DATA_AXIS: n_data, SEQ_AXIS: n_seq},
                      devices=devs[:n_data * n_seq])

@@ -161,8 +161,14 @@ def make_secure_fedavg_round(
     """
     if mask_impl not in ("auto", "threefry", "pallas"):
         raise ValueError(f"unknown mask_impl {mask_impl!r}")
+    # platform decisions key on the MESH's devices, not the process
+    # default backend — a CPU-device client mesh in a TPU-backed
+    # process must neither auto-select the Mosaic kernel nor lower it
+    # uninterpreted (same convention as ring_attention's interp_mode)
+    mesh_platform = mesh.devices.flat[0].platform
     if mask_impl == "auto":
-        mask_impl = resolve_mask_impl(model, percent)
+        mask_impl = resolve_mask_impl(model, percent,
+                                      platform=mesh_platform)
     n_devices = mesh.shape[meshlib.CLIENT_AXIS]
     local_train = make_local_trainer(
         model, optimizer, loss_fn, local_epochs=local_epochs,
@@ -255,7 +261,7 @@ def make_secure_fedavg_round(
                     from idc_models_tpu.ops import secure_masking_kernel as smk
 
                     seed = jax.random.bits(mask_key, (), jnp.uint32)
-                    interp = jax.default_backend() not in ("tpu", "axon")
+                    interp = mesh_platform not in ("tpu", "axon")
                     masked_total = jnp.zeros((flat_k.shape[1],), jnp.int32)
                     for i in range(k):  # k is static and small
                         seeds, signs = smk.pair_seeds_and_signs(
