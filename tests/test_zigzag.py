@@ -49,6 +49,25 @@ def test_zigzag_rejects_indivisible():
         zigzag_indices(36, 8)
 
 
+def test_builder_rejects_bad_knobs(devices):
+    mesh = meshlib.seq_mesh(8)
+    with pytest.raises(ValueError, match="unknown layout"):
+        make_ring_attention(mesh, layout="striped")
+    with pytest.raises(ValueError, match="unknown block_impl"):
+        make_ring_attention(mesh, block_impl="triton")
+    # odd local block under zigzag fails at trace with the real message
+    q, k, v = _qkv(seed=1, t=8 * 5)   # t_local = 5, odd
+    ring = make_ring_attention(mesh, causal=True, layout="zigzag")
+    with pytest.raises(ValueError, match="even local block"):
+        ring(q, k, v)
+    # zigzag + pallas half-block tile check names the 256 rule
+    q2, k2, v2 = _qkv(seed=2, t=8 * 128)  # t_local 128 -> quarters 64
+    ring2 = make_ring_attention(mesh, causal=True, layout="zigzag",
+                                block_impl="pallas")
+    with pytest.raises(ValueError, match="256"):
+        ring2(q2, k2, v2)
+
+
 def test_zigzag_permutation_properties():
     """For every (t, n): the indices are a true permutation, and each
     device's shard is [stripe i, stripe 2n-1-i] — so stripe i and its
