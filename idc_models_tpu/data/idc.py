@@ -162,13 +162,30 @@ def decode_pairs(pairs: list[tuple[str, int]], image_size: int, *,
     return np.stack(imgs)
 
 
+_TASK_POOL: list = [None, 0]  # [executor, max_workers] — per-process
+
+
+def _task_pool(workers: int):
+    """Process-local persistent thread pool for `decode_task`'s PIL
+    fallback — without it each spawned decode worker would build and
+    tear down a fresh ThreadPoolExecutor per batch, losing exactly the
+    amortization the in-process `FileStream._gather` path has."""
+    if _TASK_POOL[0] is None or _TASK_POOL[1] != workers:
+        if _TASK_POOL[0] is not None:
+            _TASK_POOL[0].shutdown(wait=False)
+        _TASK_POOL[0] = ThreadPoolExecutor(max_workers=workers)
+        _TASK_POOL[1] = workers
+    return _TASK_POOL[0]
+
+
 def decode_task(args):
     """Worker-process entry for `pipeline.FileStream`'s multi-process
     decode (one whole batch per task). Lives in this numpy-only module
     so spawn-started workers never import jax on the hot path."""
     pairs, image_size, backend, workers = args
     return decode_pairs(pairs, image_size, workers=workers,
-                        backend=backend)
+                        backend=backend,
+                        pool=lambda: _task_pool(workers))
 
 
 def train_val_test_split(ds: ArrayDataset,

@@ -312,7 +312,11 @@ def make_secure_fedavg_round(
                 lambda x: jnp.where(alive > 0, x, jnp.float32(jnp.nan)),
                 metrics)
             metrics["clients_recovered"] = recovered
-            metrics["clip_saturated"] = clip_saturated
+            # same all-dead masking as the trained metrics: a round where
+            # no real client survives reports NaN across the board, not a
+            # lone finite 0 that a finite-filtering consumer would keep
+            metrics["clip_saturated"] = jnp.where(
+                alive > 0, clip_saturated, jnp.float32(jnp.nan))
             return agg_params, agg_state, metrics
 
         return per_device
