@@ -165,6 +165,18 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def batch_seq_spec(mesh: Mesh, axis: str = SEQ_AXIS,
+                   trailing: int = 2) -> P:
+    """THE sequence-parallel activation layout, defined once: batch over
+    every non-`axis` mesh axis, the sequence dimension over `axis`,
+    `trailing` unsharded dims after it. Shared by the ring op's
+    shard_map specs ([B,T,H,D]: trailing=2), the attention model's
+    residual-stream pin ([B,T,E]: trailing=1), and the decode cache
+    sharding — one definition so the three surfaces cannot diverge."""
+    others = tuple(a for a in mesh.axis_names if a != axis)
+    return P(others if others else None, axis, *([None] * trailing))
+
+
 def batch_axis(mesh: Mesh, axis: str | None = None) -> str:
     """The axis a leading batch dimension shards over: `axis` if given,
     else "data" when present, else the mesh's only axis (so eval and

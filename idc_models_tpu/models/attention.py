@@ -32,13 +32,36 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
 from idc_models_tpu.ring_attention import (
-    full_attention, make_ring_attention, to_zigzag,
+    full_attention, make_ring_attention, to_zigzag, zigzag_indices,
 )
+
+
+def residual_sharding(mesh: Mesh, axis: str = meshlib.SEQ_AXIS):
+    """The [B, T, E] residual-stream sharding on `mesh` — the same
+    layout the ring op forces at its shard_map boundary
+    (`mesh.batch_seq_spec`, one definition for all SP surfaces)."""
+    return NamedSharding(mesh, meshlib.batch_seq_spec(mesh, axis,
+                                                      trailing=1))
+
+
+def _seq_pin(mesh: Mesh | None, axis: str = meshlib.SEQ_AXIS):
+    """Constraint pinning [B, T, E] activations to `residual_sharding`.
+
+    Without this, nothing stops GSPMD from replicating the LN/MLP/embed
+    activations BETWEEN ring calls over "seq" — the long-context memory
+    claim (docs/LONG_CONTEXT.md) would then hold for the attention op
+    but not the model. Gated by tests/test_attention_model.py::
+    test_residual_stream_stays_seq_sharded, which fails if any full-T
+    activation survives in the partitioned module."""
+    if mesh is None:
+        return lambda h: h
+    sh = residual_sharding(mesh, axis)
+    return lambda h: jax.lax.with_sharding_constraint(h, sh)
 
 
 def multi_head_attention(embed_dim: int, num_heads: int, *,
@@ -82,7 +105,8 @@ def multi_head_attention(embed_dim: int, num_heads: int, *,
         k = split(x @ params["wk"].astype(x.dtype))
         v = split(x @ params["wv"].astype(x.dtype))
         o = attn(q, k, v).reshape(b, t, embed_dim)
-        return o @ params["wo"].astype(x.dtype) + params["bo"], state
+        return (o @ params["wo"].astype(x.dtype)
+                + params["bo"].astype(x.dtype)), state
 
     return core.Module(init, apply, name)
 
@@ -165,21 +189,32 @@ def attention_classifier(seq_len: int, features_in: int, *,
     zig = layout == "zigzag" and causal
 
     def init(rng):
-        rngs = jax.random.split(rng, num_blocks + 3)
+        rngs = jax.random.split(rng, num_blocks + 4)
         params = {"embed": embed.init(rngs[0]).params,
                   "pos": 0.02 * jax.random.normal(
                       rngs[1], (seq_len, embed_dim))}
-        for i, (blk, r) in enumerate(zip(blocks, rngs[2:])):
+        for i, (blk, r) in enumerate(zip(blocks, rngs[2:2 + num_blocks])):
             params[f"block{i}"] = blk.init(r).params
-        params["ln_f"] = ln_f.init(rngs[-1]).params
+        params["ln_f"] = ln_f.init(rngs[-2]).params
         params["head"] = head.init(rngs[-1]).params
         return core.Variables(params, {})
 
+    pin = _seq_pin(mesh)
+
     def apply(params, state, x, *, train=False, rng=None):
-        h, _ = embed.apply(params["embed"], {}, x, train=train)
-        h = h + params["pos"].astype(h.dtype)
+        pos = params["pos"]
         if zig:
-            h = to_zigzag(h, n_ring)
+            # Permute the INPUT (and positions to match) rather than the
+            # embedded stream: embed is per-position so the result is
+            # identical, but the gather then touches only input-scale
+            # [B, T, F] / param-scale [T, E] tensors — no full-length
+            # [B, T, E] activation ever materializes, which keeps the
+            # residual stream seq-sharded end to end (see _seq_pin).
+            x = to_zigzag(x, n_ring)
+            pos = jnp.take(pos, zigzag_indices(pos.shape[0], n_ring),
+                           axis=0)
+        h, _ = embed.apply(params["embed"], {}, x, train=train)
+        h = pin(h + pos.astype(h.dtype))
         rngs = (jax.random.split(rng, num_blocks) if rng is not None
                 else [None] * num_blocks)
         for i, blk in enumerate(blocks):
@@ -188,7 +223,7 @@ def attention_classifier(seq_len: int, features_in: int, *,
 
             if remat:
                 run_block = jax.checkpoint(run_block)
-            h = run_block(params[f"block{i}"], h)
+            h = pin(run_block(params[f"block{i}"], h))
         h, _ = ln_f.apply(params["ln_f"], {}, h, train=train)
         pooled = jnp.mean(h, axis=1)   # GAP — permutation-invariant
         y, _ = head.apply(params["head"], {}, pooled, train=train)

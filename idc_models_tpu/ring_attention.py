@@ -577,11 +577,22 @@ def make_ring_attention(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     # dimension, so a 2-D ("data", "seq") mesh composes DP x SP without
     # resharding — each (data, seq) submesh row runs an independent ring
     # over its batch shard.
-    others = tuple(a for a in mesh.axis_names if a != axis)
-    spec = P(others if others else None, axis, None, None)
+    spec = meshlib.batch_seq_spec(mesh, axis, trailing=2)
     mapped = shard_map(body_fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
-    return jax.jit(mapped)
+
+    def checked(q, k, v):
+        # trace-time shape gate with the framework's message, instead of
+        # letting an indivisible T fall into shard_map's generic
+        # sharding error (the knob rejection matrix test pins this)
+        t = q.shape[1]
+        if t % n:
+            raise ValueError(
+                f"sequence length {t} not divisible by the ring size "
+                f"{n} over mesh axis {axis!r}")
+        return mapped(q, k, v)
+
+    return jax.jit(checked)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
