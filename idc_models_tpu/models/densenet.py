@@ -74,17 +74,15 @@ KERAS_LAYER_INDEX = _build_index()
 FREEZE_ALL = 10**9
 
 
-def densenet201_backbone(in_channels: int = 3, *,
-                         bn_frozen_below: int = 0) -> core.Module:
-    """`bn_frozen_below`: BN layers with Keras index < this run in
-    permanent inference mode (Keras trainable=False semantics).
-
-    Built as topology units (stem, one unit per dense layer, one per
-    transition, final BN) over the flat Keras-layer-name params: a dense
-    layer is `h -> concat(h, f(h))` — a pure function of its input — so
-    every unit edge is a valid split point for the frozen-backbone
-    feature cache despite the dense-concat topology.
-    """
+def _units(in_channels: int, bn_frozen_below: int):
+    """The backbone as topology units (stem, one unit per dense layer,
+    one per transition, final BN) over the flat Keras-layer-name params:
+    a dense layer is `h -> concat(h, f(h))` — a pure function of its
+    input — so every unit edge is a valid split point for the
+    frozen-backbone feature cache despite the dense-concat topology.
+    Module-level (like mobilenet._units) so per-stage attribution
+    microbenches (experiments/backbone_mfu.py) can build stage
+    sub-models from unit ranges."""
     specs: list[tuple[str, core.Module]] = []
 
     def reg(m) -> str:
@@ -154,10 +152,17 @@ def densenet201_backbone(in_channels: int = 3, *,
             c = c // 2
     units.append(([reg(bn(c, "bn"))],
                   lambda run, h: jax.nn.relu(run("bn", h))))
+    return units, dict(specs)
 
+
+def densenet201_backbone(in_channels: int = 3, *,
+                         bn_frozen_below: int = 0) -> core.Module:
+    """`bn_frozen_below`: BN layers with Keras index < this run in
+    permanent inference mode (Keras trainable=False semantics)."""
+    units, modules = _units(in_channels, bn_frozen_below)
     # layer_names in Keras creation order (see mobilenet.py) so secure
     # percent-selection keeps get_weights() order for this backbone
-    sec = core.unit_backbone(units, dict(specs), "densenet201",
+    sec = core.unit_backbone(units, modules, "densenet201",
                              KERAS_LAYER_INDEX)
     assert sec.layer_names == tuple(KERAS_LAYER_INDEX)
     return sec

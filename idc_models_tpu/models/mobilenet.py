@@ -87,7 +87,8 @@ _BN = dict(momentum=0.999, eps=1e-3)
 FREEZE_ALL = 10**9  # bn_frozen_below value freezing every BN layer
 
 
-def _units(in_channels: int, bn_frozen_below: int):
+def _units(in_channels: int, bn_frozen_below: int,
+           depthwise_impl: str = "grouped"):
     """The backbone as a list of topology units — unit 0 = stem (Conv1 +
     block 0), units 1..16 = inverted-residual blocks, unit 17 = the
     Conv_1 top. Each unit is (param_names, apply_fn(run, h) -> h) where
@@ -115,6 +116,7 @@ def _units(in_channels: int, bn_frozen_below: int):
                         name="Conv1")),
         reg(_bn(32, "bn_Conv1")),
         reg(core.depthwise_conv2d(32, 3, use_bias=False,
+                                  impl=depthwise_impl,
                                   name="expanded_conv_depthwise")),
         reg(_bn(32, "expanded_conv_depthwise_BN")),
         reg(core.conv2d(32, 16, 1, use_bias=False,
@@ -139,6 +141,7 @@ def _units(in_channels: int, bn_frozen_below: int):
                             name=f"block_{b}_expand")),
             reg(_bn(hidden, f"block_{b}_expand_BN")),
             reg(core.depthwise_conv2d(hidden, 3, stride=s, use_bias=False,
+                                      impl=depthwise_impl,
                                       name=f"block_{b}_depthwise")),
             reg(_bn(hidden, f"block_{b}_depthwise_BN")),
             reg(core.conv2d(hidden, c, 1, use_bias=False,
@@ -167,7 +170,8 @@ def _units(in_channels: int, bn_frozen_below: int):
 
 
 def mobilenet_v2_backbone(in_channels: int = 3, *,
-                          bn_frozen_below: int = 0) -> core.Module:
+                          bn_frozen_below: int = 0,
+                          depthwise_impl: str = "grouped") -> core.Module:
     """Returns the backbone module; params keyed by Keras layer names.
 
     `bn_frozen_below`: BN layers with Keras index < this run in permanent
@@ -180,7 +184,7 @@ def mobilenet_v2_backbone(in_channels: int = 3, *,
     residual topology; the split lands on the last unit edge where every
     earlier layer has Keras index < fine_tune_at.
     """
-    units, modules = _units(in_channels, bn_frozen_below)
+    units, modules = _units(in_channels, bn_frozen_below, depthwise_impl)
     # layer_names in Keras creation order (_build_index inserts names in
     # ascending Keras-index order) so secure percent-selection follows
     # get_weights() order for this backbone too (secure_fed_model.py:115-121)
@@ -191,9 +195,11 @@ def mobilenet_v2_backbone(in_channels: int = 3, *,
 
 
 def mobilenet_v2(num_outputs: int = 1, in_channels: int = 3, *,
-                 bn_frozen_below: int = 0) -> core.Module:
+                 bn_frozen_below: int = 0,
+                 depthwise_impl: str = "grouped") -> core.Module:
     backbone = mobilenet_v2_backbone(in_channels,
-                                     bn_frozen_below=bn_frozen_below)
+                                     bn_frozen_below=bn_frozen_below,
+                                     depthwise_impl=depthwise_impl)
     return core.classifier(backbone, 1280, num_outputs,
                            name="mobilenet_v2_classifier")
 

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from idc_models_tpu.models import core, small_cnn
 
@@ -53,6 +54,30 @@ def test_depthwise_conv():
     y, _ = m.apply(v.params, v.state, jnp.ones((2, 8, 8, 6)))
     assert y.shape == (2, 8, 8, 6)
     assert v.params["kernel"].shape == (3, 3, 1, 6)
+
+
+@pytest.mark.parametrize("stride,size", [(1, 8), (1, 7), (2, 8), (2, 7),
+                                         (2, 25)])
+def test_depthwise_taps_matches_grouped(stride, size):
+    """impl='taps' (explicit shifted elementwise MAC) is the same math as
+    XLA's grouped-conv lowering — SAME padding, both strides, odd/even
+    spatial (25 = the MobileNet 50x50 post-stem resolution)."""
+    grouped = core.depthwise_conv2d(6, 3, stride=stride)
+    taps = core.depthwise_conv2d(6, 3, stride=stride, impl="taps")
+    v = grouped.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, size, size, 6))
+    yg, _ = grouped.apply(v.params, v.state, x)
+    yt, _ = taps.apply(v.params, v.state, x)
+    assert yg.shape == yt.shape
+    np.testing.assert_allclose(np.asarray(yt), np.asarray(yg),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_depthwise_taps_rejections():
+    with pytest.raises(ValueError, match="grouped|taps"):
+        core.depthwise_conv2d(6, 3, impl="im2col")
+    with pytest.raises(ValueError, match="SAME"):
+        core.depthwise_conv2d(6, 3, impl="taps", padding="VALID")
 
 
 def test_batch_norm_train_vs_eval():
