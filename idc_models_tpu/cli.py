@@ -172,6 +172,15 @@ def _parse(argv):
     sp.add_argument("--dropout", type=float, default=0.0,
                     help="residual dropout rate inside each block "
                          "(after attention and after the MLP)")
+    sp.add_argument("--patch-size", type=int, default=5,
+                    help="with --data-dir: each image becomes a raster "
+                         "sequence of patch-size^2-pixel tokens "
+                         "(data.sequences.patchify); 1 = per-pixel "
+                         "sequence. --seq-len/--features are then "
+                         "derived from the images, not the flags")
+    sp.add_argument("--image-size", type=int, default=50,
+                    help="with --data-dir: decode size of the IDC "
+                         "patches (the reference's 50)")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -439,16 +448,20 @@ def _loss_for(num_outputs):
 
 def _run_attention(ns):
     """Beyond-reference workload: the ring-attention transformer
-    classifier on the position-sensitive synthetic sequence task, over a
-    ("data", "seq") mesh — sequence parallelism from the command line,
-    under the same step/eval/logging machinery as every other preset."""
+    classifier over a ("data", "seq") mesh — sequence parallelism from
+    the command line, under the same step/eval/logging machinery as
+    every other preset. Trains on the position-sensitive synthetic
+    sequence task, or — with --data-dir — on the reference's own IDC
+    patch tree (C1/C2), each image embedded as a raster token sequence
+    (data.sequences.patchify; see docs/LONG_CONTEXT.md)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from idc_models_tpu import mesh as meshlib
     from idc_models_tpu.data import synthetic
-    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.data.idc import ArrayDataset, train_val_test_split
+    from idc_models_tpu.data.sequences import patchify, sequence_shape
     from idc_models_tpu.models.attention import attention_classifier
     from idc_models_tpu.observe import Timer, profile_trace
     from idc_models_tpu.train import (
@@ -458,13 +471,21 @@ def _run_attention(ns):
     from idc_models_tpu.train.loop import Evaluator
     from idc_models_tpu.train.losses import binary_cross_entropy
 
-    if ns.data_dir:
-        print("[idc_models_tpu] attention: --data-dir is not used by "
-              "this workload (it trains on the synthetic "
-              "position-sensitive sequence task); ignoring it",
-              file=sys.stderr)
     if not 0.0 <= ns.dropout < 1.0:
         sys.exit(f"--dropout {ns.dropout} must be in [0, 1)")
+    # explicit --data-dir ONLY (not _data_root's <path>/data fallback):
+    # real data overrides --seq-len/--features with the derived patch
+    # sequence shape, so an artifact dir that happens to contain the
+    # IDC tree must not silently turn a long-context synthetic run into
+    # a 100-token IDC run
+    root = ns.data_dir
+    seq_len, features = ns.seq_len, ns.features
+    if root is not None:
+        try:
+            seq_len, features = sequence_shape(ns.image_size,
+                                               ns.patch_size)
+        except ValueError as e:
+            sys.exit(f"--patch-size: {e}")
     n_dev = len(jax.devices())
     # auto ring size: the largest power of two that DIVIDES the device
     # count (capped at 4), so the default never aborts on e.g. 6 devices
@@ -474,8 +495,12 @@ def _run_attention(ns):
         sys.exit(f"--seq-parallel {n_seq} must be a positive divisor "
                  f"of the device count ({n_dev})")
     stripes = 2 * n_seq if ns.layout == "zigzag" else n_seq
-    if ns.seq_len % stripes:
-        sys.exit(f"--seq-len {ns.seq_len} must divide into {stripes} "
+    what = ("--seq-len" if root is None
+            else f"the {seq_len}-token patch sequence "
+                 f"({ns.image_size}x{ns.image_size} images at "
+                 f"--patch-size {ns.patch_size})")
+    if seq_len % stripes:
+        sys.exit(f"{what} = {seq_len} must divide into {stripes} "
                  f"equal stripes for --layout {ns.layout} at ring "
                  f"size {n_seq}")
     mesh = meshlib.data_seq_mesh(n_seq)
@@ -483,19 +508,30 @@ def _run_attention(ns):
           f"(data={mesh.shape[meshlib.DATA_AXIS]}, seq={n_seq})")
 
     model = attention_classifier(
-        ns.seq_len, ns.features, embed_dim=ns.embed_dim,
+        seq_len, features, embed_dim=ns.embed_dim,
         num_heads=ns.num_heads, mlp_dim=ns.mlp_dim,
         num_blocks=ns.num_blocks, num_outputs=1, mesh=mesh, causal=True,
         layout=ns.layout, block_impl=ns.block_impl, remat=ns.remat,
         dropout_rate=ns.dropout)
     batch = ns.batch_size or 64
     lr = ns.lr if ns.lr is not None else 1e-3
-    n_train = max(ns.synthetic_examples, 4 * batch)
-    x, y = synthetic.make_sequence_task(n_train, ns.seq_len, ns.features,
-                                        seed=ns.seed)
-    vx, vy = synthetic.make_sequence_task(max(n_train // 4, batch),
-                                          ns.seq_len, ns.features,
-                                          seed=ns.seed + 1)
+    if root is not None:
+        # the reference's data domain through the SP path: decode the
+        # labeled tree (C1), deterministic 80/10/10 split (C4), then
+        # tokenize each patch
+        ds = _load_idc(ns, ns.image_size, None)
+        train_ds, val_ds, _ = train_val_test_split(ds, seed=ns.seed)
+        x, y = patchify(train_ds.images, ns.patch_size), train_ds.labels
+        vx, vy = patchify(val_ds.images, ns.patch_size), val_ds.labels
+        print(f"IDC patch sequences: {len(x)} train / {len(vx)} val, "
+              f"{seq_len} tokens x {features} features per patch")
+    else:
+        n_train = max(ns.synthetic_examples, 4 * batch)
+        x, y = synthetic.make_sequence_task(n_train, seq_len, features,
+                                            seed=ns.seed)
+        vx, vy = synthetic.make_sequence_task(max(n_train // 4, batch),
+                                              seq_len, features,
+                                              seed=ns.seed + 1)
 
     opt = rmsprop(lr)
     variables = model.init(jax.random.key(ns.seed))

@@ -350,3 +350,37 @@ def test_cifar10_synthetic_splits_differ():
         tr = cifar10.load_cifar10(None, split="train", synthetic_size=64)
         te = cifar10.load_cifar10(None, split="test", synthetic_size=64)
     assert not np.array_equal(tr.images, te.images)
+
+
+def test_patchify_token_mapping():
+    """patchify: raster-order tokens, each the row-major flatten of one
+    sub-patch with channels innermost; patch_size=1 is the per-pixel
+    sequence; the token count/width match sequence_shape."""
+    from idc_models_tpu.data import sequences
+
+    rng = np.random.default_rng(0)
+    imgs = rng.random((2, 6, 6, 3)).astype(np.float32)
+    toks = sequences.patchify(imgs, 3)
+    assert toks.shape == (2, 4, 27)
+    assert toks.shape[1:] == sequences.sequence_shape(6, 3)
+    # token 1 = sub-patch at (row 0, col 1); feature order (py, px, c)
+    np.testing.assert_array_equal(
+        toks[0, 1].reshape(3, 3, 3), imgs[0, 0:3, 3:6, :])
+    # token 2 = sub-patch at (row 1, col 0)
+    np.testing.assert_array_equal(
+        toks[1, 2].reshape(3, 3, 3), imgs[1, 3:6, 0:3, :])
+    # per-pixel degenerate case
+    pix = sequences.patchify(imgs, 1)
+    assert pix.shape == (2, 36, 3)
+    np.testing.assert_array_equal(pix[0, 7], imgs[0, 1, 1, :])
+
+
+def test_patchify_rejections():
+    from idc_models_tpu.data import sequences
+
+    with pytest.raises(ValueError, match="divisible"):
+        sequences.patchify(np.zeros((1, 6, 6, 3), np.float32), 4)
+    with pytest.raises(ValueError, match="N, S, S, C"):
+        sequences.patchify(np.zeros((6, 6, 3), np.float32), 2)
+    with pytest.raises(ValueError, match=">= 1"):
+        sequences.sequence_shape(6, 0)
