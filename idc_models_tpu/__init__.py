@@ -12,6 +12,7 @@ Layering (bottom-up):
 - `mesh` / `collectives`    device mesh + XLA collective wrappers (ICI/DCN)
 - `tp`                      channel-wise tensor parallelism ("model" axis)
 - `ring_attention`          exact long-context attention, "seq"-sharded ring
+- `ring_decode`             ring-sharded KV-cache single-token decoding
 - `data`                    host-side loaders + host->HBM prefetch pipeline
 - `models`                  explicit-pytree model zoo (pure jnp)
 - `train`                   jitted train/eval steps, two-phase loops, metrics
@@ -23,4 +24,6 @@ Layering (bottom-up):
 
 __version__ = "0.1.0"
 
-from idc_models_tpu import collectives, mesh, ring_attention, tp  # noqa: F401
+from idc_models_tpu import (  # noqa: F401
+    collectives, mesh, ring_attention, ring_decode, tp,
+)

@@ -1,0 +1,196 @@
+"""Ring-sharded KV-cache decoding: serve the long contexts the ring trains.
+
+The training side (`ring_attention.py`) shards the sequence over a
+"seq" mesh axis and never materializes it on one device; this module
+gives inference the same property. The KV cache lives sharded over the
+ring — device i owns cache slots [i*T/n, (i+1)*T/n) — and a decode step
+for ONE new token is:
+
+1. append: the slot owner (pos // t_shard) writes the new k/v into its
+   resident shard; every other device's shard is untouched — no
+   collective, the cache never moves;
+2. local attend: every device scores the (replicated, [B, 1, H, D])
+   query against its OWN K/V shard, masked to global positions <= pos —
+   a [B, H, t_shard] score row, never [T, T] anything;
+3. merge: one numerically-stable distributed softmax combine over the
+   "seq" axis — `pmax` of the local maxima, then a single `psum` of the
+   corrected (l, acc) partials. Two collectives per token, both riding
+   ICI; O(T/n) memory per device, exactly like training.
+
+This is flash-attention's (m, l, acc) algebra applied ACROSS devices
+instead of across ring steps: where training's ring rotates K/V blocks
+through a fixed schedule, decode holds K/V still and reduces the
+per-shard partials — the right shape for one-token queries, where a
+rotating ring would serialize n hops for no reuse.
+
+The cache layout IS the training layout (contiguous "seq" sharding of
+[B, T, H, D]), so a trained model's prompt K/V can be placed directly:
+pad to t_max, `jax.device_put` under `cache_sharding`, and decode
+continues from there — `prefill` does exactly this and is pinned
+bit-identical to decoding the prompt token by token. The zigzag layout
+is a TRAINING optimization (balancing a causal ring schedule that
+decode does not run) and deliberately has no decode counterpart.
+
+Exactness: every step equals the last row of full causal attention over
+the sequence so far, fp tolerance, pinned by tests/test_ring_decode.py.
+The reference has no serving path at all (SURVEY.md §2 ends at training
++ eval), so this is beyond-parity capability.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from idc_models_tpu import collectives
+from idc_models_tpu import mesh as meshlib
+
+shard_map = jax.shard_map
+
+_MASKED = -1e30  # same finite sentinel as ring_attention._MASKED
+
+
+def cache_sharding(mesh: Mesh, axis: str = meshlib.SEQ_AXIS) -> NamedSharding:
+    """[B, T_max, H, D] cache layout — identical to the training-side
+    q/k/v sharding (`mesh.batch_seq_spec`, the one shared definition),
+    so trained K/V drops in with no relayout."""
+    return NamedSharding(mesh, meshlib.batch_seq_spec(mesh, axis,
+                                                      trailing=2))
+
+
+def init_cache(mesh: Mesh, batch: int, t_max: int, heads: int, dim: int,
+               *, dtype=jnp.bfloat16, axis: str = meshlib.SEQ_AXIS):
+    """Zero-initialized (k, v) caches, sharded over the ring."""
+    n = mesh.shape[axis]
+    if t_max % n:
+        raise ValueError(f"t_max {t_max} not divisible by the ring size "
+                         f"{n} over mesh axis {axis!r}")
+    sh = cache_sharding(mesh, axis)
+    mk = functools.partial(jnp.zeros, (batch, t_max, heads, dim), dtype)
+    return (jax.device_put(mk(), sh), jax.device_put(mk(), sh))
+
+
+def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
+                     scale: float | None = None):
+    """Build ``fn(k_cache, v_cache, q_t, k_t, v_t, pos) ->
+    (out_t, k_cache, v_cache)``.
+
+    q_t/k_t/v_t are the ONE new token's projections, [B, 1, H, D]
+    (replicated over `axis`); `pos` is its global position (int32
+    scalar; cache slots > pos must still be zero/garbage-masked). The
+    returned function is jitted with both caches donated — the decode
+    loop updates in place, O(1) HBM traffic per step beyond the shard
+    writes."""
+    n = mesh.shape[axis]
+
+    def per_device(kc, vc, q, kt, vt, pos):
+        b, t_shard, h, d = kc.shape
+        i = collectives.axis_index(axis)
+        scale_ = scale if scale is not None else d ** -0.5
+        pos = jnp.asarray(pos, jnp.int32)
+        owner = pos // t_shard
+        slot = pos % t_shard
+        # 1. append — O(1) traffic: read the ONE slot, select the new
+        # token on the owner (non-owners write their existing value
+        # back), one single-slot update that donation lowers in place —
+        # never a whole-shard copy
+        mine = (owner == i)
+        old_k = lax.dynamic_slice(kc, (0, slot, 0, 0), kt.shape)
+        old_v = lax.dynamic_slice(vc, (0, slot, 0, 0), vt.shape)
+        kc = lax.dynamic_update_slice(
+            kc, jnp.where(mine, kt.astype(kc.dtype), old_k),
+            (0, slot, 0, 0))
+        vc = lax.dynamic_update_slice(
+            vc, jnp.where(mine, vt.astype(vc.dtype), old_v),
+            (0, slot, 0, 0))
+        # 2. local attend against the resident shard, f32 accumulation
+        s = jnp.einsum("bhd,bkhd->bhk", q[:, 0].astype(jnp.float32),
+                       kc.astype(jnp.float32)) * scale_
+        visible = (i * t_shard + jnp.arange(t_shard)) <= pos
+        s = jnp.where(visible[None, None, :], s, _MASKED)
+        m_loc = jnp.max(s, axis=-1)                       # [B, H]
+        p = jnp.exp(s - m_loc[..., None])
+        # a fully-masked shard (all slots beyond pos) contributes
+        # p = exp(0) = 1 garbage — zero it explicitly so the psum is
+        # exact rather than relying on the corr ~ exp(_MASKED - m) == 0
+        # underflow
+        p = jnp.where(visible[None, None, :], p, 0.0)
+        l_loc = jnp.sum(p, axis=-1)                       # [B, H]
+        acc_loc = jnp.einsum("bhk,bkhd->bhd", p,
+                             vc.astype(jnp.float32))      # [B, H, D]
+        # 3. one stable softmax merge across the ring
+        m_glob = lax.pmax(m_loc, axis)
+        corr = jnp.exp(m_loc - m_glob)
+        l_glob = collectives.psum(l_loc * corr, axis)
+        acc_glob = collectives.psum(acc_loc * corr[..., None], axis)
+        out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]  # [B,H,D]
+        return out[:, None].astype(q.dtype), kc, vc  # [B,1,H,D]
+
+    others = tuple(a for a in mesh.axis_names if a != axis)
+    bo = others if others else None
+    cache_spec = P(bo, axis, None, None)
+    tok_spec = P(bo, None, None, None)
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(cache_spec, cache_spec, tok_spec, tok_spec, tok_spec,
+                  P()),
+        out_specs=(tok_spec, cache_spec, cache_spec),
+        check_vma=False,
+    )
+
+    def checked(kc, vc, q_t, k_t, v_t, pos):
+        if q_t.shape[1] != 1:
+            raise ValueError(
+                f"ring decode takes ONE token per step: q_t has "
+                f"sequence length {q_t.shape[1]} (batch prefill goes "
+                f"through `prefill` / the training ring)")
+        if kc.shape[1] % n:
+            raise ValueError(
+                f"cache length {kc.shape[1]} not divisible by the ring "
+                f"size {n} over mesh axis {axis!r}")
+        return mapped(kc, vc, q_t, k_t, v_t, pos)
+
+    jitted = jax.jit(checked, donate_argnums=(0, 1))
+
+    def fn(kc, vc, q_t, k_t, v_t, pos):
+        # pos >= t_max would silently drop the append (no shard owns
+        # the slot) and return attention that excludes the new token —
+        # reject concrete out-of-range positions here; callers tracing
+        # pos (their own jit/scan loop) own the bound as a contract
+        import numpy as _np
+
+        if isinstance(pos, (int, _np.integer)) and not (
+                0 <= pos < kc.shape[1]):
+            raise ValueError(
+                f"pos {pos} outside the cache (t_max {kc.shape[1]}) — "
+                f"grow the cache at init/prefill time; decode cannot "
+                f"append past it")
+        return jitted(kc, vc, q_t, k_t, v_t, pos)
+
+    return fn
+
+
+def prefill(mesh: Mesh, k_prompt, v_prompt, t_max: int, *,
+            axis: str = meshlib.SEQ_AXIS, dtype=jnp.bfloat16):
+    """Place a prompt's [B, P, H, D] K/V directly into a fresh ring
+    cache (pad to t_max, shard) — bit-identical to decoding the prompt
+    token by token (pinned by test), without the O(P) python loop.
+    Returns (k_cache, v_cache); attention outputs for the prompt itself
+    come from the training ring (`make_ring_attention`), which shares
+    this layout."""
+    b, p_len, h, d = k_prompt.shape
+    if p_len > t_max:
+        raise ValueError(f"prompt length {p_len} exceeds t_max {t_max}")
+    sh = cache_sharding(mesh, axis)
+    n = mesh.shape[axis]
+    if t_max % n:
+        raise ValueError(f"t_max {t_max} not divisible by the ring size "
+                         f"{n} over mesh axis {axis!r}")
+    pad = ((0, 0), (0, t_max - p_len), (0, 0), (0, 0))
+    kc = jnp.pad(k_prompt.astype(dtype), pad)
+    vc = jnp.pad(v_prompt.astype(dtype), pad)
+    return jax.device_put(kc, sh), jax.device_put(vc, sh)

@@ -1,0 +1,144 @@
+"""Ring-sharded KV-cache decode == full causal attention, step by step.
+
+Every decoded token's output must equal the LAST ROW of full causal
+attention over the sequence so far (exact attention, distributed
+softmax merge) — on 1-D rings of several sizes (incl. non-power-of-2),
+on the 2-D ("data", "seq") mesh, and continuing from a `prefill`-placed
+prompt bit-identically to having decoded the prompt token by token."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.ring_attention import full_attention
+from idc_models_tpu.ring_decode import (
+    cache_sharding, init_cache, make_ring_decode, prefill,
+)
+
+B, H, D = 2, 2, 8
+
+
+def _kvq(t, seed=0, b=B):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(0, 1, (b, t, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _decode_all(mesh, q, k, v, t_max, *, dtype=jnp.float32):
+    """Feed tokens one at a time; stack the per-step outputs."""
+    b = q.shape[0]
+    kc, vc = init_cache(mesh, b, t_max, H, D, dtype=dtype)
+    step = make_ring_decode(mesh)
+    outs = []
+    for pos in range(q.shape[1]):
+        tok = slice(pos, pos + 1)
+        out, kc, vc = step(kc, vc, q[:, tok], k[:, tok], v[:, tok],
+                           pos)
+        outs.append(out)
+    return jnp.concatenate(outs, axis=1), kc, vc
+
+
+@pytest.mark.parametrize("n_dev", [1, 3, 4, 8])
+def test_decode_matches_full_causal(devices, n_dev):
+    t = 24
+    q, k, v = _kvq(t, seed=n_dev)
+    mesh = meshlib.seq_mesh(n_dev)
+    out, _, _ = _decode_all(mesh, q, k, v, t)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_on_2d_mesh(devices):
+    """Batch shards over "data" while every data row reduces its own
+    ("seq")-sharded cache — DP serving composes like DP training."""
+    t = 16
+    q, k, v = _kvq(t, seed=9, b=4)
+    mesh = meshlib.data_seq_mesh(2, 4)
+    out, _, _ = _decode_all(mesh, q, k, v, t)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_partial_cache(devices):
+    """t_max larger than the decoded length: empty slots (including
+    entire shards nobody has reached yet) contribute exactly zero to
+    the merge."""
+    t, t_max = 6, 32
+    q, k, v = _kvq(t, seed=3)
+    mesh = meshlib.seq_mesh(8)   # shards of 4; slots 6..31 empty
+    out, _, _ = _decode_all(mesh, q, k, v, t_max)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_prefill_equals_tokenwise(devices):
+    """`prefill`-placed prompt K/V + decode of the suffix == decoding
+    everything token by token (caches bit-identical, outputs equal)."""
+    t, p_len = 16, 10
+    q, k, v = _kvq(t, seed=5)
+    mesh = meshlib.seq_mesh(4)
+    # path A: decode everything
+    out_a, kc_a, vc_a = _decode_all(mesh, q, k, v, t)
+    # path B: prefill the first p_len, decode the rest
+    kc, vc = prefill(mesh, k[:, :p_len], v[:, :p_len], t,
+                     dtype=jnp.float32)
+    step = make_ring_decode(mesh)
+    outs = []
+    for pos in range(p_len, t):
+        tok = slice(pos, pos + 1)
+        out, kc, vc = step(kc, vc, q[:, tok], k[:, tok], v[:, tok], pos)
+        outs.append(out)
+    np.testing.assert_array_equal(np.asarray(kc), np.asarray(kc_a))
+    np.testing.assert_array_equal(np.asarray(vc), np.asarray(vc_a))
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate(outs, axis=1)),
+        np.asarray(out_a[:, p_len:]), rtol=1e-6, atol=1e-6)
+
+
+def test_cache_stays_sharded(devices):
+    """The cache keeps its ring sharding through decode steps — no
+    device ever holds the full cache (the serving-side O(T/n) claim)."""
+    t = 16
+    q, k, v = _kvq(t, seed=7)
+    mesh = meshlib.seq_mesh(8)
+    _, kc, vc = _decode_all(mesh, q, k, v, t)
+    want = cache_sharding(mesh)
+    assert kc.sharding.is_equivalent_to(want, kc.ndim)
+    assert vc.sharding.is_equivalent_to(want, vc.ndim)
+
+
+def test_decode_bf16_cache(devices):
+    """bf16 caches (the serving default) stay within bf16 tolerance of
+    the f32 reference — accumulation is f32 inside the merge."""
+    t = 12
+    q, k, v = _kvq(t, seed=11)
+    mesh = meshlib.seq_mesh(4)
+    out, _, _ = _decode_all(mesh, q.astype(jnp.bfloat16),
+                            k.astype(jnp.bfloat16),
+                            v.astype(jnp.bfloat16), t,
+                            dtype=jnp.bfloat16)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+def test_decode_rejections(devices):
+    mesh = meshlib.seq_mesh(4)
+    with pytest.raises(ValueError, match="not divisible"):
+        init_cache(mesh, B, 30, H, D)
+    with pytest.raises(ValueError, match="ONE token"):
+        kc, vc = init_cache(mesh, B, 16, H, D, dtype=jnp.float32)
+        q, k, v = _kvq(16)
+        make_ring_decode(mesh)(kc, vc, q[:, :2], k[:, :2], v[:, :2], 0)
+    with pytest.raises(ValueError, match="exceeds t_max"):
+        q, k, v = _kvq(16)
+        prefill(mesh, k, v, 8)
+    with pytest.raises(ValueError, match="outside the cache"):
+        kc, vc = init_cache(mesh, B, 16, H, D, dtype=jnp.float32)
+        q, k, v = _kvq(16)
+        make_ring_decode(mesh)(kc, vc, q[:, :1], k[:, :1], v[:, :1], 16)
