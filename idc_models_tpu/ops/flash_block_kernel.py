@@ -22,8 +22,10 @@ block starts) — no mask tensor is built or shipped.
 Measured on one TPU v5 lite chip (causal, B=1 H=8 D=64 bf16, ring of 1
 so t_local == T; 20 chained calls per timing window so the tunneled
 runtime's ~90 ms dispatch overhead is amortized out): t_local=4096
-1.07x (6.2 vs 6.7 ms/call), 8192 1.41x (10.2 vs 14.4 ms), 16384 1.62x
-(25.5 vs 41.4 ms) — the jnp path's t_local^2 f32 score tensor goes
+1.07x (6.2 vs 6.7 ms/call), 8192 1.41x (10.2 vs 14.4 ms), 16384
+1.44-1.62x across rounds (25.5-38.4 vs ~41-55 ms; the shared chip
+drifts +/-10%, so bench.py records best AND median every round rather
+than a single headline) — the jnp path's t_local^2 f32 score tensor goes
 HBM-bound exactly where the fused kernel keeps scores in VMEM. The
 kernel is the right choice once t_local reaches the many-thousands;
 `block_impl="jnp"` stays the default for the moderate blocks typical
