@@ -1,0 +1,117 @@
+"""Remat necessity proof (VERDICT r4 #4).
+
+Round 4 measured `remat=True` (jax.checkpoint per transformer block)
+only at 32,768 tokens / 6 blocks, where BOTH variants fit the 16 GB
+chip — the +33% step cost bought nothing demonstrated. This script
+finds the (seq_len, num_blocks) point on the chip where the
+stored-activation model FAILS to compile/allocate and the remat model
+TRAINS, recording both sides like the flash backward's 16k existence
+proof (experiments/flash_bwd_bench.jsonl pattern).
+
+Config family: the long-context model at its bench shape (d_model=512,
+8 heads, mlp 2048, pallas blocks, ring of 1, bf16 train step, batch 1).
+Candidates walk upward until the split point appears; each side's
+outcome (step ms, or the failure type) is one JSONL row in
+experiments/remat_necessity.jsonl.
+
+Run: python experiments/remat_necessity.py
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OUT = pathlib.Path(__file__).parent / "remat_necessity.jsonl"
+
+CANDIDATES = [
+    # (seq_len, num_blocks) — walk memory upward; 32k/6 is the round-4
+    # both-fit anchor re-measured for continuity
+    (32768, 6),
+    (32768, 12),
+    (65536, 8),
+]
+
+
+def try_step(seq_len: int, num_blocks: int, remat: bool):
+    """Compile + run 2 train steps; returns dict(ok, step_ms | error)."""
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.attention import attention_classifier
+    from idc_models_tpu.train import (
+        TrainState, jit_data_parallel, make_train_step, replicate, rmsprop,
+    )
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    mesh = meshlib.seq_mesh(1)
+    model = attention_classifier(seq_len, 8, embed_dim=512, num_heads=8,
+                                 mlp_dim=2048, num_blocks=num_blocks,
+                                 num_outputs=1, mesh=mesh, causal=True,
+                                 block_impl="pallas", remat=remat)
+    try:
+        opt = rmsprop(1e-4)
+        variables = model.init(jax.random.key(0))
+        state = TrainState(step=jnp.zeros((), jnp.int32),
+                           params=variables.params,
+                           model_state=variables.state,
+                           opt_state=opt.init(variables.params))
+        step = jit_data_parallel(
+            make_train_step(model, opt, binary_cross_entropy,
+                            compute_dtype=jnp.bfloat16), mesh,
+            axis=meshlib.SEQ_AXIS)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(0, 1, (1, seq_len, 8)), jnp.float32)
+        y = jnp.asarray([1], jnp.int32)
+        state = replicate(mesh, state)
+        key = jax.random.key(1)
+        compiled = step.lower(state, x, y, key).compile()
+        try:
+            ma = compiled.memory_analysis()
+            mem = {"temp_gb": round(ma.temp_size_in_bytes / 2**30, 2),
+                   "args_gb": round(ma.argument_size_in_bytes / 2**30, 2)}
+        except Exception:  # noqa: BLE001 — not all backends expose it
+            mem = {}
+        digest = jax.jit(lambda s: jnp.sum(
+            s.params["head"]["kernel"].astype(jnp.float32)))
+        state, _ = compiled(state, x, y, key)      # warm
+        _ = float(digest(state))
+        t0 = time.perf_counter()
+        state, _ = compiled(state, x, y, jax.random.key(2))
+        _ = float(digest(state))
+        return {"ok": True,
+                "step_ms": round((time.perf_counter() - t0) * 1e3, 1),
+                **mem}
+    except Exception as e:  # noqa: BLE001 — the failure IS the datapoint
+        return {"ok": False,
+                "error": f"{type(e).__name__}: {e}"[:300]}
+
+
+def main():
+    dev = jax.devices()[0]
+    with OUT.open("a") as f:
+        for seq_len, num_blocks in CANDIDATES:
+            row = {"seq_len": seq_len, "num_blocks": num_blocks,
+                   "d_model": 512, "mlp": 2048,
+                   "device_kind": dev.device_kind}
+            for remat in (False, True):
+                r = try_step(seq_len, num_blocks, remat)
+                row["remat" if remat else "stored"] = r
+                print(f"T={seq_len} blocks={num_blocks} "
+                      f"remat={remat}: {r}", flush=True)
+            line = json.dumps(row)
+            f.write(line + "\n")
+            f.flush()
+            stored, rem = row["stored"], row["remat"]
+            if not stored["ok"] and rem["ok"]:
+                print(f"NECESSITY POINT: T={seq_len} blocks={num_blocks} "
+                      f"— stored fails ({stored['error'][:80]}), remat "
+                      f"trains at {rem['step_ms']} ms", flush=True)
+                break
+
+
+if __name__ == "__main__":
+    main()
