@@ -108,8 +108,11 @@ def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
             vc, jnp.where(mine, vt.astype(vc.dtype), old_v),
             (0, slot, 0, 0))
         # 2. local attend against the resident shard, f32 accumulation
-        s = jnp.einsum("bhd,bkhd->bhk", q[:, 0].astype(jnp.float32),
-                       kc.astype(jnp.float32)) * scale_
+        # (preferred_element_type, NOT astype: upcasting a 64k-slot bf16
+        # cache would materialize a 2x-size f32 copy per step — the MXU
+        # accumulates in f32 natively, same as ring_attention's blocks)
+        s = jnp.einsum("bhd,bkhd->bhk", q[:, 0], kc,
+                       preferred_element_type=jnp.float32) * scale_
         visible = (i * t_shard + jnp.arange(t_shard)) <= pos
         s = jnp.where(visible[None, None, :], s, _MASKED)
         m_loc = jnp.max(s, axis=-1)                       # [B, H]
@@ -120,8 +123,8 @@ def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         # underflow
         p = jnp.where(visible[None, None, :], p, 0.0)
         l_loc = jnp.sum(p, axis=-1)                       # [B, H]
-        acc_loc = jnp.einsum("bhk,bkhd->bhd", p,
-                             vc.astype(jnp.float32))      # [B, H, D]
+        acc_loc = jnp.einsum("bhk,bkhd->bhd", p, vc,
+                             preferred_element_type=jnp.float32)
         # 3. one stable softmax merge across the ring
         m_glob = lax.pmax(m_loc, axis)
         corr = jnp.exp(m_loc - m_glob)
