@@ -1,0 +1,63 @@
+"""Train a causal LM through the sequence-parallel ring, then generate
+from it through the ring-sharded KV-cache decoder — one parameter tree,
+both directions.
+
+`python examples/07_lm_train_and_generate.py` runs on a virtual
+8-device CPU pod ("data" x "seq" mesh); the same code on a TPU pod
+trains with ring attention over ICI and serves with two collectives per
+decoded token.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from idc_models_tpu import mesh as meshlib
+
+meshlib.force_cpu_pod(8)          # delete this line on real TPU hardware
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu.models.lm import (
+    attention_lm, generate, next_token_loss,
+)
+from idc_models_tpu.train import (
+    TrainState, jit_data_parallel, make_train_step, replicate, rmsprop,
+    shard_batch,
+)
+
+VOCAB, SEQ = 11, 32
+mesh = meshlib.data_seq_mesh(4, 2)        # batch x ring
+model = attention_lm(VOCAB, SEQ, embed_dim=32, num_heads=2, mlp_dim=64,
+                     num_blocks=2, mesh=mesh)
+
+opt = rmsprop(3e-3)
+variables = model.init(jax.random.key(0))
+state = TrainState(step=jnp.zeros((), jnp.int32), params=variables.params,
+                   model_state=variables.state,
+                   opt_state=opt.init(variables.params))
+step = jit_data_parallel(make_train_step(model, opt, next_token_loss),
+                         mesh, axis="data")
+state = replicate(mesh, state)
+
+# the task: sequences count upward mod VOCAB; the LM must learn succ()
+rng, key = np.random.default_rng(1), jax.random.key(2)
+for i in range(150):
+    starts = rng.integers(0, VOCAB, (32, 1))
+    seqs = jnp.asarray((starts + np.arange(SEQ)) % VOCAB, jnp.int32)
+    bx = shard_batch(mesh, seqs, axis="data")
+    key, sub = jax.random.split(key)
+    state, m = step(state, bx, bx, sub)
+print(f"trained 150 steps: loss={float(m['loss']):.4f} "
+      f"next-token accuracy={float(m['accuracy']):.3f}")
+
+prompt = jnp.asarray([[7, 8, 9]], jnp.int32)
+out = generate(jax.device_get(state.params), prompt, 8, embed_dim=32,
+               num_heads=2, num_blocks=2, t_max=SEQ,
+               cache_dtype=jnp.float32)
+print("prompt", prompt.tolist()[0], "->", out.tolist()[0])
+assert out.tolist()[0] == [(7 + i) % VOCAB for i in range(11)]
+print("generation matches the learned successor pattern")

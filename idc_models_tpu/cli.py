@@ -34,7 +34,7 @@ def main(argv: list[str] | None = None) -> int:
         meshlib.force_cpu_pod(ns.host_devices)  # warns if ineffective
     runner = {"vgg": _run_dist, "mobile": _run_dist, "dense": _run_dist,
               "fed": _run_fed, "secure_fed": _run_secure,
-              "attention": _run_attention,
+              "attention": _run_attention, "lm": _run_lm,
               "convert_weights": _run_convert}[ns.preset_key]
     runner(ns)
     return 0
@@ -181,6 +181,32 @@ def _parse(argv):
     sp.add_argument("--image-size", type=int, default=50,
                     help="with --data-dir: decode size of the IDC "
                          "patches (the reference's 50)")
+
+    sp = sub.add_parser("lm",
+                        help="causal LM through the ring: train "
+                             "next-token on the counting task, then "
+                             "greedy-generate via the ring-sharded "
+                             "KV-cache decoder (beyond-reference)")
+    common(sp)
+    sp.add_argument("--vocab", type=int, default=16)
+    sp.add_argument("--seq-len", type=int, default=64)
+    sp.add_argument("--embed-dim", type=int, default=64)
+    sp.add_argument("--num-heads", type=int, default=4)
+    sp.add_argument("--mlp-dim", type=int, default=128)
+    sp.add_argument("--num-blocks", type=int, default=2)
+    sp.add_argument("--steps", type=int, default=200)
+    sp.add_argument("--seq-parallel", type=int, default=0,
+                    help="ring size over the 'seq' mesh axis (0 = "
+                         "largest dividing power of two, capped at 4)")
+    sp.add_argument("--layout", choices=("contiguous", "zigzag"),
+                    default="contiguous")
+    sp.add_argument("--block-impl", choices=("jnp", "pallas"),
+                    default="jnp")
+    sp.add_argument("--remat", action="store_true")
+    sp.add_argument("--dropout", type=float, default=0.0)
+    sp.add_argument("--generate", type=int, default=12,
+                    help="tokens to greedy-generate after training "
+                         "through the KV-cache decoder (0 = skip)")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -568,6 +594,101 @@ def _run_attention(ns):
     print("val:", " ".join(f"{k}={v:.4f}" for k, v in vm.items()))
     if logger:
         logger.log(event="val", **vm)
+        logger.close()
+
+
+def _run_lm(ns):
+    """Beyond-reference workload: the decoder-only LM trained through
+    sequence-parallel ring attention on the counting task
+    (next = (tok+1) % vocab), then served through the ring-sharded
+    KV-cache decoder — train and generate from one parameter tree
+    (models/lm.py, docs/LONG_CONTEXT.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import (
+        attention_lm, generate, next_token_loss,
+    )
+    from idc_models_tpu.observe import Timer, profile_trace
+    from idc_models_tpu.train import (
+        TrainState, jit_data_parallel, make_train_step, replicate,
+        rmsprop, shard_batch,
+    )
+
+    if not 0.0 <= ns.dropout < 1.0:
+        sys.exit(f"--dropout {ns.dropout} must be in [0, 1)")
+    n_dev = len(jax.devices())
+    n_seq = ns.seq_parallel or max(
+        p for p in (4, 2, 1) if n_dev % p == 0)
+    if n_seq < 1 or n_dev % n_seq:
+        sys.exit(f"--seq-parallel {n_seq} must be a positive divisor "
+                 f"of the device count ({n_dev})")
+    stripes = 2 * n_seq if ns.layout == "zigzag" else n_seq
+    if ns.seq_len % stripes:
+        sys.exit(f"--seq-len {ns.seq_len} must divide into {stripes} "
+                 f"equal stripes for --layout {ns.layout} at ring "
+                 f"size {n_seq}")
+    mesh = meshlib.data_seq_mesh(n_seq)
+    print(f"Number of devices: {mesh.devices.size} "
+          f"(data={mesh.shape[meshlib.DATA_AXIS]}, seq={n_seq})")
+
+    model = attention_lm(
+        ns.vocab, ns.seq_len, embed_dim=ns.embed_dim,
+        num_heads=ns.num_heads, mlp_dim=ns.mlp_dim,
+        num_blocks=ns.num_blocks, mesh=mesh, layout=ns.layout,
+        block_impl=ns.block_impl, remat=ns.remat,
+        dropout_rate=ns.dropout)
+    batch = ns.batch_size or 32
+    lr = ns.lr if ns.lr is not None else 3e-3
+    opt = rmsprop(lr)
+    variables = model.init(jax.random.key(ns.seed))
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    step = jit_data_parallel(
+        make_train_step(model, opt, next_token_loss), mesh,
+        axis=meshlib.DATA_AXIS)
+    state = replicate(mesh, state)
+    logger = _logger(ns)
+    rng = np.random.default_rng(ns.seed + 1)
+    key = jax.random.key(ns.seed + 2)
+    with Timer("LM training", logger=logger), \
+            profile_trace(ns.profile_dir):
+        for i in range(ns.steps):
+            starts = rng.integers(0, ns.vocab, (batch, 1))
+            seqs = jnp.asarray((starts + np.arange(ns.seq_len))
+                               % ns.vocab, jnp.int32)
+            bx = shard_batch(mesh, seqs, axis=meshlib.DATA_AXIS)
+            key, sub = jax.random.split(key)
+            state, m = step(state, bx, bx, sub)
+            if i % 50 == 0 or i == ns.steps - 1:
+                m = _fetch_scalars(m)
+                print(f"step {i}, loss={float(m['loss']):.4f}, "
+                      f"next-token accuracy={float(m['accuracy']):.4f}")
+                if logger:
+                    logger.log(event="step", step=i,
+                               loss=float(m["loss"]),
+                               accuracy=float(m["accuracy"]))
+    n_gen = min(ns.generate, ns.seq_len - 3)
+    if ns.generate > 0 and n_gen >= 1:
+        prompt = jnp.asarray(
+            [[i % ns.vocab for i in range(3)]], jnp.int32)
+        out = generate(jax.device_get(state.params), prompt, n_gen,
+                       embed_dim=ns.embed_dim, num_heads=ns.num_heads,
+                       num_blocks=ns.num_blocks, t_max=ns.seq_len,
+                       cache_dtype=jnp.float32)
+        toks = out.tolist()[0]
+        want = [i % ns.vocab for i in range(3 + n_gen)]
+        ok = toks == want
+        print(f"generate: {toks[:3]} -> {toks[3:]} "
+              f"({'matches' if ok else 'does NOT match'} the counting "
+              f"pattern)")
+        if logger:
+            logger.log(event="generate", tokens=toks, matches=ok)
+    if logger:
         logger.close()
 
 

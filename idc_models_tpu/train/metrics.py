@@ -20,7 +20,13 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 def auto_accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Dispatch on logits shape: [B,C>1] multiclass, else binary — the
     Keras `metrics=['accuracy']` auto-selection (dist_model_tf_vgg.py:132
-    vs dist_model_tf_dense.py:144)."""
+    vs dist_model_tf_dense.py:144). Sequence logits [B,T,V] with token
+    labels [B,T] (the LM convention, models/lm.py) score shifted
+    next-token accuracy, matching `next_token_loss`'s objective."""
+    if logits.ndim == 3 and logits.shape[-1] > 1:
+        pred = jnp.argmax(logits[:, :-1], -1)
+        return jnp.mean((pred == labels[:, 1:].astype(pred.dtype))
+                        .astype(jnp.float32))
     if logits.ndim == 2 and logits.shape[-1] > 1:
         return accuracy(logits, labels)
     return binary_accuracy(logits, labels)

@@ -237,3 +237,23 @@ def test_cli_secure_fed_paillier(capsys):
                capsys)
     assert "round 0:" in out
     assert "Client 0 training took" in out   # C17 per-client Timers
+
+
+def test_cli_lm(tmp_path, capsys):
+    """The causal-LM workload from the product surface: the CLI wiring
+    only (mesh line, metric line, generate line, jsonl artifact, ring
+    rejection) — convergence + pattern-match is owned by
+    tests/test_lm.py::test_lm_learns_and_generates, not re-proven
+    here."""
+    out = _run(["lm", "--host-devices", "8", "--steps", "20",
+                "--vocab", "11", "--seq-len", "32", "--embed-dim", "16",
+                "--num-heads", "2", "--mlp-dim", "32", "--num-blocks",
+                "1", "--batch-size", "16", "--generate", "6",
+                "--path", str(tmp_path)], capsys)
+    assert "(data=2, seq=4)" in out
+    assert "next-token accuracy" in out
+    assert "generate:" in out
+    assert (tmp_path / "logs" / "run.jsonl").exists()
+    with pytest.raises(SystemExit):
+        cli.main(["lm", "--host-devices", "8", "--seq-len", "30",
+                  "--layout", "zigzag"])
