@@ -43,6 +43,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -70,8 +71,12 @@ def init_cache(mesh: Mesh, batch: int, t_max: int, heads: int, dim: int,
         raise ValueError(f"t_max {t_max} not divisible by the ring size "
                          f"{n} over mesh axis {axis!r}")
     sh = cache_sharding(mesh, axis)
-    mk = functools.partial(jnp.zeros, (batch, t_max, heads, dim), dtype)
-    return (jax.device_put(mk(), sh), jax.device_put(mk(), sh))
+    # put_with_sharding, not device_put: on a multi-host mesh each
+    # process materializes only its addressable shards (mesh.py)
+    mk = functools.partial(np.zeros, (batch, t_max, heads, dim),
+                           jnp.dtype(dtype))
+    return (meshlib.put_with_sharding(mk(), sh),
+            meshlib.put_with_sharding(mk(), sh))
 
 
 def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
@@ -194,6 +199,7 @@ def prefill(mesh: Mesh, k_prompt, v_prompt, t_max: int, *,
         raise ValueError(f"t_max {t_max} not divisible by the ring size "
                          f"{n} over mesh axis {axis!r}")
     pad = ((0, 0), (0, t_max - p_len), (0, 0), (0, 0))
-    kc = jnp.pad(k_prompt.astype(dtype), pad)
-    vc = jnp.pad(v_prompt.astype(dtype), pad)
-    return jax.device_put(kc, sh), jax.device_put(vc, sh)
+    kc = jnp.pad(jnp.asarray(k_prompt, dtype), pad)
+    vc = jnp.pad(jnp.asarray(v_prompt, dtype), pad)
+    return (meshlib.put_with_sharding(kc, sh),
+            meshlib.put_with_sharding(vc, sh))

@@ -212,6 +212,32 @@ def main() -> int:
     assert abs(flash_bwd_digest - float(jnp.sum(gk_ref))) < 1e-2, (
         flash_bwd_digest, float(jnp.sum(gk_ref)))
 
+    # KV-cache decode across processes: the cache is sharded over the
+    # same cross-host "seq" mesh (each host owns half the slots), so the
+    # owner-shard appends land on whichever host owns the position and
+    # the pmax/psum softmax merge spans the DCN boundary every token.
+    from idc_models_tpu.ring_decode import init_cache, make_ring_decode
+
+    t_dec = 16
+    kc, vc = init_cache(smesh, 2, t_dec, 2, 8, dtype=jnp.float32)
+    dstep = make_ring_decode(smesh)
+    repl = meshlib.replicated(smesh)
+    drows = []
+    for pos in range(t_dec):
+        tok = slice(pos, pos + 1)
+        q1, k1, v1 = (meshlib.put_with_sharding(np.asarray(x[:, tok]),
+                                                repl)
+                      for x in (sq, sk, sv))
+        drow, kc, vc = dstep(kc, vc, q1, k1, v1, pos)
+        drows.append(drow)
+    dec = jnp.concatenate([jax.device_get(r) for r in drows], axis=1)
+    dec_ref = full_attention(sq[:, :t_dec], sk[:, :t_dec], sv[:, :t_dec],
+                             causal=True)
+    decode_digest = float(jnp.sum(dec.astype(jnp.float32)))
+    assert abs(decode_digest
+               - float(jnp.sum(dec_ref.astype(jnp.float32)))) < 1e-3, (
+        "cross-process KV-cache decode != full causal attention")
+
     # Checkpointed fit across processes: orbax save is a collective, so
     # this hangs (not just fails) if any process skips it. The dir is
     # shared (same host in this stand-in, like GCS/NFS on a real pod).
@@ -235,7 +261,8 @@ def main() -> int:
           f"fed_loss={fed_loss:.8f} fed_digest={fed_digest:.8f} "
           f"sec_loss={sec_loss:.8f} sec_digest={sec_digest:.8f} "
           f"ckpt_loss={ckpt_loss:.8f} tp_loss={tp_loss:.8f} "
-          f"tp_digest={tp_digest:.8f} sp_digest={sp_digest:.8f}",
+          f"tp_digest={tp_digest:.8f} sp_digest={sp_digest:.8f} "
+          f"decode_digest={decode_digest:.8f}",
           flush=True)
     return 0
 

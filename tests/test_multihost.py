@@ -53,16 +53,17 @@ def test_two_process_dp_step_agrees(tmp_path):
                       r"fed_loss=([-\d.]+) fed_digest=([-\d.]+) "
                       r"sec_loss=([-\d.]+) sec_digest=([-\d.]+) "
                       r"ckpt_loss=([-\d.]+) tp_loss=([-\d.]+) "
-                      r"tp_digest=([-\d.]+) sp_digest=([-\d.]+)", out)
+                      r"tp_digest=([-\d.]+) sp_digest=([-\d.]+) "
+                      r"decode_digest=([-\d.]+)", out)
         assert m, out
         results[int(m.group(1))] = m.groups()[1:]
     assert set(results) == {0, 1}
     # the DP allreduce, the eval logits gather, the FedAvg and
     # secure-aggregation round boundaries, the collective checkpoint
-    # save, the cross-process TP step, and the ring-attention K/V hops
-    # all spanned processes: both hosts hold identical state and
-    # computed identical metrics
+    # save, the cross-process TP step, the ring-attention K/V hops, and
+    # the KV-cache decode's pmax/psum merge all spanned processes: both
+    # hosts hold identical state and computed identical metrics
     assert results[0] == results[1], results
     # the DP x TP run is the same workload as the DP run in a different
     # layout — its loss must reproduce the DP loss
-    assert abs(float(results[0][-3]) - float(results[0][0])) < 1e-4, results
+    assert abs(float(results[0][-4]) - float(results[0][0])) < 1e-4, results
