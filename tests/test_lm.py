@@ -122,3 +122,42 @@ def test_decoder_rejections(devices):
         generate(params, jnp.zeros((1, 30), jnp.int32), 8,
                  embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
                  t_max=SEQ)
+
+
+def test_lm_checkpoint_roundtrip(devices, tmp_path):
+    """The LM rides the standard orbax checkpoint machinery (C8/§5):
+    params saved after a few train steps restore to a tree that decodes
+    IDENTICAL tokens — training, persistence, and serving all share one
+    parameter pytree."""
+    from idc_models_tpu.train import restore_checkpoint, save_checkpoint
+
+    mesh = meshlib.data_seq_mesh(4, 2)
+    model = _model(mesh)
+    opt = rmsprop(3e-3)
+    variables = model.init(jax.random.key(5))
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    step = jit_data_parallel(
+        make_train_step(model, opt, next_token_loss), mesh, axis="data")
+    state = replicate(mesh, state)
+    rng = np.random.default_rng(6)
+    key = jax.random.key(7)
+    for i in range(5):
+        starts = rng.integers(0, VOCAB, (16, 1))
+        seqs = (starts + np.arange(SEQ)) % VOCAB
+        bx = shard_batch(mesh, jnp.asarray(seqs, jnp.int32), axis="data")
+        key, sub = jax.random.split(key)
+        state, _ = step(state, bx, bx, sub)
+    save_checkpoint(tmp_path / "lm", state)
+    template = jax.tree.map(np.zeros_like, jax.device_get(state))
+    restored = restore_checkpoint(tmp_path / "lm", template)
+    prompt = _toks(1, seed=8)[:, :5]
+    a = generate(jax.device_get(state.params), prompt, 6, embed_dim=E,
+                 num_heads=HEADS, num_blocks=BLOCKS, t_max=SEQ,
+                 cache_dtype=jnp.float32)
+    b = generate(restored.params, prompt, 6, embed_dim=E,
+                 num_heads=HEADS, num_blocks=BLOCKS, t_max=SEQ,
+                 cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
