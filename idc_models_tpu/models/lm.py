@@ -128,7 +128,7 @@ def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
                     cache_dtype=jnp.bfloat16):
     """Serving loop for an `attention_lm` parameter tree.
 
-    Returns ``(init_caches, step)``:
+    Returns ``(init_caches, step, prefill_tokens)``:
 
     - ``init_caches(batch) -> caches`` — one ring-sharded (k, v) cache
       per block (`ring_decode.init_cache`; t_max bounds the context).
@@ -137,6 +137,14 @@ def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
       single-position forward (q/k/v projections of THIS token, the
       block's cache fold, out-projection, residual, MLP), and returns
       the next-token logits [B, vocab].
+    - ``prefill_tokens(tokens) -> (logits, caches)`` — the whole prompt
+      [B, P] in ONE jitted pass: per block, full causal attention over
+      the prompt and the block's K/V placed straight into a fresh ring
+      cache (`ring_decode.prefill` layout), returning the LAST
+      position's logits. Equal to feeding the prompt through `step`
+      token by token to fp tolerance (the batched projections
+      reassociate the same matmuls; pinned), at batch speed instead of
+      P dispatches.
 
     The per-position math reuses the very parameter tree training
     produced — no export step, no weight transform. Dropout is inference
@@ -195,7 +203,51 @@ def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
     # serving loop only ever holds the returned ones).
     step = jax.jit(step, donate_argnums=(0,))
 
-    return init_caches, step
+    from idc_models_tpu.ring_attention import full_attention
+    from idc_models_tpu.ring_decode import prefill as cache_prefill
+
+    @jax.jit
+    def _prefill_fwd(tokens):
+        b, p_len = tokens.shape
+        h = (jnp.take(params["embed"], tokens, axis=0)
+             + params["pos"][:p_len])                    # [B, P, E]
+        kvs = []
+        for i in range(num_blocks):
+            p = params[f"block{i}"]
+            a, _ = ln.apply(p["ln1"], {}, h)
+            split = lambda y: y.reshape(b, p_len, num_heads, head_dim)
+            q = split(a @ p["mha"]["wq"].astype(a.dtype))
+            k = split(a @ p["mha"]["wk"].astype(a.dtype))
+            v = split(a @ p["mha"]["wv"].astype(a.dtype))
+            o = full_attention(q, k, v, causal=True)
+            o = o.reshape(b, p_len, embed_dim)
+            h = h + (o @ p["mha"]["wo"].astype(o.dtype)
+                     + p["mha"]["bo"].astype(o.dtype))
+            a, _ = ln.apply(p["ln2"], {}, h)
+            m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
+            h = h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"])
+            kvs.append((k, v))
+        h, _ = ln.apply(params["ln_f"], {}, h[:, -1])
+        logits = h @ params["head"]["kernel"] + params["head"]["bias"]
+        return logits, kvs
+
+    def prefill_tokens(tokens):
+        tokens = jnp.asarray(tokens, jnp.int32)
+        if tokens.ndim != 2 or tokens.shape[1] < 1:
+            raise ValueError(f"prefill_tokens expects non-empty [B, P] "
+                             f"tokens, got shape {tokens.shape}")
+        if tokens.shape[1] > t_max:
+            raise ValueError(f"prompt length {tokens.shape[1]} exceeds "
+                             f"t_max {t_max}")
+        logits, kvs = _prefill_fwd(tokens)
+        caches = tuple(
+            cache_prefill(mesh, k.astype(cache_dtype),
+                          v.astype(cache_dtype), t_max,
+                          dtype=cache_dtype)
+            for k, v in kvs)
+        return logits, caches
+
+    return init_caches, step, prefill_tokens
 
 
 def generate(params, prompt, steps: int, *, embed_dim: int,
@@ -213,14 +265,12 @@ def generate(params, prompt, steps: int, *, embed_dim: int,
     if p_len + steps > t_max:
         raise ValueError(f"prompt {p_len} + steps {steps} exceeds "
                          f"t_max {t_max}")
-    init_caches, step = make_lm_decoder(
+    _, step, prefill_tokens = make_lm_decoder(
         params, embed_dim=embed_dim, num_heads=num_heads,
         num_blocks=num_blocks, t_max=t_max, mesh=mesh,
         cache_dtype=cache_dtype)
-    caches = init_caches(b)
-    logits = None
-    for pos in range(p_len):
-        logits, caches = step(caches, prompt[:, pos], pos)
+    # whole prompt in one pass (pinned equal to token-by-token feeding)
+    logits, caches = prefill_tokens(prompt)
     out = [prompt]
     for s in range(steps):
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
