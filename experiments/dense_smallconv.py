@@ -1,0 +1,183 @@
+"""Tiny-spatial conv -> one matmul: can it move DenseNet's bottleneck?
+
+The round-5 attribution (backbone_mfu.jsonl) pins DenseNet201@32x32's
+cost in the late stages: stage4 (48 concat layers at 2x2 spatial,
+221k p/s fwd, MFU 0.079) and stage5 (32 layers at 1x1). A 3x3 SAME
+conv at spatial S<=3 touches every input position from every output
+position, so it IS a dense linear map over (position, channel) — a
+single [S^2*Cin, S^2*Cout] matmul with the block weights gathered from
+the 3x3 kernel by geometry (taps outside the window are zero). That
+shape (e.g. 1152x128 for stage4's 3x3 convs instead of halo-padded
+K=288 patches with N=32) is a much better MXU tile; at 1x1 the map
+degenerates to x @ k[center] (the 8 border taps only ever see padding).
+
+This measures: (a) exactness vs lax.conv (asserted before timing),
+(b) stage4/stage5 forward with the transformed convs vs the native
+lowering, on the chip. If the stage-level numbers move, the transform
+graduates to a core.conv2d option; if not, this file is the closed
+lever account.
+
+Run: python experiments/dense_smallconv.py
+Appends JSON lines to experiments/dense_smallconv.jsonl.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from mfu_matrix import _timed  # noqa: E402
+
+OUT = Path(__file__).resolve().parent / "dense_smallconv.jsonl"
+
+
+def block_weight(k, S):
+    """[3, 3, Cin, Cout] SAME-conv kernel -> the [S^2*Cin, S^2*Cout]
+    dense position-mixing matrix it realizes on S x S inputs (S <= 3:
+    every (in, out) position pair lies inside the 3x3 window or sees
+    only zero padding)."""
+    import jax.numpy as jnp
+
+    c_in, c_out = k.shape[2], k.shape[3]
+    blocks = []
+    for pi in range(S * S):
+        iy, ix = divmod(pi, S)
+        row = []
+        for po in range(S * S):
+            oy, ox = divmod(po, S)
+            dy, dx = iy - oy + 1, ix - ox + 1
+            if 0 <= dy < 3 and 0 <= dx < 3:
+                row.append(k[dy, dx])
+            else:
+                row.append(jnp.zeros((c_in, c_out), k.dtype))
+        blocks.append(jnp.concatenate(row, axis=1))
+    return jnp.concatenate(blocks, axis=0)
+
+
+def smallconv(k, x):
+    """y = conv2d(x, k, SAME, stride 1) for [B, S, S, Cin], S <= 3."""
+    import jax.numpy as jnp
+
+    b, S, _, c_in = x.shape
+    c_out = k.shape[3]
+    if S == 1:
+        return (x.reshape(b, c_in) @ k[1, 1]).reshape(b, 1, 1, c_out)
+    W = block_weight(k, S)
+    y = x.reshape(b, S * S * c_in) @ W
+    return y.reshape(b, S, S, c_out)
+
+
+def check_exact():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.default_rng(0)
+    for S, c_in, c_out in ((1, 896, 128), (2, 288, 128), (2, 128, 32),
+                           (3, 64, 16)):
+        k = jnp.asarray(rng.normal(0, 1, (3, 3, c_in, c_out)), jnp.float32)
+        x = jnp.asarray(rng.normal(0, 1, (4, S, S, c_in)), jnp.float32)
+        ref = lax.conv_general_dilated(
+            x, k, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = smallconv(k, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+    print("exactness: smallconv == lax.conv for S in {1,2,3}",
+          file=sys.stderr)
+
+
+def measure_stage(group: str, *, transform: bool, batch=1024):
+    """dense stage forward (as backbone_mfu.measure_group) with 3x3
+    convs at tiny spatial optionally replaced by the matmul form."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu.models import core, densenet
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    import backbone_mfu as bm
+
+    lo, hi, size, c_in = bm._DENSE_GROUPS[group]
+    units, modules = densenet._units(3, densenet.FREEZE_ALL)
+    if transform:
+        # swap every 3x3 conv module for the matmul form (1x1 convs and
+        # BNs untouched); geometry guarantees spatial <= 3 in-stage
+        for name, mod in list(modules.items()):
+            if name.endswith("_2_conv"):
+                modules[name] = _matmul_conv_like(mod)
+    init, apply = bm._range_model(units, modules, lo, hi)
+    variables = init(jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(0)
+                    .random((batch, size, size, c_in), np.float32),
+                    dtype=jnp.bfloat16)
+
+    @jax.jit
+    def fwd(params, state, x):
+        return jnp.sum(apply(params, state, x).astype(jnp.float32))
+
+    compiled = fwd.lower(variables.params, variables.state, x).compile()
+    ca = compiled.cost_analysis()
+    flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    box = {}
+
+    def dispatch(n):
+        for _ in range(n):
+            box["y"] = compiled(variables.params, variables.state, x)
+
+    def fence():
+        return float(box["y"])
+
+    steps, dt, dts = _timed(dispatch, fence)
+    return {"patches_per_sec_per_chip": steps * batch / dt,
+            "steps": steps, "best_dt": dt, "window_dts": dts,
+            "flops_per_patch": flops / batch if flops else None}
+
+
+def _matmul_conv_like(mod):
+    """Same init/params as the wrapped core.conv2d; apply via smallconv
+    when the input spatial is <= 3 (else fall back to the original)."""
+    from idc_models_tpu.models import core
+
+    def apply(params, state, x, *, train=False, rng=None):
+        if x.shape[1] <= 3 and x.shape[1] == x.shape[2]:
+            return smallconv(params["kernel"].astype(x.dtype), x), state
+        return mod.apply(params, state, x, train=train, rng=rng)
+
+    return core.Module(mod.init, apply, mod.name)
+
+
+def main():
+    import jax
+
+    check_exact()
+    dev = jax.devices()[0]
+    rows = []
+    with OUT.open("a") as f:
+        for group in ("stage4_2", "stage5_1"):
+            for transform in (False, True):
+                t0 = time.time()
+                r = measure_stage(group, transform=transform)
+                r.update(name=f"{group}_{'matmul' if transform else 'native'}",
+                         wall_s=round(time.time() - t0, 1),
+                         device_kind=dev.device_kind)
+                line = json.dumps(r)
+                print(line, flush=True)
+                f.write(line + "\n")
+                f.flush()
+                rows.append(r)
+    for i in (0, 2):
+        nat, mat = rows[i], rows[i + 1]
+        print(f"{nat['name'][:-7]}: matmul/native = "
+              f"{mat['patches_per_sec_per_chip'] / nat['patches_per_sec_per_chip']:.3f}x",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
