@@ -252,10 +252,14 @@ def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
 
 def generate(params, prompt, steps: int, *, embed_dim: int,
              num_heads: int, num_blocks: int, t_max: int,
-             mesh: Mesh | None = None, cache_dtype=jnp.bfloat16):
-    """Greedy generation: feed `prompt` [B, P] token by token through
-    the cached decoder, then extend `steps` tokens by argmax. Returns
-    int32 [B, P + steps] (prompt included)."""
+             mesh: Mesh | None = None, cache_dtype=jnp.bfloat16,
+             temperature: float = 0.0, top_k: int | None = None,
+             rng=None):
+    """Generation through the cached decoder: one-pass prompt prefill,
+    then `steps` tokens. `temperature=0` (default) is greedy argmax;
+    `temperature > 0` samples from softmax(logits / temperature)
+    (requires `rng`), optionally restricted to the `top_k` most likely
+    tokens. Returns int32 [B, P + steps] (prompt included)."""
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
     if steps < 1 or p_len < 1:
@@ -265,15 +269,35 @@ def generate(params, prompt, steps: int, *, embed_dim: int,
     if p_len + steps > t_max:
         raise ValueError(f"prompt {p_len} + steps {steps} exceeds "
                          f"t_max {t_max}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     _, step, prefill_tokens = make_lm_decoder(
         params, embed_dim=embed_dim, num_heads=num_heads,
         num_blocks=num_blocks, t_max=t_max, mesh=mesh,
         cache_dtype=cache_dtype)
+
+    def pick(logits, key):
+        lg = logits.astype(jnp.float32)
+        if top_k is not None and top_k < lg.shape[-1]:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k]
+            lg = jnp.where(lg >= kth[:, None], lg, -jnp.inf)
+        if temperature == 0.0:
+            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, lg / temperature,
+                                      axis=-1).astype(jnp.int32)
+
     # whole prompt in one pass (pinned equal to token-by-token feeding)
     logits, caches = prefill_tokens(prompt)
     out = [prompt]
     for s in range(steps):
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sub = None
+        if temperature > 0.0:
+            rng, sub = jax.random.split(rng)
+        tok = pick(logits, sub)
         out.append(tok[:, None])
         if s + 1 < steps:   # the last token's logits are never needed
             logits, caches = step(caches, tok, p_len + s)

@@ -196,3 +196,35 @@ def test_prefill_tokens_equals_tokenwise(devices):
         prefill_tokens(jnp.zeros((2, 0), jnp.int32))
     with pytest.raises(ValueError, match="exceeds"):
         prefill_tokens(jnp.zeros((2, SEQ + 1), jnp.int32))
+
+
+def test_generate_sampling_modes(devices):
+    """temperature/top_k: greedy is deterministic and equals the
+    default; sampling varies with the rng but respects top_k=1 ==
+    greedy; invalid knobs are rejected."""
+    model = _model(None)
+    params = model.init(jax.random.key(11)).params
+    kw = dict(embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+              t_max=SEQ, cache_dtype=jnp.float32)
+    prompt = _toks(2, seed=15)[:, :6]
+    greedy = generate(params, prompt, 6, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(generate(params, prompt, 6, temperature=0.0, **kw)),
+        np.asarray(greedy))
+    # top_k=1 sampling has a single-token support -> exactly greedy
+    np.testing.assert_array_equal(
+        np.asarray(generate(params, prompt, 6, temperature=5.0,
+                            top_k=1, rng=jax.random.key(0), **kw)),
+        np.asarray(greedy))
+    # high temperature over an untrained (near-uniform) head varies
+    a = generate(params, prompt, 6, temperature=5.0,
+                 rng=jax.random.key(1), **kw)
+    c = generate(params, prompt, 6, temperature=5.0,
+                 rng=jax.random.key(2), **kw)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    with pytest.raises(ValueError, match="needs an rng"):
+        generate(params, prompt, 2, temperature=1.0, **kw)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(params, prompt, 2, temperature=-1.0, **kw)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, prompt, 2, top_k=0, **kw)
