@@ -280,10 +280,11 @@ def generate(params, prompt, steps: int, *, embed_dim: int,
         num_blocks=num_blocks, t_max=t_max, mesh=mesh,
         cache_dtype=cache_dtype)
 
+    @jax.jit  # one dispatch, like the decode step it follows
     def pick(logits, key):
         lg = logits.astype(jnp.float32)
         if top_k is not None and top_k < lg.shape[-1]:
-            kth = jnp.sort(lg, axis=-1)[:, -top_k]
+            kth = jax.lax.top_k(lg, top_k)[0][:, -1]
             lg = jnp.where(lg >= kth[:, None], lg, -jnp.inf)
         if temperature == 0.0:
             return jnp.argmax(lg, axis=-1).astype(jnp.int32)
