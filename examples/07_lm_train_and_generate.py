@@ -61,3 +61,16 @@ out = generate(jax.device_get(state.params), prompt, 8, embed_dim=32,
 print("prompt", prompt.tolist()[0], "->", out.tolist()[0])
 assert out.tolist()[0] == [(7 + i) % VOCAB for i in range(11)]
 print("generation matches the learned successor pattern")
+
+# hot serving: a Generator holds the compiled programs — one ring
+# prefill dispatch + ONE fused scan dispatch per request, and repeated
+# requests (or a fresh same-shape checkpoint) recompile nothing
+from idc_models_tpu.models.lm import Generator
+
+gen = Generator(jax.device_get(state.params), embed_dim=32, num_heads=2,
+                num_blocks=2, t_max=SEQ, cache_dtype=jnp.float32)
+for start in (3, 5):
+    p = jnp.asarray([[start, start + 1, start + 2]], jnp.int32)
+    toks = gen(p, 8).tolist()[0]
+    assert toks == [(start + i) % VOCAB for i in range(11)]
+print("Generator served 2 requests, compiled once:", gen.cache_sizes())

@@ -205,8 +205,17 @@ def _parse(argv):
     sp.add_argument("--remat", action="store_true")
     sp.add_argument("--dropout", type=float, default=0.0)
     sp.add_argument("--generate", type=int, default=12,
-                    help="tokens to greedy-generate after training "
-                         "through the KV-cache decoder (0 = skip)")
+                    help="tokens to generate after training through "
+                         "the KV-cache decoder (0 = skip); emitted in "
+                         "ONE fused device dispatch (models/lm.py "
+                         "Generator)")
+    sp.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature for --generate "
+                         "(0 = greedy argmax, the default)")
+    sp.add_argument("--top-k", type=int, default=0,
+                    help="restrict sampling to the k most likely "
+                         "tokens (0 = no restriction; needs "
+                         "--temperature > 0)")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -608,9 +617,7 @@ def _run_lm(ns):
     import numpy as np
 
     from idc_models_tpu import mesh as meshlib
-    from idc_models_tpu.models.lm import (
-        attention_lm, generate, next_token_loss,
-    )
+    from idc_models_tpu.models.lm import attention_lm, next_token_loss
     from idc_models_tpu.observe import Timer, profile_trace
     from idc_models_tpu.train import (
         TrainState, jit_data_parallel, make_train_step, replicate,
@@ -674,20 +681,50 @@ def _run_lm(ns):
                                accuracy=float(m["accuracy"]))
     n_gen = min(ns.generate, ns.seq_len - 3)
     if ns.generate > 0 and n_gen >= 1:
+        import time as _time
+
+        from idc_models_tpu.models.lm import Generator
+
+        if ns.temperature < 0.0:
+            sys.exit(f"--temperature {ns.temperature} must be >= 0")
+        if ns.top_k < 0:
+            sys.exit(f"--top-k {ns.top_k} must be >= 0 (0 = no "
+                     f"restriction)")
+        if ns.top_k > 0 and ns.temperature == 0.0:
+            print("[idc_models_tpu] --top-k has no effect at "
+                  "--temperature 0 (greedy argmax already picks the "
+                  "top-1 token)", file=sys.stderr)
+        # the serving object compiles prefill + the fused scan decode
+        # once; repeated requests against it perform zero recompilation
+        gen = Generator(jax.device_get(state.params),
+                        embed_dim=ns.embed_dim, num_heads=ns.num_heads,
+                        num_blocks=ns.num_blocks, t_max=ns.seq_len,
+                        cache_dtype=jnp.float32,
+                        temperature=ns.temperature,
+                        top_k=ns.top_k or None)
         prompt = jnp.asarray(
             [[i % ns.vocab for i in range(3)]], jnp.int32)
-        out = generate(jax.device_get(state.params), prompt, n_gen,
-                       embed_dim=ns.embed_dim, num_heads=ns.num_heads,
-                       num_blocks=ns.num_blocks, t_max=ns.seq_len,
-                       cache_dtype=jnp.float32)
-        toks = out.tolist()[0]
+        key = (jax.random.key(ns.seed + 3) if ns.temperature > 0.0
+               else None)
+        out = gen(prompt, n_gen, rng=key)         # compile + generate
+        t0 = _time.perf_counter()
+        out = gen(prompt, n_gen, rng=key)         # compiled: 2 dispatches
+        toks = out.tolist()[0]                    # fetch fences the timer
+        dt = _time.perf_counter() - t0
         want = [i % ns.vocab for i in range(3 + n_gen)]
         ok = toks == want
-        print(f"generate: {toks[:3]} -> {toks[3:]} "
-              f"({'matches' if ok else 'does NOT match'} the counting "
-              f"pattern)")
+        verdict = ("matches" if ok else "does NOT match"
+                   ) if ns.temperature == 0.0 else "sampled against"
+        print(f"generate: {toks[:3]} -> {toks[3:]} ({verdict} the "
+              f"counting pattern; {n_gen} tokens end-to-end in "
+              f"{dt * 1e3:.1f} ms, one prefill + one fused decode "
+              f"dispatch)")
         if logger:
-            logger.log(event="generate", tokens=toks, matches=ok)
+            # generate_ms_per_token is END-TO-END (prefill dispatch +
+            # fused decode + host fetch) / tokens — NOT the same metric
+            # as bench.py's decode_ms_per_token (pure decode window)
+            logger.log(event="generate", tokens=toks, matches=ok,
+                       generate_ms_per_token=dt * 1e3 / n_gen)
     if logger:
         logger.close()
 

@@ -82,7 +82,12 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str):
-    return lax.axis_size(axis_name)
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    # jax <= 0.4.x: psum of the python scalar 1 is evaluated at trace
+    # time against the axis env and returns the CONCRETE size — the
+    # canonical pre-axis_size idiom, safe to drive python-unrolled loops
+    return lax.psum(1, axis_name)
 
 
 def ring_perm(n: int, shift: int = 1) -> list[tuple[int, int]]:
@@ -120,7 +125,7 @@ def ring_psum(x, axis_name: str):
     restructured as `lax.fori_loop` over rotating blocks; do that when
     such a ring becomes a real use case, not before.
     """
-    n = lax.axis_size(axis_name)
+    n = int(axis_size(axis_name))
     if n == 1:
         return x
     me = lax.axis_index(axis_name)

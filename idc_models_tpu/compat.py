@@ -1,0 +1,22 @@
+"""Version-compatibility shims for the jax API surface this framework
+rides.
+
+The framework targets current jax, where `shard_map` is top-level
+(`jax.shard_map`) and the replication check is spelled `check_vma`.
+Older runtimes (jax <= 0.4.x, e.g. a CPU-only CI container) ship the
+same functionality as `jax.experimental.shard_map.shard_map` with the
+check named `check_rep`. One definition here so every shard_map call
+site — library and tests — works unchanged on both."""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)

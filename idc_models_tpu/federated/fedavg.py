@@ -43,7 +43,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from idc_models_tpu.compat import shard_map
 
 from idc_models_tpu import collectives
 from idc_models_tpu import mesh as meshlib

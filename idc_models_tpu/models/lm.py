@@ -8,10 +8,16 @@ close the loop the round-5 pieces opened. `attention_lm` is the
 smallest honest decoder-only LM — token embedding + learned positions,
 the SAME pre-LN ring-attention blocks as the classifier
 (`models/attention.py::transformer_block`), final LN, per-position
-vocab head — and `make_lm_decoder` drives the SAME parameters through
-single-token KV-cache steps: per block, project this token's q/k/v,
-fold against the block's ring-sharded cache (`ring_decode`), residual +
-MLP, exactly the block forward restricted to one position.
+vocab head — and the serving side drives the SAME parameters:
+`make_lm_decoder` exposes single-token KV-cache steps (per block,
+project this token's q/k/v, fold against the block's ring-sharded
+cache (`ring_decode`), residual + MLP — exactly the block forward
+restricted to one position) plus a ring prefill, and `Generator` is
+the compiled serving object: one ring-sharded prefill dispatch over
+the prompt (O(P/n) per device, same `make_ring_attention` as
+training) and ONE fused `lax.scan` dispatch emitting all requested
+tokens with the caches donated through the loop — compiled once per
+decode configuration, process-wide, zero recompilation on reuse.
 
 Incremental == full: teacher-forcing the decoder over a sequence
 reproduces the training-path logits at every position to fp tolerance
@@ -25,14 +31,20 @@ path — layout is a training knob, not a serving constraint.
 
 from __future__ import annotations
 
+import functools
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
 from idc_models_tpu.models.attention import _seq_pin, transformer_block
-from idc_models_tpu.ring_decode import init_cache, make_ring_decode
+from idc_models_tpu.ring_decode import (
+    cache_sharding, init_cache, make_ring_decode,
+)
 
 
 def attention_lm(vocab_size: int, seq_len: int, *,
@@ -122,10 +134,227 @@ def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return -jnp.mean(ll)
 
 
+class _ServeConfig(NamedTuple):
+    """Everything that shapes the compiled serving programs — and
+    NOTHING that doesn't (parameters are explicit arguments, prompt
+    length and step count are jit shape keys). Hashable, so one config
+    maps to one compiled program set for the life of the process."""
+    mesh: Mesh
+    embed_dim: int
+    num_heads: int
+    num_blocks: int
+    t_max: int
+    cache_dtype: object          # np.dtype (normalized, hashable)
+    block_impl: str
+    temperature: float
+    top_k: int | None
+
+
+def _place_params(params, mesh):
+    """Bind a parameter tree to the SERVING mesh, replicated.
+
+    Host (numpy) trees are fine to pass in — e.g. a checkpoint straight
+    from device_get/restore — and so are device trees living on a
+    DIFFERENT topology (a training state replicated over the full pod,
+    served on a sub-mesh): the serving programs pin activations to the
+    serving mesh, so the parameters must live there too, not wherever
+    training left them."""
+    sh = meshlib.replicated(mesh)
+    return jax.tree.map(
+        lambda a: meshlib.put_with_sharding(jnp.asarray(a), sh), params)
+
+
+class _ServeFns(NamedTuple):
+    init_caches: object
+    step: object          # (params, caches, tok, pos) -> (logits, caches)
+    prefill: object       # (params, tokens) -> (logits, caches)
+    decode_loop: object   # (params, caches, logits, rng, offsets)
+    #                       -> (tokens, logits, caches)
+
+
+def _serve_config(params, *, embed_dim, num_heads, num_blocks, t_max,
+                  mesh, cache_dtype, block_impl="jnp",
+                  temperature=0.0, top_k=None) -> _ServeConfig:
+    if embed_dim % num_heads:
+        raise ValueError(f"embed_dim {embed_dim} not divisible by "
+                         f"num_heads {num_heads}")
+    if params["pos"].shape[0] < t_max:
+        raise ValueError(
+            f"cache t_max {t_max} exceeds the trained position table "
+            f"({params['pos'].shape[0]}) — positions past it have no "
+            f"embedding")
+    mesh = mesh if mesh is not None else meshlib.seq_mesh(1)
+    n = mesh.shape[meshlib.SEQ_AXIS]
+    if t_max % n:
+        raise ValueError(f"t_max {t_max} not divisible by the ring size "
+                         f"{n} over mesh axis {meshlib.SEQ_AXIS!r}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    return _ServeConfig(mesh, embed_dim, num_heads, num_blocks, t_max,
+                        jnp.dtype(cache_dtype), block_impl,
+                        float(temperature), top_k)
+
+
+def _check_prompt(tokens, t_max: int):
+    """The one prompt contract for every prefill entry point: non-empty
+    int32 [B, P] with P <= t_max."""
+    tokens = jnp.asarray(tokens, jnp.int32)
+    if tokens.ndim != 2 or tokens.shape[1] < 1:
+        raise ValueError(f"prefill expects non-empty [B, P] tokens, "
+                         f"got shape {tokens.shape}")
+    if tokens.shape[1] > t_max:
+        raise ValueError(f"prompt length {tokens.shape[1]} exceeds "
+                         f"t_max {t_max}")
+    return tokens
+
+
+@functools.lru_cache(maxsize=16)
+def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
+    """The compile-once serving programs for one decode configuration.
+
+    Every program takes the parameter tree as an EXPLICIT argument
+    instead of closing over it, so the jitted executables — cached here
+    by config and inside jax.jit by shape — are shared across
+    `Generator` instances and repeated `generate` calls: a second
+    request with the same config and shapes performs zero XLA
+    recompilation (ADVICE round 5; gated by
+    tests/test_lm.py::test_generator_reuses_compilation)."""
+    from idc_models_tpu.ring_attention import make_ring_attention
+
+    mesh, t_max = cfg.mesh, cfg.t_max
+    head_dim = cfg.embed_dim // cfg.num_heads
+    n_ring = mesh.shape[meshlib.SEQ_AXIS]
+    # un-jitted decode fold: it is traced INTO the jitted step and the
+    # fused scan below, whose top-level jit owns donation
+    decode = make_ring_decode(mesh, jit=False)
+    ring = make_ring_attention(mesh, causal=True,
+                               block_impl=cfg.block_impl)
+    ln = core.layer_norm(cfg.embed_dim)
+    pin = _seq_pin(mesh)
+
+    def init_caches(batch: int):
+        return tuple(init_cache(mesh, batch, t_max, cfg.num_heads,
+                                head_dim, dtype=cfg.cache_dtype)
+                     for _ in range(cfg.num_blocks))
+
+    def step_body(params, caches, tok, pos):
+        b = tok.shape[0]
+        h = (jnp.take(params["embed"], tok, axis=0)
+             + params["pos"][pos])                      # [B, E]
+        new_caches = []
+        for i in range(cfg.num_blocks):
+            p = params[f"block{i}"]
+            kc, vc = caches[i]
+            a, _ = ln.apply(p["ln1"], {}, h)
+            split = lambda y: y.reshape(b, 1, cfg.num_heads, head_dim)
+            q = split(a @ p["mha"]["wq"].astype(a.dtype))
+            k = split(a @ p["mha"]["wk"].astype(a.dtype))
+            v = split(a @ p["mha"]["wv"].astype(a.dtype))
+            o, kc, vc = decode(kc, vc, q, k, v, pos)
+            o = o.reshape(b, cfg.embed_dim)
+            h = h + (o @ p["mha"]["wo"].astype(o.dtype)
+                     + p["mha"]["bo"].astype(o.dtype))
+            a, _ = ln.apply(p["ln2"], {}, h)
+            m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
+            h = h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"])
+            new_caches.append((kc, vc))
+        h, _ = ln.apply(params["ln_f"], {}, h)
+        logits = h @ params["head"]["kernel"] + params["head"]["bias"]
+        return logits, tuple(new_caches)
+
+    # one dispatch per token for callers driving single steps: without
+    # this, every token pays ~15 eager host-side op dispatches per
+    # block around the cache fold — on the tunneled runtime that is
+    # ~ms each, swamping the 0.15-0.35 ms device floor the decode bench
+    # measures. Caches are donated (a serving loop only ever holds the
+    # returned ones).
+    step = jax.jit(step_body, donate_argnums=(1,))
+
+    def prefill_body(params, tokens):
+        # the prompt runs through the SAME ring the model trained with:
+        # per device a [P/n, P/n]-tiled causal fold instead of a
+        # replicated [B, H, P, P] score tensor — prefill keeps the
+        # O(T/n) property the ring cache exists for. Prompts that do
+        # not divide the ring are end-padded to the next multiple
+        # (causal: pad positions cannot influence real ones) and the
+        # pad K/V is dropped before the cache is built.
+        b, p_len = tokens.shape
+        pad = -p_len % n_ring
+        p_pad = p_len + pad
+        toks = jnp.pad(tokens, ((0, 0), (0, pad)))
+        h = (jnp.take(params["embed"], toks, axis=0)
+             + params["pos"][:p_pad])                    # [B, P', E]
+        h = pin(h)
+        kvs = []
+        for i in range(cfg.num_blocks):
+            p = params[f"block{i}"]
+            a, _ = ln.apply(p["ln1"], {}, h)
+            split = lambda y: y.reshape(b, p_pad, cfg.num_heads,
+                                        head_dim)
+            q = split(a @ p["mha"]["wq"].astype(a.dtype))
+            k = split(a @ p["mha"]["wk"].astype(a.dtype))
+            v = split(a @ p["mha"]["wv"].astype(a.dtype))
+            o = ring(q, k, v)
+            o = o.reshape(b, p_pad, cfg.embed_dim)
+            h = pin(h + (o @ p["mha"]["wo"].astype(o.dtype)
+                         + p["mha"]["bo"].astype(o.dtype)))
+            a, _ = ln.apply(p["ln2"], {}, h)
+            m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
+            h = pin(h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"]))
+            kvs.append((k, v))
+        h, _ = ln.apply(params["ln_f"], {}, h[:, p_len - 1])
+        logits = h @ params["head"]["kernel"] + params["head"]["bias"]
+        sh = cache_sharding(mesh)
+
+        def to_cache(x):                 # K/V -> fresh ring cache slot
+            x = x[:, :p_len].astype(cfg.cache_dtype)
+            x = jnp.pad(x, ((0, 0), (0, t_max - p_len), (0, 0), (0, 0)))
+            return lax.with_sharding_constraint(x, sh)
+
+        return logits, tuple((to_cache(k), to_cache(v)) for k, v in kvs)
+
+    prefill = jax.jit(prefill_body)
+
+    def pick(logits, key):
+        lg = logits.astype(jnp.float32)
+        if cfg.top_k is not None and cfg.top_k < lg.shape[-1]:
+            kth = jax.lax.top_k(lg, cfg.top_k)[0][:, -1]
+            lg = jnp.where(lg >= kth[:, None], lg, -jnp.inf)
+        if cfg.temperature == 0.0:
+            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, lg / cfg.temperature,
+                                      axis=-1).astype(jnp.int32)
+
+    def decode_body(params, caches, logits, rng, offsets):
+        # the WHOLE decode of len(offsets) tokens is one device
+        # program: sample -> embed -> blocks -> ring cache append ->
+        # logits, rolled by lax.scan. One host dispatch total, vs one
+        # (or more) per token in a host loop — the ~4 ms/token
+        # tunneled-dispatch overhead is amortized over the run. The
+        # final carry logits correspond to the last sampled token, so
+        # chained windows continue exactly where this one stopped.
+        def body(carry, off):
+            caches, logits, rng = carry
+            rng, sub = jax.random.split(rng)
+            tok = pick(logits, sub)
+            logits, caches = step_body(params, caches, tok, off)
+            return (caches, logits, rng), tok
+
+        (caches, logits, _), toks = lax.scan(
+            body, (caches, logits, rng), offsets)
+        return jnp.moveaxis(toks, 0, 1), logits, caches
+
+    decode_loop = jax.jit(decode_body, donate_argnums=(1,))
+
+    return _ServeFns(init_caches, step, prefill, decode_loop)
+
+
 def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
                     num_blocks: int, t_max: int,
                     mesh: Mesh | None = None,
-                    cache_dtype=jnp.bfloat16):
+                    cache_dtype=jnp.bfloat16, block_impl: str = "jnp"):
     """Serving loop for an `attention_lm` parameter tree.
 
     Returns ``(init_caches, step, prefill_tokens)``:
@@ -138,168 +367,161 @@ def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
       block's cache fold, out-projection, residual, MLP), and returns
       the next-token logits [B, vocab].
     - ``prefill_tokens(tokens) -> (logits, caches)`` — the whole prompt
-      [B, P] in ONE jitted pass: per block, full causal attention over
-      the prompt and the block's K/V placed straight into a fresh ring
-      cache (`ring_decode.prefill` layout), returning the LAST
-      position's logits. Equal to feeding the prompt through `step`
-      token by token to fp tolerance (the batched projections
-      reassociate the same matmuls; pinned), at batch speed instead of
-      P dispatches.
+      [B, P] in ONE jitted pass THROUGH THE RING
+      (`make_ring_attention` on this mesh, `block_impl` selectable):
+      per block a causal ring fold over the seq-sharded prompt — O(P/n)
+      score memory per device, never a replicated [B, H, P, P] tensor —
+      with the block's K/V placed straight into a fresh ring cache
+      (`ring_decode` layout, built in-jit under `cache_sharding`),
+      returning the LAST position's logits. Equal to feeding the prompt
+      through `step` token by token to fp tolerance, at batch speed
+      instead of P dispatches; prompts not divisible by the ring are
+      end-padded internally (causal ⇒ exact).
 
-    The per-position math reuses the very parameter tree training
-    produced — no export step, no weight transform. Dropout is inference
-    -off by construction (decode is eval)."""
-    if embed_dim % num_heads:
-        raise ValueError(f"embed_dim {embed_dim} not divisible by "
-                         f"num_heads {num_heads}")
-    if params["pos"].shape[0] < t_max:
-        raise ValueError(
-            f"cache t_max {t_max} exceeds the trained position table "
-            f"({params['pos'].shape[0]}) — positions past it have no "
-            f"embedding")
-    head_dim = embed_dim // num_heads
-    mesh = mesh if mesh is not None else meshlib.seq_mesh(1)
-    decode = make_ring_decode(mesh)
-    ln = core.layer_norm(embed_dim)
-    # host (numpy) trees are fine to pass in — e.g. a checkpoint straight
-    # from device_get/restore; the jitted step needs jax arrays to index
-    # with a traced position
-    params = jax.tree.map(jnp.asarray, params)
-
-    def init_caches(batch: int):
-        return tuple(init_cache(mesh, batch, t_max, num_heads, head_dim,
-                                dtype=cache_dtype)
-                     for _ in range(num_blocks))
+    The compiled programs come from a process-wide cache keyed on the
+    decode configuration (`_serving_fns`), with the parameter tree an
+    explicit argument — building a second decoder for the same config
+    recompiles NOTHING. The per-position math reuses the very parameter
+    tree training produced — no export step, no weight transform.
+    Dropout is inference-off by construction (decode is eval)."""
+    cfg = _serve_config(params, embed_dim=embed_dim,
+                        num_heads=num_heads, num_blocks=num_blocks,
+                        t_max=t_max, mesh=mesh, cache_dtype=cache_dtype,
+                        block_impl=block_impl)
+    fns = _serving_fns(cfg)
+    params = _place_params(params, cfg.mesh)
 
     def step(caches, tok, pos):
-        b = tok.shape[0]
-        h = (jnp.take(params["embed"], tok, axis=0)
-             + params["pos"][pos])                      # [B, E]
-        new_caches = []
-        for i in range(num_blocks):
-            p = params[f"block{i}"]
-            kc, vc = caches[i]
-            a, _ = ln.apply(p["ln1"], {}, h)
-            split = lambda y: y.reshape(b, 1, num_heads, head_dim)
-            q = split(a @ p["mha"]["wq"].astype(a.dtype))
-            k = split(a @ p["mha"]["wk"].astype(a.dtype))
-            v = split(a @ p["mha"]["wv"].astype(a.dtype))
-            o, kc, vc = decode(kc, vc, q, k, v, pos)
-            o = o.reshape(b, embed_dim)
-            h = h + (o @ p["mha"]["wo"].astype(o.dtype)
-                     + p["mha"]["bo"].astype(o.dtype))
-            a, _ = ln.apply(p["ln2"], {}, h)
-            m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
-            h = h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"])
-            new_caches.append((kc, vc))
-        h, _ = ln.apply(params["ln_f"], {}, h)
-        logits = h @ params["head"]["kernel"] + params["head"]["bias"]
-        return logits, tuple(new_caches)
-
-    # one dispatch per token: without this, every token pays ~15 eager
-    # host-side op dispatches per block around the jitted cache fold —
-    # on the tunneled runtime that is ~ms each, swamping the 0.15-0.35
-    # ms device floor the decode bench measures. Caches are donated (the
-    # serving loop only ever holds the returned ones).
-    step = jax.jit(step, donate_argnums=(0,))
-
-    from idc_models_tpu.ring_attention import full_attention
-    from idc_models_tpu.ring_decode import prefill as cache_prefill
-
-    @jax.jit
-    def _prefill_fwd(tokens):
-        b, p_len = tokens.shape
-        h = (jnp.take(params["embed"], tokens, axis=0)
-             + params["pos"][:p_len])                    # [B, P, E]
-        kvs = []
-        for i in range(num_blocks):
-            p = params[f"block{i}"]
-            a, _ = ln.apply(p["ln1"], {}, h)
-            split = lambda y: y.reshape(b, p_len, num_heads, head_dim)
-            q = split(a @ p["mha"]["wq"].astype(a.dtype))
-            k = split(a @ p["mha"]["wk"].astype(a.dtype))
-            v = split(a @ p["mha"]["wv"].astype(a.dtype))
-            o = full_attention(q, k, v, causal=True)
-            o = o.reshape(b, p_len, embed_dim)
-            h = h + (o @ p["mha"]["wo"].astype(o.dtype)
-                     + p["mha"]["bo"].astype(o.dtype))
-            a, _ = ln.apply(p["ln2"], {}, h)
-            m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
-            h = h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"])
-            kvs.append((k, v))
-        h, _ = ln.apply(params["ln_f"], {}, h[:, -1])
-        logits = h @ params["head"]["kernel"] + params["head"]["bias"]
-        return logits, kvs
+        return fns.step(params, caches, tok, pos)
 
     def prefill_tokens(tokens):
-        tokens = jnp.asarray(tokens, jnp.int32)
-        if tokens.ndim != 2 or tokens.shape[1] < 1:
-            raise ValueError(f"prefill_tokens expects non-empty [B, P] "
-                             f"tokens, got shape {tokens.shape}")
-        if tokens.shape[1] > t_max:
-            raise ValueError(f"prompt length {tokens.shape[1]} exceeds "
-                             f"t_max {t_max}")
-        logits, kvs = _prefill_fwd(tokens)
-        caches = tuple(
-            cache_prefill(mesh, k.astype(cache_dtype),
-                          v.astype(cache_dtype), t_max,
-                          dtype=cache_dtype)
-            for k, v in kvs)
-        return logits, caches
+        return fns.prefill(params, _check_prompt(tokens, t_max))
 
-    return init_caches, step, prefill_tokens
+    return fns.init_caches, step, prefill_tokens
+
+
+class Generator:
+    """Reusable compiled serving path: ring prefill + fused scan decode.
+
+    Build ONCE per parameter tree and decode configuration, then serve
+    repeated requests: ``gen(prompt, steps, rng=...) -> [B, P + steps]``
+    runs the whole generation in two device dispatches — one ring
+    prefill over the prompt, one `lax.scan` emitting all `steps` tokens
+    (embed → blocks → ring cache append → logits → temperature/top_k
+    sample entirely on device, caches donated through the scan).
+
+    The underlying XLA programs live in a process-wide cache keyed on
+    the decode configuration with parameters passed explicitly, so a
+    second `Generator` (fresh checkpoint, same shapes) or a repeated
+    call reuses the compiled executables outright — zero recompilation
+    (gated by test). `temperature=0` (default) is greedy argmax;
+    `temperature > 0` samples from softmax(logits / temperature)
+    (requires `rng` per call), optionally restricted to the `top_k`
+    most likely tokens.
+
+    Bounds contract: the Generator owns `pos` — `__call__`/`decode`
+    reject any request past `t_max` BEFORE dispatch, because inside the
+    fused scan positions are traced and an out-of-range append would
+    otherwise be silently dropped (`ring_decode` can only guard
+    concrete positions)."""
+
+    def __init__(self, params, *, embed_dim: int, num_heads: int,
+                 num_blocks: int, t_max: int, mesh: Mesh | None = None,
+                 cache_dtype=jnp.bfloat16, block_impl: str = "jnp",
+                 temperature: float = 0.0, top_k: int | None = None):
+        self._cfg = _serve_config(
+            params, embed_dim=embed_dim, num_heads=num_heads,
+            num_blocks=num_blocks, t_max=t_max, mesh=mesh,
+            cache_dtype=cache_dtype, block_impl=block_impl,
+            temperature=temperature, top_k=top_k)
+        self._fns = _serving_fns(self._cfg)
+        self._params = _place_params(params, self._cfg.mesh)
+        self.t_max = t_max
+        self.temperature = float(temperature)
+
+    def init_caches(self, batch: int):
+        """Fresh zeroed ring caches (one (k, v) pair per block)."""
+        return self._fns.init_caches(batch)
+
+    def prefill(self, prompt):
+        """Prompt [B, P] -> (last-position logits [B, vocab], caches),
+        one ring-sharded pass (O(P/n) per device)."""
+        return self._fns.prefill(self._params,
+                                 _check_prompt(prompt, self.t_max))
+
+    def decode(self, caches, logits, pos0: int, steps: int, *, rng=None):
+        """Emit `steps` tokens in ONE dispatch from (caches, logits) at
+        global position `pos0` (the position the next sampled token
+        occupies). Returns ``(tokens [B, steps], logits, caches)`` —
+        the logits/caches continue a chained window exactly. Donates
+        `caches`."""
+        if steps < 1:
+            raise ValueError(f"decode needs steps >= 1, got {steps}")
+        if pos0 < 0:
+            raise ValueError(f"decode pos {pos0} must be >= 0 — inside "
+                             f"the fused scan a negative append matches "
+                             f"no owner shard and would be silently "
+                             f"dropped")
+        if pos0 + steps > self.t_max:
+            raise ValueError(f"decode at pos {pos0} + steps {steps} "
+                             f"exceeds t_max {self.t_max} — the cache "
+                             f"cannot grow at decode time")
+        if self.temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs an rng "
+                             "key")
+        if rng is None:
+            rng = jax.random.key(0)      # greedy never consumes it
+        offsets = jnp.arange(pos0, pos0 + steps, dtype=jnp.int32)
+        return self._fns.decode_loop(self._params, caches, logits, rng,
+                                     offsets)
+
+    def __call__(self, prompt, steps: int, *, rng=None):
+        prompt = jnp.asarray(prompt, jnp.int32)
+        p_len = prompt.shape[1] if prompt.ndim == 2 else 0
+        if steps < 1 or p_len < 1:
+            raise ValueError(f"generate needs a non-empty prompt and "
+                             f"steps >= 1, got prompt length {p_len}, "
+                             f"steps {steps}")
+        if p_len + steps > self.t_max:
+            raise ValueError(f"prompt {p_len} + steps {steps} exceeds "
+                             f"t_max {self.t_max}")
+        if self.temperature > 0.0 and rng is None:
+            # before the prefill dispatch: a 16k-token prompt must not
+            # compile and run just to throw away the work on this
+            raise ValueError("sampling (temperature > 0) needs an rng "
+                             "key")
+        logits, caches = self.prefill(prompt)
+        toks, _, _ = self.decode(caches, logits, p_len, steps, rng=rng)
+        return jnp.concatenate([prompt, toks], axis=1)
+
+    def cache_sizes(self) -> dict:
+        """Per-program jit-cache entry counts — observability for the
+        zero-recompilation contract (a second same-shape call must not
+        grow any of these)."""
+        return {"step": self._fns.step._cache_size(),
+                "prefill": self._fns.prefill._cache_size(),
+                "decode_loop": self._fns.decode_loop._cache_size()}
 
 
 def generate(params, prompt, steps: int, *, embed_dim: int,
              num_heads: int, num_blocks: int, t_max: int,
              mesh: Mesh | None = None, cache_dtype=jnp.bfloat16,
              temperature: float = 0.0, top_k: int | None = None,
-             rng=None):
-    """Generation through the cached decoder: one-pass prompt prefill,
-    then `steps` tokens. `temperature=0` (default) is greedy argmax;
-    `temperature > 0` samples from softmax(logits / temperature)
-    (requires `rng`), optionally restricted to the `top_k` most likely
-    tokens. Returns int32 [B, P + steps] (prompt included)."""
-    prompt = jnp.asarray(prompt, jnp.int32)
-    b, p_len = prompt.shape
-    if steps < 1 or p_len < 1:
-        raise ValueError(f"generate needs a non-empty prompt and "
-                         f"steps >= 1, got prompt length {p_len}, "
-                         f"steps {steps}")
-    if p_len + steps > t_max:
-        raise ValueError(f"prompt {p_len} + steps {steps} exceeds "
-                         f"t_max {t_max}")
-    if temperature < 0.0:
-        raise ValueError(f"temperature must be >= 0, got {temperature}")
-    if temperature > 0.0 and rng is None:
-        raise ValueError("sampling (temperature > 0) needs an rng key")
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1, got {top_k}")
-    _, step, prefill_tokens = make_lm_decoder(
-        params, embed_dim=embed_dim, num_heads=num_heads,
-        num_blocks=num_blocks, t_max=t_max, mesh=mesh,
-        cache_dtype=cache_dtype)
+             rng=None, block_impl: str = "jnp"):
+    """One-shot convenience around `Generator`: one-pass ring prefill,
+    then `steps` tokens in a single fused dispatch. `temperature=0`
+    (default) is greedy argmax; `temperature > 0` samples from
+    softmax(logits / temperature) (requires `rng`), optionally
+    restricted to the `top_k` most likely tokens. Returns int32
+    [B, P + steps] (prompt included).
 
-    @jax.jit  # one dispatch, like the decode step it follows
-    def pick(logits, key):
-        lg = logits.astype(jnp.float32)
-        if top_k is not None and top_k < lg.shape[-1]:
-            kth = jax.lax.top_k(lg, top_k)[0][:, -1]
-            lg = jnp.where(lg >= kth[:, None], lg, -jnp.inf)
-        if temperature == 0.0:
-            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, lg / temperature,
-                                      axis=-1).astype(jnp.int32)
-
-    # whole prompt in one pass (pinned equal to token-by-token feeding)
-    logits, caches = prefill_tokens(prompt)
-    out = [prompt]
-    for s in range(steps):
-        sub = None
-        if temperature > 0.0:
-            rng, sub = jax.random.split(rng)
-        tok = pick(logits, sub)
-        out.append(tok[:, None])
-        if s + 1 < steps:   # the last token's logits are never needed
-            logits, caches = step(caches, tok, p_len + s)
-    return jnp.concatenate(out, axis=1)
+    Repeated calls are cheap: the compiled programs are cached
+    process-wide per decode config (see `_serving_fns`), so only the
+    first call with a given config + shape pays XLA compilation. Hot
+    serving loops should still hold a `Generator` to skip the per-call
+    validation and tree re-asserting."""
+    gen = Generator(params, embed_dim=embed_dim, num_heads=num_heads,
+                    num_blocks=num_blocks, t_max=t_max, mesh=mesh,
+                    cache_dtype=cache_dtype, block_impl=block_impl,
+                    temperature=temperature, top_k=top_k)
+    return gen(prompt, steps, rng=rng)

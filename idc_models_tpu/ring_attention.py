@@ -73,7 +73,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from idc_models_tpu import collectives
 from idc_models_tpu import mesh as meshlib
 
-shard_map = jax.shard_map
+from idc_models_tpu.compat import shard_map
 
 
 # Masked scores use a large finite negative instead of -inf: exp() of it

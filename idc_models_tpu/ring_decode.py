@@ -50,7 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from idc_models_tpu import collectives
 from idc_models_tpu import mesh as meshlib
 
-shard_map = jax.shard_map
+from idc_models_tpu.compat import shard_map
 
 _MASKED = -1e30  # same finite sentinel as ring_attention._MASKED
 
@@ -80,7 +80,7 @@ def init_cache(mesh: Mesh, batch: int, t_max: int, heads: int, dim: int,
 
 
 def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
-                     scale: float | None = None):
+                     scale: float | None = None, jit: bool = True):
     """Build ``fn(k_cache, v_cache, q_t, k_t, v_t, pos) ->
     (out_t, k_cache, v_cache)``.
 
@@ -89,7 +89,13 @@ def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     scalar; cache slots > pos must still be zero/garbage-masked). The
     returned function is jitted with both caches donated — the decode
     loop updates in place, O(1) HBM traffic per step beyond the shard
-    writes."""
+    writes.
+
+    ``jit=False`` returns the same function un-jitted, for callers that
+    trace it into a LARGER jitted program (the LM's fused scan decode
+    loop, models/lm.py) — a nested jit would discard the donation with
+    a warning, and the caller's top-level jit owns donation anyway.
+    Traced callers also own the `pos` bound (see below)."""
     n = mesh.shape[axis]
 
     def per_device(kc, vc, q, kt, vt, pos):
@@ -162,20 +168,34 @@ def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
                 f"size {n} over mesh axis {axis!r}")
         return mapped(kc, vc, q_t, k_t, v_t, pos)
 
+    if not jit:
+        return checked
+
     jitted = jax.jit(checked, donate_argnums=(0, 1))
 
     def fn(kc, vc, q_t, k_t, v_t, pos):
         # pos >= t_max would silently drop the append (no shard owns
         # the slot) and return attention that excludes the new token —
-        # reject concrete out-of-range positions here; callers tracing
-        # pos (their own jit/scan loop) own the bound as a contract
+        # reject ANY concrete out-of-range position here: python and
+        # numpy ints, numpy scalars, and already-materialized jax
+        # scalars (a jnp.int32(t_max) must fail the same way, not
+        # silently vanish). Callers tracing pos (their own jit/scan
+        # loop) own the bound as a contract.
         import numpy as _np
 
-        if isinstance(pos, (int, _np.integer)) and not (
-                0 <= pos < kc.shape[1]):
+        concrete = None
+        if isinstance(pos, (int, _np.integer)):
+            concrete = int(pos)
+        elif (isinstance(pos, (jax.Array, _np.ndarray))
+              and jnp.ndim(pos) == 0):
+            try:
+                concrete = int(pos)
+            except jax.errors.ConcretizationTypeError:
+                pass   # traced: the caller's jit/scan owns the bound
+        if concrete is not None and not (0 <= concrete < kc.shape[1]):
             raise ValueError(
-                f"pos {pos} outside the cache (t_max {kc.shape[1]}) — "
-                f"grow the cache at init/prefill time; decode cannot "
+                f"pos {concrete} outside the cache (t_max {kc.shape[1]})"
+                f" — grow the cache at init/prefill time; decode cannot "
                 f"append past it")
         return jitted(kc, vc, q_t, k_t, v_t, pos)
 

@@ -51,7 +51,7 @@ from idc_models_tpu.secure.paillier import (
 )
 
 LossFn = Callable[[jax.Array, jax.Array], jax.Array]
-shard_map = jax.shard_map
+from idc_models_tpu.compat import shard_map
 
 # Protected model_state tensors (BN moving statistics) are prescaled by
 # 1/256 before quantization and rescaled after aggregation: ImageNet-scale

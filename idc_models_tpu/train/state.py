@@ -49,7 +49,33 @@ def rmsprop(learning_rate: float, *, rho: float = 0.9, eps: float = 1e-7,
     trainability mask replacing freeze/recompile (quirk Q6)."""
     # eps_in_sqrt=False: Keras updates with g / (sqrt(nu) + eps); optax's
     # default puts eps inside the sqrt, which damps very differently at nu~0.
-    opt = optax.rmsprop(learning_rate, decay=rho, eps=eps, eps_in_sqrt=False)
+    import inspect
+
+    if "eps_in_sqrt" in inspect.signature(optax.rmsprop).parameters:
+        opt = optax.rmsprop(learning_rate, decay=rho, eps=eps,
+                            eps_in_sqrt=False)
+    else:
+        # older optax has no eps_in_sqrt knob and hard-codes the
+        # inside-the-sqrt form; hand-roll the same Keras-form transform.
+        # The state is optax's own ScaleByRmsState(nu=...) inside the
+        # standard two-element chain, so the opt_state PYTREE STRUCTURE
+        # matches what new optax.rmsprop produces — checkpoints
+        # round-trip across optax versions, and numerics agree.
+        def _init(params):
+            return optax.ScaleByRmsState(
+                nu=jax.tree.map(jnp.zeros_like, params))
+
+        def _update(updates, state, params=None):
+            del params
+            nu = jax.tree.map(lambda n, g: rho * n + (1 - rho) * g * g,
+                              state.nu, updates)
+            upd = jax.tree.map(lambda g, n: g / (jnp.sqrt(n) + eps),
+                               updates, nu)
+            return upd, optax.ScaleByRmsState(nu=nu)
+
+        opt = optax.chain(
+            optax.GradientTransformation(_init, _update),
+            optax.scale(-learning_rate))
     return freeze_where(opt, trainable_mask)
 
 

@@ -37,7 +37,12 @@ def make_train_step(model: core.Module, optimizer: optax.GradientTransformation,
     """Returns train_step(state, images, labels, rng) -> (state, metrics)."""
 
     def train_step(state: TrainState, images, labels, rng):
-        images = images.astype(compute_dtype)
+        # integer inputs (LM token ids) skip the compute-dtype cast: a
+        # bf16 round-trip would silently corrupt ids > 256 before the
+        # model's int32 cast-back (attention_lm), and integer inputs
+        # never benefit from a low-precision matmul dtype anyway
+        if not jnp.issubdtype(jnp.asarray(images).dtype, jnp.integer):
+            images = images.astype(compute_dtype)
 
         def loss_of(params):
             logits, new_model_state = model.apply(
@@ -67,7 +72,8 @@ def make_eval_step(model: core.Module, loss_fn: LossFn, *,
     """Returns eval_step(state, images, labels) -> metrics (loss/acc/logits)."""
 
     def eval_step(state: TrainState, images, labels):
-        images = images.astype(compute_dtype)
+        if not jnp.issubdtype(jnp.asarray(images).dtype, jnp.integer):
+            images = images.astype(compute_dtype)  # ids stay exact
         logits, _ = model.apply(state.params, state.model_state, images,
                                 train=False)
         logits = logits.astype(jnp.float32)

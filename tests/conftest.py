@@ -18,10 +18,30 @@ import jax  # noqa: E402
 # VGG16 train-step compile drops ~1.6s -> ~0.3s; the suite is full of
 # them). Keyed by HLO + compile options + jax version, so stale entries
 # can't be served; the dir is gitignored.
-jax.config.update("jax_compilation_cache_dir",
-                  str(pathlib.Path(__file__).parent / ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+#
+# ONLY on newer jax (the top-level-shard_map API line): on 0.4.x
+# XLA:CPU a DESERIALIZED cached executable of a donating jitted train
+# step silently returns wrong outputs — first (cold) run correct,
+# second (warm) run leaves updated params untouched (reproduced via
+# test_freeze_machinery_applies: head delta 0.0316 cold, 0.0 from the
+# cache hit). Correctness over speed: leave the cache off there.
+PERSISTENT_CACHE_OK = hasattr(jax, "shard_map")
+if PERSISTENT_CACHE_OK:
+    jax.config.update("jax_compilation_cache_dir",
+                      str(pathlib.Path(__file__).parent / ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+else:
+    # actively DISABLE it: an ambient JAX_COMPILATION_CACHE_DIR in the
+    # developer's shell would re-enable the broken cache behind the
+    # guard (and test_examples.py copies os.environ into subprocesses)
+    import os as _os
+
+    for _var in ("JAX_COMPILATION_CACHE_DIR",
+                 "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                 "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"):
+        _os.environ.pop(_var, None)
+    jax.config.update("jax_compilation_cache_dir", None)
 
 import pytest  # noqa: E402
 

@@ -9,13 +9,14 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from idc_models_tpu import collectives, mesh as meshlib
+from idc_models_tpu.compat import shard_map
 
 N = 8
 
 
 def _run(body, vals, out_specs=P(), n=N):
     mesh = meshlib.data_mesh(n)
-    f = jax.jit(jax.shard_map(body, mesh=mesh,
+    f = jax.jit(shard_map(body, mesh=mesh,
                               in_specs=P(meshlib.DATA_AXIS),
                               out_specs=out_specs, check_vma=False))
     return f(vals)
@@ -41,7 +42,7 @@ def test_weighted_pmean_matches_numpy():
         return collectives.weighted_pmean(x[0], wi[0], meshlib.DATA_AXIS)
 
     mesh = meshlib.data_mesh(N)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(meshlib.DATA_AXIS), P(meshlib.DATA_AXIS)),
         out_specs=P(), check_vma=False))

@@ -11,13 +11,18 @@ import pytest
 
 EXAMPLES = sorted((Path(__file__).parent.parent / "examples").glob("*.py"))
 # share the suite's persistent compilation cache (conftest.py) with the
-# subprocesses so repeat runs skip the example models' compiles too
-_ENV = dict(
-    os.environ,
-    JAX_COMPILATION_CACHE_DIR=str(Path(__file__).parent / ".jax_cache"),
-    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5",
-    JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="-1",
-)
+# subprocesses so repeat runs skip the example models' compiles too —
+# only where the cache is trustworthy (see conftest.PERSISTENT_CACHE_OK:
+# 0.4.x XLA:CPU serves silently-wrong deserialized executables)
+from conftest import PERSISTENT_CACHE_OK
+
+_ENV = dict(os.environ)
+if PERSISTENT_CACHE_OK:
+    _ENV.update(
+        JAX_COMPILATION_CACHE_DIR=str(Path(__file__).parent / ".jax_cache"),
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5",
+        JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="-1",
+    )
 
 
 @pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)

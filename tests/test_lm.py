@@ -13,7 +13,7 @@ import pytest
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models.lm import (
-    attention_lm, generate, make_lm_decoder, next_token_loss,
+    Generator, attention_lm, generate, make_lm_decoder, next_token_loss,
 )
 from idc_models_tpu.train import (
     TrainState, jit_data_parallel, make_train_step, replicate, rmsprop,
@@ -196,6 +196,166 @@ def test_prefill_tokens_equals_tokenwise(devices):
         prefill_tokens(jnp.zeros((2, 0), jnp.int32))
     with pytest.raises(ValueError, match="exceeds"):
         prefill_tokens(jnp.zeros((2, SEQ + 1), jnp.int32))
+
+
+def test_prefill_runs_through_ring(devices):
+    """Ring prefill == the single-device full-attention forward (the
+    old prefill path) at the last prompt position — for prompts both
+    divisible and NOT divisible by the ring (internal end-padding),
+    with the caches landing ring-sharded and the pad region zero."""
+    from idc_models_tpu.ring_decode import cache_sharding
+
+    mesh = meshlib.seq_mesh(4)
+    params = _model(mesh).init(jax.random.key(21)).params
+    ref_model = _model(None)          # full_attention blocks
+    toks = _toks(2, seed=17)
+    full, _ = ref_model.apply(params, {}, toks)
+    _, _, prefill_tokens = make_lm_decoder(
+        params, embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+        t_max=SEQ, mesh=mesh, cache_dtype=jnp.float32)
+    want = cache_sharding(mesh)
+    for p_len in (16, 18):            # 18 % 4 != 0 -> padded internally
+        logits, caches = prefill_tokens(toks[:, :p_len])
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, p_len - 1]),
+                                   rtol=2e-4, atol=2e-4)
+        for kc, vc in caches:
+            assert kc.sharding.is_equivalent_to(want, kc.ndim)
+            assert vc.sharding.is_equivalent_to(want, vc.ndim)
+            # slots past the prompt stay zero — the fresh-cache
+            # contract decode's visibility masking relies on
+            assert not np.asarray(kc)[:, p_len:].any()
+            assert not np.asarray(vc)[:, p_len:].any()
+
+
+def _step_loop_reference(params, prompt, steps, mesh, temperature,
+                         top_k, rng):
+    """The pre-fused serving loop — prefill, then one pick + one step()
+    dispatch per token — with pick's exact math inlined. The fused scan
+    must reproduce its token sequence bit-for-bit (same rng split
+    order: one split per emitted token, before the pick)."""
+    _, step, prefill_tokens = make_lm_decoder(
+        params, embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+        t_max=SEQ, mesh=mesh, cache_dtype=jnp.float32)
+    logits, caches = prefill_tokens(prompt)
+    p_len = prompt.shape[1]
+    toks = [prompt]
+    for s in range(steps):
+        rng, sub = jax.random.split(rng)
+        lg = logits.astype(jnp.float32)
+        if top_k is not None and top_k < lg.shape[-1]:
+            kth = jax.lax.top_k(lg, top_k)[0][:, -1]
+            lg = jnp.where(lg >= kth[:, None], lg, -jnp.inf)
+        if temperature == 0.0:
+            tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        else:
+            tok = jax.random.categorical(sub, lg / temperature,
+                                         axis=-1).astype(jnp.int32)
+        toks.append(tok[:, None])
+        if s + 1 < steps:
+            logits, caches = step(caches, tok, p_len + s)
+    return jnp.concatenate(toks, axis=1)
+
+
+def test_fused_decode_matches_step_loop(devices):
+    """The one-dispatch scan decode emits the SAME token sequence as
+    driving step() from the host, greedy and seeded top-k sampling."""
+    mesh = meshlib.seq_mesh(4)
+    params = _model(mesh).init(jax.random.key(23)).params
+    prompt = _toks(2, seed=19)[:, :10]
+    kw = dict(embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+              t_max=SEQ, mesh=mesh, cache_dtype=jnp.float32)
+    fused = generate(params, prompt, 8, **kw)
+    ref = _step_loop_reference(params, prompt, 8, mesh, 0.0, None,
+                               jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+    gen = Generator(params, temperature=1.3, top_k=4, **kw)
+    fused = gen(prompt, 8, rng=jax.random.key(42))
+    ref = _step_loop_reference(params, prompt, 8, mesh, 1.3, 4,
+                               jax.random.key(42))
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+
+
+def test_generator_reuses_compilation(devices):
+    """Zero recompilation on reuse: a second same-shape call — and a
+    second Generator over a fresh same-shape parameter tree — must not
+    grow any program's jit cache (the ADVICE r5 per-request re-jit)."""
+    mesh = meshlib.seq_mesh(2)
+    params = _model(mesh).init(jax.random.key(31)).params
+    kw = dict(embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+              t_max=SEQ, mesh=mesh, cache_dtype=jnp.float32)
+    gen = Generator(params, **kw)
+    prompt = _toks(2, seed=33)[:, :8]
+    out1 = gen(prompt, 5)
+    sizes = gen.cache_sizes()
+    out2 = gen(prompt, 5)
+    assert gen.cache_sizes() == sizes, (gen.cache_sizes(), sizes)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    params2 = jax.tree.map(lambda a: np.array(a), params)
+    gen2 = Generator(params2, **kw)
+    out3 = gen2(prompt, 5)
+    assert gen2.cache_sizes() == sizes, (gen2.cache_sizes(), sizes)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out3))
+
+
+def test_generator_chained_decode_windows(devices):
+    """decode() windows chain exactly: two back-to-back windows through
+    the returned (logits, caches) equal one window of the combined
+    length — the contract the serving bench leans on."""
+    params = _model(None).init(jax.random.key(35)).params
+    kw = dict(embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+              t_max=SEQ, cache_dtype=jnp.float32)
+    gen = Generator(params, **kw)
+    prompt = _toks(1, seed=37)[:, :6]
+    one = gen(prompt, 10)
+    logits, caches = gen.prefill(prompt)
+    t1, logits, caches = gen.decode(caches, logits, 6, 4)
+    t2, _, _ = gen.decode(caches, logits, 10, 6)
+    two = jnp.concatenate([prompt, t1, t2], axis=1)
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(two))
+    with pytest.raises(ValueError, match="exceeds t_max"):
+        gen.decode(gen.init_caches(1), jnp.zeros((1, VOCAB)), SEQ - 2, 4)
+    with pytest.raises(ValueError, match=">= 0"):
+        gen.decode(gen.init_caches(1), jnp.zeros((1, VOCAB)), -1, 2)
+
+
+def test_int_tokens_skip_compute_dtype_cast(devices):
+    """bf16 train/eval steps must not round-trip token ids through the
+    compute dtype: ids > 256 would corrupt before attention_lm's int32
+    cast-back (ADVICE r5). With the integer-dtype skip, a bf16 step is
+    bit-identical to the f32 step on the same int tokens."""
+    from idc_models_tpu.train.step import make_eval_step
+    from idc_models_tpu.train import rmsprop
+
+    vocab, seq = 600, 8
+    model = attention_lm(vocab, seq, embed_dim=16, num_heads=2,
+                         mlp_dim=32, num_blocks=1)
+    variables = model.init(jax.random.key(41))
+    opt = rmsprop(1e-3)
+
+    def fresh_state():
+        return TrainState(step=jnp.zeros((), jnp.int32),
+                          params=variables.params,
+                          model_state=variables.state,
+                          opt_state=opt.init(variables.params))
+
+    toks = jnp.asarray([[1, 511, 512, 513, 300, 2, 3, 4]], jnp.int32)
+    ev_bf = make_eval_step(model, next_token_loss,
+                           compute_dtype=jnp.bfloat16)(
+        fresh_state(), toks, toks)
+    ev_f32 = make_eval_step(model, next_token_loss,
+                            compute_dtype=jnp.float32)(
+        fresh_state(), toks, toks)
+    np.testing.assert_array_equal(np.asarray(ev_bf["logits"]),
+                                  np.asarray(ev_f32["logits"]))
+    key = jax.random.key(43)
+    _, m_bf = make_train_step(model, opt, next_token_loss,
+                              compute_dtype=jnp.bfloat16)(
+        fresh_state(), toks, toks, key)
+    _, m_f32 = make_train_step(model, opt, next_token_loss,
+                               compute_dtype=jnp.float32)(
+        fresh_state(), toks, toks, key)
+    assert float(m_bf["loss"]) == float(m_f32["loss"])
 
 
 def test_generate_sampling_modes(devices):

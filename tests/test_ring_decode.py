@@ -142,3 +142,11 @@ def test_decode_rejections(devices):
         kc, vc = init_cache(mesh, B, 16, H, D, dtype=jnp.float32)
         q, k, v = _kvq(16)
         make_ring_decode(mesh)(kc, vc, q[:, :1], k[:, :1], v[:, :1], 16)
+    # a CONCRETE jax scalar must fail the same way, not silently drop
+    # the append (no shard owns slot t_max); same for a numpy 0-d array
+    for bad in (jnp.int32(16), np.asarray(16)):
+        with pytest.raises(ValueError, match="outside the cache"):
+            kc, vc = init_cache(mesh, B, 16, H, D, dtype=jnp.float32)
+            q, k, v = _kvq(16)
+            make_ring_decode(mesh)(kc, vc, q[:, :1], k[:, :1], v[:, :1],
+                                   bad)

@@ -9,6 +9,7 @@ import pytest
 
 from idc_models_tpu import collectives
 from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.compat import shard_map
 from idc_models_tpu.data import synthetic
 from idc_models_tpu.data.idc import ArrayDataset
 from idc_models_tpu.data.partition import partition_clients
@@ -53,7 +54,7 @@ def test_masked_psum_equals_plain_psum():
         return masked_sum, plain_sum, (q + m)[None]
 
     from jax.sharding import PartitionSpec as P
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         body, mesh=mesh, in_specs=P(meshlib.CLIENT_AXIS),
         out_specs=(P(), P(), P(meshlib.CLIENT_AXIS)), check_vma=False))
     masked_sum, plain_sum, contributions = f(vals)
