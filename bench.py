@@ -690,6 +690,118 @@ def bench_lm_decode(on_accelerator: bool):
             "decode_tokens_per_sec": round(n_dec / best, 1)}
 
 
+def bench_serving(on_accelerator: bool):
+    """The continuous-batching engine (serve/) vs the serial PR-1
+    `Generator` on the SAME trace — the serving scenario record.
+
+    The scenario is EOS-terminated GOODPUT, the thing a multi-user
+    server is judged on: every request carries a stop token (probed as
+    the deepest-first-appearing token of a greedy stream, so stops land
+    mid-budget) and a budget near t_max. The engine's masked windows
+    retire a slot the step its EOS lands and recycle it into the next
+    queued request; the serial fused scan CANNOT early-exit — it decodes
+    every request's full budget and throws the post-EOS tail away. Both
+    paths produce bit-identical useful tokens (engine parity is gated
+    by test), both replay the trace in arrival order as a burst, both
+    are timed warm (compilation in a discarded first pass), and both
+    end with host fetches that data-depend on the emitted tokens
+    (module docstring: the only trustworthy fence). Three interleaved
+    pairs, best window each — `serve_tokens_per_sec` must be >= the
+    serial baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import Generator, attention_lm
+    from idc_models_tpu.serve import LMServer, poisson_trace
+
+    if on_accelerator:
+        vocab, e, heads, blocks, mlp = 1024, 512, 8, 2, 2048
+        t_max, n_slots, window, n_req = 2048, 8, 64, 16
+        prompt_lens, budgets = (64, 256), (1200, 1500)
+    else:
+        # CPU smoke note: a serial CPU has no idle batch lanes for
+        # continuous batching to fill, so the structural win here is
+        # EOS-recycling alone and the margin is thin — on the
+        # accelerator the batch rows are near-free and the gap is the
+        # real story
+        vocab, e, heads, blocks, mlp = 32, 32, 2, 2, 64
+        t_max, n_slots, window, n_req = 128, 8, 8, 48
+        prompt_lens, budgets = (4, 12), (110, 116)
+    mesh = meshlib.seq_mesh(1)
+    model = attention_lm(vocab, t_max, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks, mesh=mesh)
+    params = model.init(jax.random.key(0)).params
+    kw = dict(embed_dim=e, num_heads=heads, num_blocks=blocks,
+              t_max=t_max, mesh=mesh, cache_dtype=jnp.bfloat16)
+
+    # probe a greedy stream for the token whose FIRST appearance is
+    # deepest: as the scenario's EOS it stops most requests mid-budget
+    gen = Generator(params, **kw)
+    probe = gen(jnp.asarray([[1, 2, 3]], jnp.int32),
+                min(t_max // 3, 256)).tolist()[0][3:]
+    first: dict[int, int] = {}
+    for i, t in enumerate(probe):
+        first.setdefault(t, i)
+    eos = max(first, key=first.get)
+
+    trace = poisson_trace(n_req, rate_per_s=1e9, vocab=vocab,
+                          t_max=t_max, prompt_lens=prompt_lens,
+                          budgets=budgets, seed=0, eos_id=eos)
+
+    def engine_pass():
+        server = LMServer(params, n_slots=n_slots, window=window,
+                          max_prefills_per_cycle=n_slots, eos_id=eos,
+                          **kw)
+        t0 = time.perf_counter()
+        results = server.run(trace)
+        useful = sum(len(r.tokens) for r in results)        # fence
+        assert useful
+        return time.perf_counter() - t0, useful, server.summary()
+
+    def serial_pass():
+        g = Generator(params, **kw)
+        t0 = time.perf_counter()
+        useful = 0
+        for _, req in trace:
+            out = g(jnp.asarray([req.prompt], jnp.int32),
+                    req.max_new_tokens)
+            stream = out.tolist()[0][len(req.prompt):]      # fence
+            useful += (stream.index(eos) + 1 if eos in stream
+                       else len(stream))
+        return time.perf_counter() - t0, useful
+
+    engine_pass()                                    # compile both paths
+    serial_pass()
+    eng, ser, ratios, summary = [], [], [], None
+    for _ in range(3):                               # interleaved pairs
+        dt_e, tok_e, summary = engine_pass()
+        dt_s, tok_s = serial_pass()
+        assert tok_e == tok_s, (tok_e, tok_s)        # same useful output
+        eng.append(tok_e / dt_e)
+        ser.append(tok_s / dt_s)
+        # the chip/host load drifts on the minutes scale (±10-40%
+        # observed); a PAIRED ratio cancels most of it, best-of pairs
+        # is the honest structural comparison (same discipline as
+        # _run_timed's best-of-4)
+        ratios.append((tok_e / dt_e) / (tok_s / dt_s))
+    return {
+        "serve_trace_requests": n_req,
+        "serve_slots": n_slots,
+        "serve_window": window,
+        "serve_eos_id": eos,
+        "serve_tokens": summary["serve_tokens"],
+        "serve_tokens_per_sec": round(max(eng), 1),
+        "serve_tokens_per_sec_windows": [round(x, 1) for x in eng],
+        "serve_ttft_ms_p50": summary["serve_ttft_ms_p50"],
+        "serve_ttft_ms_p95": summary["serve_ttft_ms_p95"],
+        "serve_slot_occupancy": summary["serve_slot_occupancy"],
+        "serial_tokens_per_sec": round(max(ser), 1),
+        "serve_speedup_vs_serial": round(max(ratios), 3),
+        "serve_speedup_windows": [round(r, 3) for r in ratios],
+    }
+
+
 def main() -> None:
     import jax
 
@@ -711,6 +823,7 @@ def main() -> None:
     ring.update(bench_flash_train(on_accelerator))
     ring.update(bench_attention_model_step(on_accelerator))
     ring.update(bench_lm_decode(on_accelerator))
+    ring.update(bench_serving(on_accelerator))
     if on_accelerator:
         # second headline sample, minutes after the first (the shared
         # chip's load drifts on that timescale; back-to-back windows

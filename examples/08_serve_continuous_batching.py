@@ -1,0 +1,102 @@
+"""Serve many users from one LM with the continuous-batching engine —
+the multi-user half of the serving story example 07 started.
+
+`python examples/08_serve_continuous_batching.py` runs on a virtual
+8-device CPU pod. A trained counting-task LM serves a burst of
+concurrent requests through `serve.LMServer`: fixed decode slots, one
+fused masked window per scheduler tick (every busy slot decodes one
+batch row; finished slots emit pad and append nothing), FIFO admission
+with backpressure, and slot recycling the moment a request hits its
+stop token or budget. Every request's output is bit-identical to a
+serial `Generator` call — batching changes the throughput, not the
+tokens.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from idc_models_tpu import mesh as meshlib
+
+meshlib.force_cpu_pod(8)          # delete this line on real TPU hardware
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu.models.lm import Generator, attention_lm, next_token_loss
+from idc_models_tpu.serve import LMServer, Request, poisson_trace
+from idc_models_tpu.train import (
+    TrainState, jit_data_parallel, make_train_step, replicate, rmsprop,
+    shard_batch,
+)
+
+VOCAB, SEQ = 11, 32
+mesh = meshlib.data_seq_mesh(4, 2)
+model = attention_lm(VOCAB, SEQ, embed_dim=32, num_heads=2, mlp_dim=64,
+                     num_blocks=2, mesh=mesh)
+
+# train succ() (next = tok + 1 mod VOCAB) exactly as in example 07
+opt = rmsprop(3e-3)
+variables = model.init(jax.random.key(0))
+state = TrainState(step=jnp.zeros((), jnp.int32), params=variables.params,
+                   model_state=variables.state,
+                   opt_state=opt.init(variables.params))
+step = jit_data_parallel(make_train_step(model, opt, next_token_loss),
+                         mesh, axis="data")
+state = replicate(mesh, state)
+rng, key = np.random.default_rng(1), jax.random.key(2)
+for i in range(150):
+    starts = rng.integers(0, VOCAB, (32, 1))
+    seqs = jnp.asarray((starts + np.arange(SEQ)) % VOCAB, jnp.int32)
+    bx = shard_batch(mesh, seqs, axis="data")
+    key, sub = jax.random.split(key)
+    state, m = step(state, bx, bx, sub)
+print(f"trained 150 steps: loss={float(m['loss']):.4f}")
+params = jax.device_get(state.params)
+
+# a server with 3 decode slots serving 8 concurrent requests: requests
+# queue FIFO, prefill into free slots, and decode TOGETHER in fused
+# masked windows; each slot recycles the moment its request finishes
+server = LMServer(params, embed_dim=32, num_heads=2, num_blocks=2,
+                  t_max=SEQ, n_slots=3, window=4,
+                  cache_dtype=jnp.float32)
+requests = [Request(id=f"user{i}", prompt=tuple((i + j) % VOCAB
+                                                for j in range(3)),
+                    max_new_tokens=6 + i % 4)
+            for i in range(8)]
+results = server.run([(0.0, r) for r in requests])
+assert all(r.status == "ok" for r in results)
+
+# every stream continues its counting prompt — and is bit-identical to
+# a serial Generator call with the same prompt
+gen = Generator(params, embed_dim=32, num_heads=2, num_blocks=2,
+                t_max=SEQ, cache_dtype=jnp.float32)
+for r in requests:
+    got = server.poll(r.id)
+    want = [(r.prompt[-1] + 1 + j) % VOCAB
+            for j in range(r.max_new_tokens)]
+    assert got.tokens == want, (r.id, got.tokens, want)
+    serial = gen(jnp.asarray([r.prompt], jnp.int32),
+                 r.max_new_tokens).tolist()[0][len(r.prompt):]
+    assert got.tokens == serial
+print(f"served {len(results)} concurrent users on 3 slots, every stream "
+      f"= its serial generation, bit for bit")
+
+s = server.summary()
+print(f"throughput {s['serve_tokens_per_sec']} tok/s, "
+      f"TTFT p50 {s['serve_ttft_ms_p50']} ms, "
+      f"slot occupancy {s['serve_slot_occupancy']}")
+
+# a Poisson arrival trace (the standard serving-benchmark workload)
+# through a fresh server — zero recompilation: the programs were
+# compiled once above and live in a process-wide cache
+server2 = LMServer(params, embed_dim=32, num_heads=2, num_blocks=2,
+                   t_max=SEQ, n_slots=3, window=4,
+                   cache_dtype=jnp.float32)
+sizes = server2.engine.cache_sizes()
+trace = poisson_trace(6, rate_per_s=200.0, vocab=VOCAB, t_max=SEQ, seed=7)
+server2.run(trace, realtime=True)
+assert server2.engine.cache_sizes() == sizes
+print("Poisson trace served with zero new compilations:", sizes)

@@ -35,6 +35,7 @@ def main(argv: list[str] | None = None) -> int:
     runner = {"vgg": _run_dist, "mobile": _run_dist, "dense": _run_dist,
               "fed": _run_fed, "secure_fed": _run_secure,
               "attention": _run_attention, "lm": _run_lm,
+              "serve": _run_serve,
               "convert_weights": _run_convert}[ns.preset_key]
     runner(ns)
     return 0
@@ -216,6 +217,57 @@ def _parse(argv):
                     help="restrict sampling to the k most likely "
                          "tokens (0 = no restriction; needs "
                          "--temperature > 0)")
+
+    sp = sub.add_parser("serve",
+                        help="continuous-batching LM serving engine: "
+                             "fixed decode slots, masked fused windows, "
+                             "FIFO admission with backpressure "
+                             "(serve/, beyond-reference)")
+    sp.add_argument("--path", default=None,
+                    help="artifact root (serving events stream to "
+                         "<path>/logs/serve.jsonl)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--host-devices", type=int, default=0,
+                    help="force N virtual CPU devices (TPU stand-in)")
+    sp.add_argument("--vocab", type=int, default=16)
+    sp.add_argument("--t-max", type=int, default=64,
+                    help="cache capacity per slot (prompt + generation)")
+    sp.add_argument("--embed-dim", type=int, default=32)
+    sp.add_argument("--num-heads", type=int, default=2)
+    sp.add_argument("--mlp-dim", type=int, default=64)
+    sp.add_argument("--num-blocks", type=int, default=2)
+    sp.add_argument("--seq-parallel", type=int, default=1,
+                    help="ring size over the 'seq' mesh axis for the "
+                         "serving mesh (caches shard over it)")
+    sp.add_argument("--train-steps", type=int, default=0,
+                    help="train the counting task this many steps "
+                         "before serving (0 = serve from random init; "
+                         "the engine exercises identically either way)")
+    sp.add_argument("--slots", type=int, default=4,
+                    help="concurrent decode slots")
+    sp.add_argument("--window", type=int, default=8,
+                    help="tokens per fused decode dispatch")
+    sp.add_argument("--requests", type=int, default=16,
+                    help="synthetic trace length (ignored with --trace)")
+    sp.add_argument("--rate", type=float, default=50.0,
+                    help="synthetic Poisson arrival rate, requests/s")
+    sp.add_argument("--trace", default=None,
+                    help="JSONL request trace to replay instead of the "
+                         "synthetic Poisson one (serve.load_trace "
+                         "format)")
+    sp.add_argument("--realtime", action="store_true",
+                    help="honor trace arrival times on the wall clock "
+                         "(default: replay as fast as the engine "
+                         "drains, order kept)")
+    sp.add_argument("--temperature", type=float, default=0.0)
+    sp.add_argument("--top-k", type=int, default=0)
+    sp.add_argument("--eos", type=int, default=None,
+                    help="stop token id (default: none — requests run "
+                         "to their token budget)")
+    sp.add_argument("--max-queue-depth", type=int, default=64,
+                    help="admission-queue backpressure bound")
+    sp.add_argument("--max-prefills-per-cycle", type=int, default=1,
+                    help="prefill-vs-decode interleave cap per cycle")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -726,6 +778,98 @@ def _run_lm(ns):
             logger.log(event="generate", tokens=toks, matches=ok,
                        generate_ms_per_token=dt * 1e3 / n_gen)
     if logger:
+        logger.close()
+
+
+def _run_serve(ns):
+    """Beyond-reference workload: the continuous-batching serving
+    engine (serve/) over an `attention_lm` parameter tree — fixed decode
+    slots, masked fused windows, FIFO admission with backpressure —
+    replaying a request trace (JSONL or synthetic Poisson arrivals) and
+    reporting throughput/TTFT/occupancy (docs/LONG_CONTEXT.md)."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import attention_lm, next_token_loss
+    from idc_models_tpu.observe import JsonlLogger, Timer
+    from idc_models_tpu.serve import LMServer, load_trace, poisson_trace
+
+    n_dev = len(jax.devices())
+    if ns.seq_parallel < 1 or n_dev < ns.seq_parallel:
+        sys.exit(f"--seq-parallel {ns.seq_parallel} needs at least that "
+                 f"many devices ({n_dev} available)")
+    if ns.t_max % ns.seq_parallel:
+        sys.exit(f"--t-max {ns.t_max} must divide by --seq-parallel "
+                 f"{ns.seq_parallel}")
+    if ns.temperature < 0.0:
+        sys.exit(f"--temperature {ns.temperature} must be >= 0")
+    mesh = meshlib.seq_mesh(ns.seq_parallel)
+    # the model trains through the SAME ring the serving mesh uses —
+    # omitting mesh here would silently train single-device full
+    # attention ([B, H, t_max, t_max] scores) at exactly the sizes
+    # --seq-parallel exists for
+    model = attention_lm(ns.vocab, ns.t_max, embed_dim=ns.embed_dim,
+                         num_heads=ns.num_heads, mlp_dim=ns.mlp_dim,
+                         num_blocks=ns.num_blocks,
+                         mesh=mesh if ns.seq_parallel > 1 else None)
+    params = model.init(jax.random.key(ns.seed)).params
+    if ns.train_steps > 0:
+        from idc_models_tpu.train import (
+            TrainState, make_train_step, rmsprop,
+        )
+
+        opt = rmsprop(3e-3)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           model_state={}, opt_state=opt.init(params))
+        step = jax.jit(make_train_step(model, opt, next_token_loss))
+        rng = np.random.default_rng(ns.seed + 1)
+        key = jax.random.key(ns.seed + 2)
+        with Timer("Serve pre-training"):
+            for _ in range(ns.train_steps):
+                starts = rng.integers(0, ns.vocab, (16, 1))
+                seqs = jnp.asarray(
+                    (starts + np.arange(ns.t_max)) % ns.vocab, jnp.int32)
+                key, sub = jax.random.split(key)
+                state, m = step(state, seqs, seqs, sub)
+            print(f"pre-trained {ns.train_steps} steps, "
+                  f"loss={float(m['loss']):.4f}")
+        params = jax.device_get(state.params)
+
+    logger = (JsonlLogger(Path(ns.path) / "logs" / "serve.jsonl")
+              if ns.path else None)
+    server = LMServer(
+        params, embed_dim=ns.embed_dim, num_heads=ns.num_heads,
+        num_blocks=ns.num_blocks, t_max=ns.t_max, n_slots=ns.slots,
+        window=ns.window, mesh=mesh, cache_dtype=jnp.float32,
+        temperature=ns.temperature, top_k=ns.top_k or None,
+        eos_id=ns.eos, max_queue_depth=ns.max_queue_depth,
+        max_prefills_per_cycle=ns.max_prefills_per_cycle, logger=logger)
+    if ns.trace:
+        trace = load_trace(ns.trace)
+    else:
+        trace = poisson_trace(
+            ns.requests, rate_per_s=ns.rate, vocab=ns.vocab,
+            t_max=ns.t_max, eos_id=ns.eos,
+            prompt_lens=(2, max(ns.t_max // 4, 2)),
+            budgets=(2, max(ns.t_max // 4, 2)), seed=ns.seed,
+            sampled=ns.temperature > 0.0)
+    print(f"serving {len(trace)} requests on {ns.slots} slots "
+          f"(window {ns.window}, t_max {ns.t_max}, ring "
+          f"{ns.seq_parallel})")
+    with Timer("Serving trace", logger=logger):
+        results = server.run(trace, realtime=ns.realtime)
+    n_ok = sum(r.status == "ok" for r in results)
+    summary = server.summary()
+    print(f"served: ok={n_ok} timeout={summary['serve_timed_out']} "
+          f"rejected={summary['serve_rejected']} "
+          f"tokens={summary['serve_tokens']}")
+    print("serve summary:", json.dumps(summary))
+    if logger:
+        logger.log(event="serve_summary", **summary)
         logger.close()
 
 

@@ -36,6 +36,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh
 
@@ -210,6 +211,102 @@ def _check_prompt(tokens, t_max: int):
     return tokens
 
 
+def prefill_bucket(p_len: int, t_max: int, n_ring: int) -> int:
+    """The padded prompt length the prefill program actually runs at:
+    the smallest `n_ring * 2**k` >= p_len, capped at t_max.
+
+    Prompt length is a jit SHAPE key — an engine admitting arbitrary
+    user prompt lengths would otherwise compile a fresh prefill per
+    length. Bucketing maps every length onto O(log(t_max)) compiled
+    shapes, and because the true length rides through the program as a
+    TRACED scalar (see `_serving_fns`), two prompts in the same bucket
+    share one executable bit-for-bit."""
+    if not 1 <= p_len <= t_max:
+        raise ValueError(f"prompt length {p_len} outside [1, {t_max}]")
+    b = n_ring
+    while b < p_len:
+        b *= 2
+    return min(b, t_max)
+
+
+def prefill_buckets(t_max: int, n_ring: int) -> tuple[int, ...]:
+    """Every bucket `prefill_bucket` can return — the complete compile
+    set a serving engine warms up (O(log(t_max / n_ring)) shapes)."""
+    out, b = [], n_ring
+    while b < t_max:
+        out.append(b)
+        b *= 2
+    out.append(t_max)
+    return tuple(out)
+
+
+def _pad_prompt(tokens, t_max: int, n_ring: int):
+    """[B, P] -> ([B, bucket] zero-padded, true length P). Pad tokens
+    embed position >= P but are masked out of the cache and, causally,
+    cannot influence any real position's logits."""
+    p_len = tokens.shape[1]
+    bucket = prefill_bucket(p_len, t_max, n_ring)
+    if bucket != p_len:
+        tokens = jnp.pad(tokens, ((0, 0), (0, bucket - p_len)))
+    return tokens, p_len
+
+
+def _make_pick(cfg: _ServeConfig):
+    """The sampling rule for one decode config: greedy argmax at
+    temperature 0, else temperature softmax optionally restricted to the
+    top_k most likely tokens. Module-level so the serving ENGINE
+    (serve/engine.py) applies the exact same math per slot — bit parity
+    with a serial `Generator` hinges on sharing this definition."""
+    def pick(logits, key):
+        lg = logits.astype(jnp.float32)
+        if cfg.top_k is not None and cfg.top_k < lg.shape[-1]:
+            kth = jax.lax.top_k(lg, cfg.top_k)[0][:, -1]
+            lg = jnp.where(lg >= kth[:, None], lg, -jnp.inf)
+        if cfg.temperature == 0.0:
+            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, lg / cfg.temperature,
+                                      axis=-1).astype(jnp.int32)
+
+    return pick
+
+
+def _token_forward(cfg: _ServeConfig, ln, params, caches, tok, pos, fold):
+    """One token per row through every block — the single definition of
+    the decode-time forward: embed (+position), then per block
+    [pre-LN -> q/k/v projection of THIS token -> cache fold ->
+    out-projection residual -> pre-LN MLP residual], final LN, vocab
+    head. `pos` may be a scalar (serial decode: every row at the same
+    position) or an int32 [B] vector (the serving engine's per-slot
+    positions) — the position-table gather broadcasts either way.
+    `fold(block_idx, kc, vc, q, k, v) -> (o, kc, vc)` supplies the
+    cache fold, so the serial scalar-pos path and the engine's masked
+    per-row path share every other op bit-for-bit."""
+    b = tok.shape[0]
+    head_dim = cfg.embed_dim // cfg.num_heads
+    h = (jnp.take(params["embed"], tok, axis=0)
+         + params["pos"][pos])                          # [B, E]
+    new_caches = []
+    for i in range(cfg.num_blocks):
+        p = params[f"block{i}"]
+        kc, vc = caches[i]
+        a, _ = ln.apply(p["ln1"], {}, h)
+        split = lambda y: y.reshape(b, 1, cfg.num_heads, head_dim)
+        q = split(a @ p["mha"]["wq"].astype(a.dtype))
+        k = split(a @ p["mha"]["wk"].astype(a.dtype))
+        v = split(a @ p["mha"]["wv"].astype(a.dtype))
+        o, kc, vc = fold(i, kc, vc, q, k, v)
+        o = o.reshape(b, cfg.embed_dim)
+        h = h + (o @ p["mha"]["wo"].astype(o.dtype)
+                 + p["mha"]["bo"].astype(o.dtype))
+        a, _ = ln.apply(p["ln2"], {}, h)
+        m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
+        h = h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"])
+        new_caches.append((kc, vc))
+    h, _ = ln.apply(params["ln_f"], {}, h)
+    logits = h @ params["head"]["kernel"] + params["head"]["bias"]
+    return logits, tuple(new_caches)
+
+
 @functools.lru_cache(maxsize=16)
 def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
     """The compile-once serving programs for one decode configuration.
@@ -240,29 +337,9 @@ def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
                      for _ in range(cfg.num_blocks))
 
     def step_body(params, caches, tok, pos):
-        b = tok.shape[0]
-        h = (jnp.take(params["embed"], tok, axis=0)
-             + params["pos"][pos])                      # [B, E]
-        new_caches = []
-        for i in range(cfg.num_blocks):
-            p = params[f"block{i}"]
-            kc, vc = caches[i]
-            a, _ = ln.apply(p["ln1"], {}, h)
-            split = lambda y: y.reshape(b, 1, cfg.num_heads, head_dim)
-            q = split(a @ p["mha"]["wq"].astype(a.dtype))
-            k = split(a @ p["mha"]["wk"].astype(a.dtype))
-            v = split(a @ p["mha"]["wv"].astype(a.dtype))
-            o, kc, vc = decode(kc, vc, q, k, v, pos)
-            o = o.reshape(b, cfg.embed_dim)
-            h = h + (o @ p["mha"]["wo"].astype(o.dtype)
-                     + p["mha"]["bo"].astype(o.dtype))
-            a, _ = ln.apply(p["ln2"], {}, h)
-            m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
-            h = h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"])
-            new_caches.append((kc, vc))
-        h, _ = ln.apply(params["ln_f"], {}, h)
-        logits = h @ params["head"]["kernel"] + params["head"]["bias"]
-        return logits, tuple(new_caches)
+        return _token_forward(
+            cfg, ln, params, caches, tok, pos,
+            lambda _i, kc, vc, q, k, v: decode(kc, vc, q, k, v, pos))
 
     # one dispatch per token for callers driving single steps: without
     # this, every token pays ~15 eager host-side op dispatches per
@@ -272,19 +349,20 @@ def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
     # returned ones).
     step = jax.jit(step_body, donate_argnums=(1,))
 
-    def prefill_body(params, tokens):
+    def prefill_body(params, tokens, p_len):
         # the prompt runs through the SAME ring the model trained with:
         # per device a [P/n, P/n]-tiled causal fold instead of a
         # replicated [B, H, P, P] score tensor — prefill keeps the
-        # O(T/n) property the ring cache exists for. Prompts that do
-        # not divide the ring are end-padded to the next multiple
-        # (causal: pad positions cannot influence real ones) and the
-        # pad K/V is dropped before the cache is built.
-        b, p_len = tokens.shape
-        pad = -p_len % n_ring
-        p_pad = p_len + pad
-        toks = jnp.pad(tokens, ((0, 0), (0, pad)))
-        h = (jnp.take(params["embed"], toks, axis=0)
+        # O(T/n) property the ring cache exists for. `tokens` arrives
+        # padded to a prefill BUCKET (`prefill_bucket`: n_ring * 2**k,
+        # capped at t_max) and `p_len` — the TRUE prompt length — is a
+        # traced scalar, so every prompt length in a bucket runs the
+        # same executable: prompt length stops being a compile key.
+        # Causality makes the padding exact (pad positions cannot
+        # influence real ones) and the pad K/V is masked out of the
+        # cache below.
+        b, p_pad = tokens.shape
+        h = (jnp.take(params["embed"], tokens, axis=0)
              + params["pos"][:p_pad])                    # [B, P', E]
         h = pin(h)
         kvs = []
@@ -304,28 +382,27 @@ def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
             m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
             h = pin(h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"]))
             kvs.append((k, v))
-        h, _ = ln.apply(params["ln_f"], {}, h[:, p_len - 1])
-        logits = h @ params["head"]["kernel"] + params["head"]["bias"]
+        # last REAL position's activations — p_len is traced, so this is
+        # a dynamic gather, not a static index
+        h_last = lax.dynamic_slice_in_dim(h, p_len - 1, 1, axis=1)[:, 0]
+        h_last, _ = ln.apply(params["ln_f"], {}, h_last)
+        logits = (h_last @ params["head"]["kernel"]
+                  + params["head"]["bias"])
         sh = cache_sharding(mesh)
+        keep = (jnp.arange(p_pad) < p_len)[None, :, None, None]
 
         def to_cache(x):                 # K/V -> fresh ring cache slot
-            x = x[:, :p_len].astype(cfg.cache_dtype)
-            x = jnp.pad(x, ((0, 0), (0, t_max - p_len), (0, 0), (0, 0)))
+            # zero pad positions (traced mask): decode's visibility
+            # masking relies on slots past the prompt staying zero
+            x = jnp.where(keep, x, 0).astype(cfg.cache_dtype)
+            x = jnp.pad(x, ((0, 0), (0, t_max - p_pad), (0, 0), (0, 0)))
             return lax.with_sharding_constraint(x, sh)
 
         return logits, tuple((to_cache(k), to_cache(v)) for k, v in kvs)
 
     prefill = jax.jit(prefill_body)
 
-    def pick(logits, key):
-        lg = logits.astype(jnp.float32)
-        if cfg.top_k is not None and cfg.top_k < lg.shape[-1]:
-            kth = jax.lax.top_k(lg, cfg.top_k)[0][:, -1]
-            lg = jnp.where(lg >= kth[:, None], lg, -jnp.inf)
-        if cfg.temperature == 0.0:
-            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, lg / cfg.temperature,
-                                      axis=-1).astype(jnp.int32)
+    pick = _make_pick(cfg)
 
     def decode_body(params, caches, logits, rng, offsets):
         # the WHOLE decode of len(offsets) tokens is one device
@@ -391,11 +468,15 @@ def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
     fns = _serving_fns(cfg)
     params = _place_params(params, cfg.mesh)
 
+    n_ring = cfg.mesh.shape[meshlib.SEQ_AXIS]
+
     def step(caches, tok, pos):
         return fns.step(params, caches, tok, pos)
 
     def prefill_tokens(tokens):
-        return fns.prefill(params, _check_prompt(tokens, t_max))
+        padded, p_len = _pad_prompt(_check_prompt(tokens, t_max),
+                                    t_max, n_ring)
+        return fns.prefill(params, padded, np.int32(p_len))
 
     return fns.init_caches, step, prefill_tokens
 
@@ -445,9 +526,13 @@ class Generator:
 
     def prefill(self, prompt):
         """Prompt [B, P] -> (last-position logits [B, vocab], caches),
-        one ring-sharded pass (O(P/n) per device)."""
-        return self._fns.prefill(self._params,
-                                 _check_prompt(prompt, self.t_max))
+        one ring-sharded pass (O(P/n) per device). Prompts are padded
+        to a prefill bucket (`prefill_bucket`) with the true length
+        traced, so distinct prompt lengths share compiled programs."""
+        n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
+        padded, p_len = _pad_prompt(_check_prompt(prompt, self.t_max),
+                                    self.t_max, n_ring)
+        return self._fns.prefill(self._params, padded, np.int32(p_len))
 
     def decode(self, caches, logits, pos0: int, steps: int, *, rng=None):
         """Emit `steps` tokens in ONE dispatch from (caches, logits) at

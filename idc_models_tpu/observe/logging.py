@@ -34,6 +34,14 @@ class JsonlLogger:
         self._f.write(json.dumps(rec) + "\n")
 
     def close(self) -> None:
+        """Flush + fsync before closing: a run log that dies with the
+        process (OOM, preemption) must still hold every record already
+        logged — line buffering alone leaves the last page in the OS
+        cache."""
+        if self._f.closed:
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
         self._f.close()
 
     def __enter__(self) -> "JsonlLogger":
@@ -43,12 +51,34 @@ class JsonlLogger:
         self.close()
 
 
+# arrays above this many elements are summarized, not inlined: a logger
+# fed a whole activation/batch by accident must not write megabyte lines
+# (or hang serializing them) into an append-only run log
+_MAX_INLINE_ELEMENTS = 1024
+
+
 def _jsonable(v):
     if hasattr(v, "item") and getattr(v, "ndim", None) in (0, None):
         try:
             return v.item()
         except Exception:
             pass
+    if hasattr(v, "ndim") and getattr(v, "ndim", 0) > 0:
+        # numpy/jax arrays: json.dumps would otherwise raise mid-run
+        # (losing the record AND crashing the caller's loop). Size-check
+        # from the SHAPE before any materialization — summarizing an
+        # oversized device array must not fetch it to host first.
+        try:
+            import math
+
+            import numpy as _np
+
+            if math.prod(v.shape) > _MAX_INLINE_ELEMENTS:
+                return {"__array__": True, "shape": list(v.shape),
+                        "dtype": str(v.dtype)}
+            return _np.asarray(v).tolist()
+        except Exception:
+            return repr(v)
     if isinstance(v, dict):
         return {k: _jsonable(x) for k, x in v.items()}
     if isinstance(v, (list, tuple)):

@@ -202,6 +202,117 @@ def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     return fn
 
 
+def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
+                             scale: float | None = None,
+                             jit: bool = False):
+    """Per-slot decode fold for the continuous-batching engine
+    (serve/engine.py): ``fn(k_cache, v_cache, q_t, k_t, v_t, pos, live)
+    -> (out_t, k_cache, v_cache)`` where every batch row is an
+    INDEPENDENT sequence at its OWN position.
+
+    `pos` is int32 [B] (row b's new token sits at global position
+    pos[b]) and `live` is bool [B]: rows with live=False append NOTHING
+    — their cache shard is bit-untouched, which is what lets a finished
+    serving slot idle through decode windows without corrupting the
+    cache a recycled request will overwrite. The attend/merge algebra is
+    the scalar `make_ring_decode` fold applied row-wise (same einsums,
+    same masking, same two-collective softmax merge), so a live row's
+    output is bit-identical to the scalar path at the same position.
+
+    Rows where live=False may carry pos == t_max (one past the end, the
+    natural "finished" frontier); positions are clamped internally for
+    the attend and the masked append never fires for them. Defaults to
+    ``jit=False`` because the intended caller is the engine's fused
+    decode window, whose top-level jit owns donation."""
+    n = mesh.shape[axis]
+
+    def per_device(kc, vc, q, kt, vt, pos, live):
+        b, t_shard, h, d = kc.shape
+        i = collectives.axis_index(axis)
+        scale_ = scale if scale is not None else d ** -0.5
+        pos = jnp.asarray(pos, jnp.int32)
+        live = jnp.asarray(live, jnp.bool_)
+        # finished rows legitimately sit at pos == t_max; clamp so the
+        # owner/slot arithmetic and visibility mask stay in range (the
+        # append is gated on `live`, never on the clamp)
+        posc = jnp.clip(pos, 0, n * t_shard - 1)
+        owner = posc // t_shard
+        slot = posc % t_shard
+        mine = (owner == i) & live
+
+        # per-row O(1) append: each row reads its ONE slot and writes the
+        # new token back only when this shard owns the row's position AND
+        # the row is live — a dead row's shard is bit-untouched
+        def row_append(c, t, s, m):
+            old = lax.dynamic_slice(c, (s, 0, 0), t.shape)
+            return lax.dynamic_update_slice(
+                c, jnp.where(m, t.astype(c.dtype), old), (s, 0, 0))
+
+        kc = jax.vmap(row_append)(kc, kt, slot, mine)
+        vc = jax.vmap(row_append)(vc, vt, slot, mine)
+        # row-wise local attend + the same stable merge as the scalar
+        # fold (see make_ring_decode); visibility is per ROW now
+        s = jnp.einsum("bhd,bkhd->bhk", q[:, 0], kc,
+                       preferred_element_type=jnp.float32) * scale_
+        visible = ((i * t_shard + jnp.arange(t_shard))[None, :]
+                   <= posc[:, None])                       # [B, t_shard]
+        s = jnp.where(visible[:, None, :], s, _MASKED)
+        m_loc = jnp.max(s, axis=-1)                        # [B, H]
+        p = jnp.exp(s - m_loc[..., None])
+        p = jnp.where(visible[:, None, :], p, 0.0)
+        l_loc = jnp.sum(p, axis=-1)
+        acc_loc = jnp.einsum("bhk,bkhd->bhd", p, vc,
+                             preferred_element_type=jnp.float32)
+        m_glob = lax.pmax(m_loc, axis)
+        corr = jnp.exp(m_loc - m_glob)
+        l_glob = collectives.psum(l_loc * corr, axis)
+        acc_glob = collectives.psum(acc_loc * corr[..., None], axis)
+        out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]
+        return out[:, None].astype(q.dtype), kc, vc
+
+    others = tuple(a for a in mesh.axis_names if a != axis)
+    bo = others if others else None
+    cache_spec = P(bo, axis, None, None)
+    tok_spec = P(bo, None, None, None)
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(cache_spec, cache_spec, tok_spec, tok_spec, tok_spec,
+                  P(), P()),
+        out_specs=(tok_spec, cache_spec, cache_spec),
+        check_vma=False,
+    )
+
+    def checked(kc, vc, q_t, k_t, v_t, pos, live):
+        if q_t.shape[1] != 1:
+            raise ValueError(
+                f"batched ring decode takes ONE token per row per step: "
+                f"q_t has sequence length {q_t.shape[1]}")
+        if kc.shape[1] % n:
+            raise ValueError(
+                f"cache length {kc.shape[1]} not divisible by the ring "
+                f"size {n} over mesh axis {axis!r}")
+        if jnp.shape(pos) != (kc.shape[0],):
+            raise ValueError(
+                f"pos must be one position per row, shape "
+                f"({kc.shape[0]},); got {jnp.shape(pos)}")
+        # reject concrete out-of-range LIVE positions, same contract as
+        # the scalar path (a silently dropped append is the failure mode)
+        if (isinstance(pos, (np.ndarray, list, tuple))
+                and isinstance(live, (np.ndarray, list, tuple))):
+            p_arr = np.asarray(pos)
+            bad = p_arr[(np.asarray(live)) & ((p_arr < 0)
+                                              | (p_arr >= kc.shape[1]))]
+            if bad.size:
+                raise ValueError(
+                    f"live pos {bad.tolist()} outside the cache "
+                    f"(t_max {kc.shape[1]})")
+        return mapped(kc, vc, q_t, k_t, v_t, pos, live)
+
+    if not jit:
+        return checked
+    return jax.jit(checked, donate_argnums=(0, 1))
+
+
 def prefill(mesh: Mesh, k_prompt, v_prompt, t_max: int, *,
             axis: str = meshlib.SEQ_AXIS, dtype=jnp.bfloat16):
     """Place a prompt's [B, P, H, D] K/V directly into a fresh ring

@@ -1,0 +1,8 @@
+from idc_models_tpu.serve.api import (  # noqa: F401
+    LMServer, Request, Result, load_trace, poisson_trace, save_trace,
+)
+from idc_models_tpu.serve.engine import SlotEngine  # noqa: F401
+from idc_models_tpu.serve.metrics import ServingMetrics  # noqa: F401
+from idc_models_tpu.serve.scheduler import (  # noqa: F401
+    AdmissionQueue, Scheduler,
+)

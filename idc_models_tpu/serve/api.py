@@ -1,0 +1,278 @@
+"""User-facing serving surface: `Request`/`Result`, the synchronous
+`submit()`/`poll()` API, and `run(trace)` trace replay.
+
+`LMServer` composes the three serving layers — `SlotEngine` (device
+state machine), `Scheduler` (admission queue, deadlines, interleave,
+recycling), `ServingMetrics` (TTFT/throughput/occupancy) — behind the
+smallest API that exercises them end to end:
+
+    server = LMServer(params, embed_dim=..., num_heads=...,
+                      num_blocks=..., t_max=..., n_slots=4, window=8)
+    server.submit(Request(id="a", prompt=(1, 2, 3), max_new_tokens=16))
+    while server.poll("a") is None:
+        server.step()                  # one scheduler tick
+    print(server.poll("a").tokens)
+
+Traces replay real arrival processes without a network frontend:
+`poisson_trace` synthesizes open-loop Poisson arrivals (the standard
+serving-benchmark arrival model) and `load_trace`/`save_trace` move the
+same `(arrival_s, Request)` list through a JSONL file, one request per
+line. `run(trace)` replays either kind — by wall clock (`realtime=True`,
+the honest TTFT measurement) or as a burst (deterministic tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request. `seed` derives the request's PRIVATE
+    sampling stream (identical to passing `jax.random.key(seed)` to a
+    serial `Generator` call — token parity is per-request, not
+    per-batch); `deadline_s` is seconds from submit after which the
+    request is dropped (queued) or cancelled mid-generation (running);
+    `eos_id` overrides the server default stop token (None = server's,
+    -1 = never stop early)."""
+    id: str
+    prompt: tuple
+    max_new_tokens: int
+    eos_id: int | None = None
+    seed: int | None = None
+    deadline_s: float | None = None
+
+
+@dataclasses.dataclass
+class Result:
+    """What came back: `tokens` are the GENERATED ids only (prompt not
+    echoed), truncated at EOS (inclusive) when one is configured.
+    `status` is "ok" (ran to EOS/budget), "timeout" (deadline hit —
+    possibly with partial tokens), or "rejected" (queue full at submit
+    with on_full="reject")."""
+    id: str
+    tokens: list
+    status: str
+    finish_reason: str | None = None
+    ttft_ms: float | None = None
+    latency_ms: float | None = None
+
+
+class LMServer:
+    """Continuous-batching server over one `attention_lm` parameter
+    tree. Construction compiles (or reuses from the process-wide cache)
+    every program the serve loop touches when `warmup=True`, so the
+    first request pays no XLA latency and later requests of ANY prompt
+    length/budget compile nothing (gated by test)."""
+
+    def __init__(self, params, *, embed_dim: int, num_heads: int,
+                 num_blocks: int, t_max: int, n_slots: int = 4,
+                 window: int = 8, mesh=None, cache_dtype=None,
+                 block_impl: str = "jnp", temperature: float = 0.0,
+                 top_k: int | None = None, pad_id: int = 0,
+                 eos_id: int | None = None, max_queue_depth: int = 64,
+                 max_prefills_per_cycle: int = 1,
+                 admit_after_collect: bool = True, logger=None,
+                 warmup: bool = True, clock=time.monotonic):
+        import jax.numpy as jnp
+
+        from idc_models_tpu.serve.engine import SlotEngine
+        from idc_models_tpu.serve.metrics import ServingMetrics
+        from idc_models_tpu.serve.scheduler import Scheduler
+
+        self.engine = SlotEngine(
+            params, embed_dim=embed_dim, num_heads=num_heads,
+            num_blocks=num_blocks, t_max=t_max, n_slots=n_slots,
+            mesh=mesh,
+            cache_dtype=(jnp.bfloat16 if cache_dtype is None
+                         else cache_dtype),
+            block_impl=block_impl, temperature=temperature, top_k=top_k,
+            pad_id=pad_id, eos_id=eos_id)
+        self.metrics = ServingMetrics(logger)
+        self.scheduler = Scheduler(
+            self.engine, window=window, max_queue_depth=max_queue_depth,
+            max_prefills_per_cycle=max_prefills_per_cycle,
+            admit_after_collect=admit_after_collect,
+            metrics=self.metrics, clock=clock)
+        self._results: dict[str, Result] = {}
+        self._inflight: set[str] = set()
+        if warmup:
+            self.engine.warmup(window)
+
+    # -- synchronous API -------------------------------------------------
+
+    def submit(self, request: Request) -> bool:
+        """Enqueue a request. False = backpressure (queue at max depth);
+        raises ValueError for requests that could never be served."""
+        from idc_models_tpu.serve.scheduler import Entry
+
+        if request.id in self._results or request.id in self._inflight:
+            # includes QUEUED/RUNNING ids: a duplicate in flight would
+            # silently overwrite the other's Result at finish
+            raise ValueError(f"request id {request.id!r} already used")
+        entry = Entry(
+            rid=request.id,
+            prompt=np.asarray(request.prompt, np.int32),
+            budget=int(request.max_new_tokens),
+            eos_id=request.eos_id,
+            # integer seeds ride through as-is: the engine derives the
+            # key data on the host (identical to jax.random.key(seed))
+            rng=request.seed,
+            deadline=request.deadline_s)
+        ok = self.scheduler.submit(entry)
+        if not ok:
+            # leave no Result: the caller may retry the same id later
+            return False
+        self._inflight.add(request.id)
+        return True
+
+    def step(self) -> list[Result]:
+        """One scheduler tick (admissions + one fused decode window);
+        returns the requests that finished on it."""
+        finished = []
+        for e in self.scheduler.tick():
+            r = _to_result(e)
+            self._results[r.id] = r
+            self._inflight.discard(r.id)
+            finished.append(r)
+        return finished
+
+    def poll(self, rid: str) -> Result | None:
+        """The finished Result for `rid`, or None while it is still
+        queued/running."""
+        return self._results.get(rid)
+
+    def drain(self) -> list[Result]:
+        """Tick until idle; returns everything that finished."""
+        out = []
+        while not self.scheduler.idle():
+            out.extend(self.step())
+        return out
+
+    # -- trace replay ----------------------------------------------------
+
+    def run(self, trace, *, realtime: bool = False,
+            on_full: str = "block") -> list[Result]:
+        """Replay `[(arrival_s, Request), ...]` and drain. With
+        `realtime=True` requests are held until their arrival offset on
+        the wall clock (the honest open-loop TTFT measurement); with
+        False the trace is replayed as fast as the engine drains it —
+        arrival ORDER kept, deterministic for tests. `on_full` is the
+        client-side backpressure policy: "block" re-offers the head
+        request every tick until the queue accepts it; "reject" records
+        a rejected Result and moves on."""
+        if on_full not in ("block", "reject"):
+            raise ValueError(f"on_full must be 'block' or 'reject', "
+                             f"got {on_full!r}")
+        trace = sorted(trace, key=lambda tr: tr[0])
+        clock = self.scheduler.clock
+        t0 = clock()
+        out, i = [], 0
+        while i < len(trace) or not self.scheduler.idle():
+            now = clock() - t0
+            while i < len(trace) and (not realtime
+                                      or trace[i][0] <= now):
+                # in block mode, don't OFFER a request the queue cannot
+                # take: every refused submit() counts as a rejection in
+                # the metrics, and a head request re-offered for 50
+                # ticks is one blocked request, not 50 rejected ones
+                if (on_full == "block"
+                        and len(self.scheduler.queue)
+                        >= self.scheduler.queue.max_depth):
+                    break               # blocked: re-offer next tick
+                if self.submit(trace[i][1]):
+                    i += 1
+                elif on_full == "reject":
+                    r = Result(id=trace[i][1].id, tokens=[],
+                               status="rejected")
+                    self._results[r.id] = r
+                    out.append(r)
+                    i += 1
+                else:
+                    break               # blocked: re-offer next tick
+            if (realtime and self.scheduler.idle() and i < len(trace)):
+                # nothing running and the next arrival is in the future
+                time.sleep(min(max(trace[i][0] - (clock() - t0), 0.0),
+                               0.005))
+                continue
+            out.extend(self.step())
+        return out
+
+    def summary(self) -> dict:
+        return self.metrics.summary()
+
+
+def _to_result(e) -> Result:
+    return Result(
+        id=e.rid, tokens=list(e.tokens), status=e.status,
+        finish_reason=e.finish_reason,
+        ttft_ms=(None if e.t_first is None
+                 else (e.t_first - e.t_submit) * 1e3),
+        latency_ms=(None if e.t_done is None
+                    else (e.t_done - e.t_submit) * 1e3))
+
+
+# -- traces ---------------------------------------------------------------
+
+
+def poisson_trace(n_requests: int, *, rate_per_s: float, vocab: int,
+                  t_max: int, prompt_lens=(4, 16), budgets=(4, 16),
+                  eos_id: int | None = None,
+                  deadline_s: float | None = None, seed: int = 0,
+                  sampled: bool = False):
+    """Synthetic open-loop arrivals: exponential inter-arrival times at
+    `rate_per_s`, prompt lengths and budgets uniform over the given
+    inclusive ranges (clamped so prompt + budget <= t_max). With
+    `sampled=True` each request carries its own seed (for temperature>0
+    servers). Returns `[(arrival_s, Request), ...]`."""
+    rng = np.random.default_rng(seed)
+    t, trace = 0.0, []
+    lo_p, hi_p = prompt_lens
+    lo_b, hi_b = budgets
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate_per_s))
+        p_len = int(rng.integers(lo_p, hi_p + 1))
+        p_len = min(p_len, t_max - 1)
+        budget = int(rng.integers(lo_b, hi_b + 1))
+        budget = min(budget, t_max - p_len)
+        prompt = tuple(int(x) for x in rng.integers(0, vocab, p_len))
+        trace.append((t, Request(
+            id=f"r{i}", prompt=prompt, max_new_tokens=budget,
+            eos_id=eos_id, deadline_s=deadline_s,
+            seed=(int(rng.integers(0, 2**31)) if sampled else None))))
+    return trace
+
+
+def save_trace(path, trace) -> str:
+    """Write `[(arrival_s, Request), ...]` as JSONL, one request per
+    line — the interchange format `run`/`load_trace` and the CLI's
+    `serve --trace` share."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        for t, r in trace:
+            f.write(json.dumps({
+                "t": t, "id": r.id, "prompt": list(r.prompt),
+                "max_new_tokens": r.max_new_tokens, "eos_id": r.eos_id,
+                "seed": r.seed, "deadline_s": r.deadline_s}) + "\n")
+    return str(path)
+
+
+def load_trace(path):
+    """Read a `save_trace` JSONL file back into `[(t, Request), ...]`."""
+    trace = []
+    for line in Path(path).read_text().splitlines():
+        if not line.strip():
+            continue
+        d = json.loads(line)
+        trace.append((float(d.get("t", 0.0)), Request(
+            id=str(d["id"]), prompt=tuple(d["prompt"]),
+            max_new_tokens=int(d["max_new_tokens"]),
+            eos_id=d.get("eos_id"), seed=d.get("seed"),
+            deadline_s=d.get("deadline_s"))))
+    return trace

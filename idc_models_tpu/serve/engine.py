@@ -1,0 +1,471 @@
+"""Fixed-slot continuous-batching decode engine — the device half of the
+serving subsystem.
+
+PR 1's `Generator` (models/lm.py) serves ONE request start-to-finish:
+ring prefill, then a fused scan emitting that request's tokens. Under
+concurrent traffic that leaves every other request queued head-of-line
+and the decode batch at 1. This engine applies iteration-level
+scheduling (Orca) with slot recycling (vLLM): `n_slots` requests decode
+TOGETHER, one batch row each, and whenever a row finishes (EOS, budget,
+deadline) the scheduler drops a freshly prefilled request into the
+vacated row while the other rows keep decoding — the batch never drains
+to refill.
+
+ALL per-slot state is device-resident and donated through the whole
+serve loop: per-block ring caches `[S, t_max, H, D]` (one row per slot,
+the training-layout ring sharding), last-token logits `[S, V]`, rng key
+data `[S, 2]`, positions `[S]`, remaining token budgets `[S]`, and stop
+ids `[S]`. The host keeps a SHADOW of positions/budgets it can update by
+pure arithmetic from the fetched tokens — no per-window state fetch.
+Three compiled programs drive the device:
+
+- **masked fused window** — ONE dispatch emits up to W tokens for every
+  slot: per scan step, each live slot splits its OWN rng stream, samples
+  with the exact serial `pick` math (a `[1, V]` row per slot), and runs
+  the shared per-token forward (`models/lm._token_forward`) with the
+  batched ring fold (`ring_decode.make_batched_ring_decode`) — finished
+  slots emit `pad_id` and their cache rows are bit-untouched. Budgets
+  count down and EOS hits zero them ON DEVICE, so rows retire mid-window
+  with no host in the loop; positions advance only while live.
+- **prefill** — the SAME bucketed program the serial `Generator` runs
+  (`models/lm._serving_fns`): prompts pad to `prefill_bucket` shapes
+  with the true length traced, so admitting arbitrary prompt lengths
+  compiles nothing new after warmup AND a request's prefill is
+  bit-identical to a serial call's.
+- **insert** — a jitted batch-axis scatter admitting one request: the
+  fresh `[1, t_max, H, D]` caches, `[1, V]` logits, and the slot's
+  position/budget/stop-id/key rows all land via `dynamic_update_slice`
+  with the slot index TRACED — one executable for every slot, zero
+  recompilation on recycle.
+
+The window API is a TWO-DEEP PIPELINE: `begin_window` dispatches a
+window and returns immediately (jax dispatch is async); `collect` blocks
+on the PREVIOUS window's tokens. The scheduler admits and does its host
+bookkeeping while the in-flight window computes — on the tunneled TPU
+runtime this hides the ~4 ms dispatch the same way the PR-1 fused scan
+hides per-token dispatch. `step_window` (= begin + collect) keeps the
+synchronous contract for direct use.
+
+Token parity (gated by tests/test_serve.py): because prefill, the
+per-token forward, the fold (row-wise bit-equal to the scalar fold), and
+the sampling rule are all the serial definitions, a request's output
+through this engine is bit-identical to a serial `Generator` call with
+the same prompt/seed — including a request admitted into a slot another
+request vacated mid-run.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.models import core
+from idc_models_tpu.models.lm import (
+    _make_pick, _place_params, _serve_config, _serving_fns,
+    _token_forward, prefill_bucket, prefill_buckets,
+)
+from idc_models_tpu.ring_decode import make_batched_ring_decode
+
+
+def _key_data(rng) -> np.ndarray:
+    """A request's rng as host uint32 key data. Integer seeds take the
+    host fast path — bit-identical to `key_data(jax.random.key(seed))`
+    under the default threefry2x32 impl (verified on first use), without
+    the per-admission device dispatch + fetch an eager key build
+    costs."""
+    if isinstance(rng, (int, np.integer)):
+        seed = int(rng)
+        if seed < 0:
+            raise ValueError(f"need a non-negative seed, got {seed}")
+        if not _key_data._checked:
+            probe = np.asarray(
+                jax.random.key_data(jax.random.key(0x12345)))
+            want = np.array([0, 0x12345], np.uint32)
+            if not np.array_equal(probe, want):
+                raise RuntimeError(
+                    "non-threefry2x32 default PRNG: pass explicit "
+                    "jax.random keys instead of integer seeds")
+            _key_data._checked = True
+        return np.array([(seed >> 32) & 0xffffffff, seed & 0xffffffff],
+                        np.uint32)
+    return np.asarray(jax.random.key_data(rng))
+
+
+_key_data._checked = False
+
+
+class _EngineFns(NamedTuple):
+    init_caches: object
+    window: object    # (params, caches, logits, kd, pos, rem, eos, W)
+    insert: object    # (state..., new_caches, new_logits, slot, ...)
+
+
+@functools.lru_cache(maxsize=16)
+def _engine_fns(cfg, pad_id: int) -> _EngineFns:
+    """Compile-once engine programs per decode configuration — the same
+    process-wide sharing discipline as `models/lm._serving_fns`: params
+    are explicit arguments, so two engines with one config share every
+    executable."""
+    mesh, t_max = cfg.mesh, cfg.t_max
+    head_dim = cfg.embed_dim // cfg.num_heads
+    fold = make_batched_ring_decode(mesh, jit=False)
+    ln = core.layer_norm(cfg.embed_dim)
+    pick = _make_pick(cfg)
+    # the TRAILING-NONE-FREE spelling of the ring cache layout: jit
+    # normalizes trailing Nones out of output PartitionSpecs, and the
+    # jit cache keys on spec EQUALITY — P(None, "seq", None, None) and
+    # P(None, "seq") describe one layout but are different keys, which
+    # would recompile the window once when its input caches switch from
+    # init_cache's spelling to a previous program's output (observed)
+    cache_sh = jax.sharding.NamedSharding(
+        mesh, meshlib.batch_seq_spec(mesh, trailing=0))
+    rep = meshlib.replicated(mesh)
+
+    def pin_state(caches, logits):
+        # every program returns the engine state under ONE canonical
+        # sharding: jit executables are cached per input sharding, so
+        # letting GSPMD re-derive layouts per program would make e.g.
+        # insert-output caches a different cache key than init_cache's
+        # and recompile the window once per producer (observed)
+        caches = tuple(
+            (lax.with_sharding_constraint(kc, cache_sh),
+             lax.with_sharding_constraint(vc, cache_sh))
+            for kc, vc in caches)
+        return caches, lax.with_sharding_constraint(logits, rep)
+
+    def init_caches(n_slots: int):
+        # same zeroed layout as ring_decode.init_cache, but placed under
+        # the engine's canonical (normalized) sharding spelling
+        def mk():
+            return meshlib.put_with_sharding(
+                np.zeros((n_slots, t_max, cfg.num_heads, head_dim),
+                         jnp.dtype(cfg.cache_dtype)), cache_sh)
+
+        return tuple((mk(), mk()) for _ in range(cfg.num_blocks))
+
+    def masked_step(params, caches, tok, pos, live):
+        return _token_forward(
+            cfg, ln, params, caches, tok, pos,
+            lambda _i, kc, vc, q, k, v: fold(kc, vc, q, k, v, pos, live))
+
+    def window_body(params, caches, logits, kd, pos, remaining, eos,
+                    n_steps):
+        # the whole window is ONE device program, like the serial fused
+        # scan — but each slot carries its own position, budget, and rng
+        # stream, and dead slots ride along as bit-level no-ops
+        def body(carry, _):
+            caches, logits, kd, pos, remaining = carry
+            live = remaining > 0
+            if cfg.temperature == 0.0:
+                # greedy consumes NO randomness (serial pick ignores its
+                # key too) — skip the S per-slot threefry splits, which
+                # otherwise dominate the per-step cost at small batch
+                toks = jax.vmap(lambda lg: pick(lg[None, :], None)[0])(
+                    logits)
+            else:
+                pair = jax.vmap(jax.random.split)(
+                    jax.random.wrap_key_data(kd))        # [S, 2] keys
+                # per-slot sampling over a [1, V] row — the EXACT serial
+                # pick call shape, so seeded sampling matches
+                # bit-for-bit
+                toks = jax.vmap(lambda lg, k: pick(lg[None, :], k)[0])(
+                    logits, pair[:, 1])
+            toks = jnp.where(live, toks, pad_id).astype(jnp.int32)
+            if cfg.temperature > 0.0:
+                # the stream advances once per EMITTED token, same as
+                # the serial decode loop's one split per step
+                kd = jnp.where(live[:, None],
+                               jax.random.key_data(pair[:, 0]), kd)
+            new_logits, caches = masked_step(params, caches, toks, pos,
+                                             live)
+            logits = jnp.where(live[:, None], new_logits, logits)
+            pos = jnp.where(live, pos + 1, pos)
+            remaining = jnp.where(live, remaining - 1, remaining)
+            hit = live & (eos >= 0) & (toks == eos)
+            remaining = jnp.where(hit, 0, remaining)
+            return (caches, logits, kd, pos, remaining), toks
+
+        (caches, logits, kd, pos, remaining), toks = lax.scan(
+            body, (caches, logits, kd, pos, remaining), None,
+            length=n_steps)
+        caches, logits = pin_state(caches, logits)
+        return (jnp.moveaxis(toks, 0, 1), caches, logits, kd, pos,
+                remaining)
+
+    # eos (argnum 6) is read-only across windows and deliberately NOT
+    # donated — the same device array feeds every window until an
+    # admission replaces it
+    window = jax.jit(window_body, static_argnums=(7,),
+                     donate_argnums=(1, 2, 3, 4, 5))
+
+    def insert_body(caches, logits, kd, pos, rem, eos, new_caches,
+                    new_logits, slot, p_len, budget, eos_id, kd_row):
+        # batch-axis scatter with the slot index (and every per-slot
+        # scalar) TRACED: one compiled program admits any request into
+        # any slot
+        out = []
+        for (kc, vc), (nk, nv) in zip(caches, new_caches):
+            kc = lax.dynamic_update_slice(kc, nk.astype(kc.dtype),
+                                          (slot, 0, 0, 0))
+            vc = lax.dynamic_update_slice(vc, nv.astype(vc.dtype),
+                                          (slot, 0, 0, 0))
+            out.append((kc, vc))
+        logits = lax.dynamic_update_slice(
+            logits, new_logits.astype(logits.dtype), (slot, 0))
+        kd = lax.dynamic_update_slice(kd, kd_row[None], (slot, 0))
+        pos = pos.at[slot].set(p_len)
+        rem = rem.at[slot].set(budget)
+        eos = eos.at[slot].set(eos_id)
+        caches, logits = pin_state(tuple(out), logits)
+        return caches, logits, kd, pos, rem, eos
+
+    insert = jax.jit(insert_body, donate_argnums=(0, 1, 2, 3, 4, 5))
+    return _EngineFns(init_caches, window, insert)
+
+
+class SlotEngine:
+    """`n_slots` concurrent decode rows over one parameter tree.
+
+    The host-side contract: `free_slots()` lists vacant rows;
+    `admit(slot, prompt, budget, ...)` prefills and scatters a request
+    into a row; `begin_window`/`collect` run the two-deep pipelined
+    masked windows (`step_window` is the synchronous pair); `finished`/
+    `release` recycle rows. Scheduling policy (queueing, deadlines,
+    interleave) lives in serve/scheduler.py — this class owns only the
+    device state machine.
+
+    The host never fetches per-slot state: positions and budgets are
+    shadowed by arithmetic on the fetched token rows (the device rule —
+    live steps are a prefix, EOS zeroes the budget — is replayed
+    exactly), so a window costs ONE host transfer: its tokens.
+    """
+
+    def __init__(self, params, *, embed_dim: int, num_heads: int,
+                 num_blocks: int, t_max: int, n_slots: int = 4,
+                 mesh=None, cache_dtype=jnp.bfloat16,
+                 block_impl: str = "jnp", temperature: float = 0.0,
+                 top_k: int | None = None, pad_id: int = 0,
+                 eos_id: int | None = None):
+        if n_slots < 1:
+            raise ValueError(f"need n_slots >= 1, got {n_slots}")
+        self._cfg = _serve_config(
+            params, embed_dim=embed_dim, num_heads=num_heads,
+            num_blocks=num_blocks, t_max=t_max, mesh=mesh,
+            cache_dtype=cache_dtype, block_impl=block_impl,
+            temperature=temperature, top_k=top_k)
+        non_seq = [a for a in self._cfg.mesh.axis_names
+                   if a != meshlib.SEQ_AXIS
+                   and self._cfg.mesh.shape[a] > 1]
+        if non_seq:
+            raise ValueError(
+                f"serving mesh must be seq-only: requests prefill one at "
+                f"a time ([1, P] batches cannot shard over axes "
+                f"{non_seq}); build the engine on mesh.seq_mesh(n)")
+        self._sfns = _serving_fns(self._cfg)
+        self._efns = _engine_fns(self._cfg, int(pad_id))
+        self._params = _place_params(params, self._cfg.mesh)
+        self._n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
+        self.t_max = t_max
+        self.n_slots = n_slots
+        self.pad_id = int(pad_id)
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        vocab = params["head"]["kernel"].shape[1]
+        # dtype only — never np.asarray the head: on a real model that
+        # is a multi-hundred-MB device→host fetch per engine build
+        ldtype = jnp.result_type(params["head"]["kernel"].dtype)
+        rep = meshlib.replicated(self._cfg.mesh)
+        # device state — placed under the canonical shardings every
+        # engine program pins its outputs to (one jit cache key for the
+        # whole loop), donated through every window/insert
+        self._caches = self._efns.init_caches(n_slots)
+        self._logits = meshlib.put_with_sharding(
+            np.zeros((n_slots, vocab), ldtype), rep)
+        self._kd = meshlib.put_with_sharding(
+            np.zeros((n_slots, 2), np.uint32), rep)
+        self._pos = meshlib.put_with_sharding(
+            np.zeros(n_slots, np.int32), rep)
+        self._rem = meshlib.put_with_sharding(
+            np.zeros(n_slots, np.int32), rep)
+        self._eos = meshlib.put_with_sharding(
+            np.full(n_slots, -1, np.int32), rep)
+        # host shadows (never fetched back from device)
+        self._pos_h = np.zeros(n_slots, np.int64)
+        self._rem_h = np.zeros(n_slots, np.int64)
+        self._eos_h = np.full(n_slots, -1, np.int64)
+        self._occupied = np.zeros(n_slots, bool)
+        self._pending = None     # (toks_dev, rem_snapshot, occ_snapshot)
+
+    # -- slot lifecycle -------------------------------------------------
+
+    def free_slots(self) -> list[int]:
+        """Slots safe to admit into NOW. A slot released after a window
+        was dispatched (deadline cancel) stays excluded until that
+        window is collected — its in-flight tokens would otherwise be
+        attributed to the newly admitted request."""
+        in_flight = (self._pending[1][1] if self._pending is not None
+                     else None)
+        return [s for s in range(self.n_slots)
+                if not self._occupied[s]
+                and (in_flight is None or not in_flight[s])]
+
+    def occupancy(self) -> float:
+        return float(self._occupied.sum()) / self.n_slots
+
+    def finished(self, slot: int) -> bool:
+        return bool(self._occupied[slot]) and self._rem_h[slot] == 0
+
+    def release(self, slot: int) -> None:
+        """Vacate a slot (EOS/budget done, or a deadline cancel). The
+        row's device state is left as-is: a cancelled row at worst
+        decodes its bounded remaining budget as a dead ride-along, and
+        the next admit's insert overwrites the full row (dead rows never
+        append or influence live ones — gated by test)."""
+        self._occupied[slot] = False
+        self._rem_h[slot] = 0
+
+    def admit(self, slot: int, prompt, max_new_tokens: int, *,
+              rng=None, eos_id: int | None = None) -> None:
+        """Prefill `prompt` ([P] or [1, P]) and scatter it into `slot`:
+        one bucketed prefill dispatch + one insert dispatch, while every
+        other slot's state stays put. `rng` seeds this REQUEST's
+        sampling stream — an integer seed or the exact key a serial
+        `Generator.decode` call would take. May be called while a window
+        is in flight: the insert lands after it, and the slot (vacant in
+        the flying window) starts decoding on the next one."""
+        if self._occupied[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        # host-side prompt prep (the eager-jnp equivalent costs ~6 tiny
+        # device dispatches per ADMISSION — measured to be a third of
+        # the whole serve loop's wall at smoke scale): numpy pad to the
+        # prefill bucket, hand the jitted prefill the numpy array
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        if prompt.ndim != 2 or prompt.shape[0] != 1 or prompt.shape[1] < 1:
+            raise ValueError(f"admit takes ONE non-empty [1, P] prompt, "
+                             f"got shape {prompt.shape}")
+        p_len = prompt.shape[1]
+        if p_len > self.t_max:
+            raise ValueError(f"prompt length {p_len} exceeds t_max "
+                             f"{self.t_max}")
+        if max_new_tokens < 1:
+            raise ValueError(f"need max_new_tokens >= 1, got "
+                             f"{max_new_tokens}")
+        if p_len + max_new_tokens > self.t_max:
+            raise ValueError(
+                f"prompt {p_len} + max_new_tokens {max_new_tokens} "
+                f"exceeds t_max {self.t_max} — the cache cannot grow at "
+                f"decode time")
+        if self.temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs an rng "
+                             "key (or integer seed) per request")
+        bucket = prefill_bucket(p_len, self.t_max, self._n_ring)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[:, :p_len] = prompt
+        logits1, caches1 = self._sfns.prefill(self._params, padded,
+                                              np.int32(p_len))
+        eos = self.eos_id if eos_id is None else eos_id
+        eos = -1 if eos is None else int(eos)
+        kd_row = (_key_data(rng) if rng is not None
+                  else np.zeros(2, np.uint32))
+        (self._caches, self._logits, self._kd, self._pos, self._rem,
+         self._eos) = self._efns.insert(
+            self._caches, self._logits, self._kd, self._pos, self._rem,
+            self._eos, caches1, logits1, np.int32(slot), np.int32(p_len),
+            np.int32(max_new_tokens), np.int32(eos), kd_row)
+        self._pos_h[slot] = p_len
+        self._rem_h[slot] = max_new_tokens
+        self._eos_h[slot] = eos
+        self._occupied[slot] = True
+
+    # -- decode ---------------------------------------------------------
+
+    def begin_window(self, n_steps: int) -> None:
+        """Dispatch ONE fused masked window (async) — up to `n_steps`
+        tokens per slot. `collect` returns its tokens; at most one
+        window may be in flight."""
+        if self._pending is not None:
+            raise RuntimeError("a window is already in flight — "
+                               "collect() it first")
+        if n_steps < 1:
+            raise ValueError(f"need n_steps >= 1, got {n_steps}")
+        snapshot = (self._rem_h.copy(), self._occupied.copy(),
+                    self._eos_h.copy())
+        toks, self._caches, self._logits, self._kd, self._pos, self._rem = (
+            self._efns.window(self._params, self._caches, self._logits,
+                              self._kd, self._pos, self._rem, self._eos,
+                              n_steps))
+        self._pending = (toks, snapshot)
+
+    def collect(self) -> dict[int, list[int]]:
+        """Block on the in-flight window's tokens ({} if none) and
+        replay the device retirement rule onto the host shadows: live
+        steps are a prefix of the window (budgets only count down), and
+        an EOS hit zeroes the remaining budget after emitting. Returns
+        {slot: tokens emitted} for slots occupied when the window was
+        dispatched."""
+        if self._pending is None:
+            return {}
+        toks, (rem_before, occupied, eos_h) = self._pending
+        self._pending = None
+        toks = np.asarray(toks)                 # the ONE host transfer
+        out = {}
+        for s in range(self.n_slots):
+            if not occupied[s]:
+                continue
+            n = int(min(rem_before[s], toks.shape[1]))
+            row = [int(t) for t in toks[s, :n]]
+            if eos_h[s] >= 0 and eos_h[s] in row:
+                row = row[:row.index(int(eos_h[s])) + 1]
+                self._rem_h[s] = 0
+            else:
+                self._rem_h[s] = rem_before[s] - len(row)
+            self._pos_h[s] += len(row)
+            out[s] = row
+        return out
+
+    def step_window(self, n_steps: int) -> dict[int, list[int]]:
+        """Synchronous window: begin + collect in one call."""
+        self.begin_window(n_steps)
+        return self.collect()
+
+    # -- observability --------------------------------------------------
+
+    def cache_sizes(self) -> dict:
+        """Jit-cache entry counts for the no-recompile contract: after
+        warmup, admitting requests of ANY prompt length/budget into any
+        slot must not grow these (gated by test)."""
+        return {"window": self._efns.window._cache_size(),
+                "insert": self._efns.insert._cache_size(),
+                "prefill": self._sfns.prefill._cache_size()}
+
+    def warmup(self, n_steps: int) -> None:
+        """Compile every program the serve loop will touch: the prefill
+        at every bucket length, the insert, and the masked window at
+        `n_steps` — so admission traffic after this triggers ZERO XLA
+        compilations. Runs on the real (empty) engine state with a ZERO
+        budget, so every row stays dead and the warmup dispatches are
+        bit-level no-ops."""
+        logits1 = caches1 = None
+        for b in prefill_buckets(self.t_max, self._n_ring):
+            logits1, caches1 = self._sfns.prefill(
+                self._params, np.zeros((1, b), np.int32), np.int32(b))
+        # two full insert->window cycles: the steady-state inputs of
+        # each program are the (sharding-pinned) OUTPUTS of the others,
+        # so the second cycle warms exactly the executables the serve
+        # loop reuses forever
+        for _ in range(2):
+            (self._caches, self._logits, self._kd, self._pos, self._rem,
+             self._eos) = self._efns.insert(
+                self._caches, self._logits, self._kd, self._pos,
+                self._rem, self._eos, caches1, logits1, np.int32(0),
+                np.int32(1), np.int32(0), np.int32(-1),
+                np.zeros(2, np.uint32))
+            self.step_window(n_steps)

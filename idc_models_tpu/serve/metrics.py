@@ -1,0 +1,126 @@
+"""Serving observability: TTFT, per-token latency, throughput, queue
+depth, slot occupancy.
+
+Counters accumulate in memory and stream — when a logger is given —
+through the same `observe.JsonlLogger` jsonl record shape every other
+loop in the framework writes, so a serving run's timeline sits next to
+its training runs' in one machine-comparable format. `summary()` is the
+record `bench.py` embeds in the official JSON line (`serve_*` fields).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _pct(values, q) -> float | None:
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class ServingMetrics:
+    """Per-request and per-cycle serving counters.
+
+    Hooks are called by the scheduler: `on_submit`/`on_reject` at the
+    queue, `on_first_token` when a request's first decode window lands
+    (TTFT), `on_finish` with the whole request's timing, and `on_cycle`
+    once per engine cycle with queue depth / slot occupancy / tokens
+    emitted. All times are seconds on the caller's clock.
+    """
+
+    def __init__(self, logger=None):
+        self.logger = logger
+        self.submitted = 0
+        self.rejected = 0
+        self.timed_out = 0
+        self.finished = 0
+        self.tokens_out = 0
+        self.cycles = 0
+        self.ttft_s: list[float] = []
+        self.token_s: list[float] = []      # per-token decode latency
+        self.queue_depths: list[int] = []
+        self.occupancies: list[float] = []
+        self.cycle_tokens: list[int] = []
+        self._t_first: float | None = None
+        self._t_last: float | None = None
+
+    # -- request lifecycle ----------------------------------------------
+
+    def on_submit(self, rid, t: float) -> None:
+        self.submitted += 1
+        if self._t_first is None:
+            self._t_first = t
+        self._log(event="serve_submit", id=rid)
+
+    def on_reject(self, rid, t: float) -> None:
+        self.rejected += 1
+        self._log(event="serve_reject", id=rid)
+
+    def on_first_token(self, rid, ttft_s: float) -> None:
+        self.ttft_s.append(ttft_s)
+        self._log(event="serve_first_token", id=rid,
+                  ttft_ms=ttft_s * 1e3)
+
+    def on_finish(self, rid, *, n_tokens: int, ttft_s: float | None,
+                  decode_s: float, reason: str, t: float) -> None:
+        self.finished += 1
+        if reason in ("timeout", "deadline"):
+            self.timed_out += 1
+        self.tokens_out += n_tokens
+        self._t_last = t
+        if n_tokens > 1 and decode_s > 0:
+            self.token_s.append(decode_s / (n_tokens - 1))
+        self._log(event="serve_finish", id=rid, tokens=n_tokens,
+                  reason=reason,
+                  ttft_ms=None if ttft_s is None else ttft_s * 1e3)
+
+    # -- engine cycle ----------------------------------------------------
+
+    def on_cycle(self, *, queue_depth: int, occupancy: float,
+                 tokens: int = 0) -> None:
+        self.cycles += 1
+        self.queue_depths.append(int(queue_depth))
+        self.occupancies.append(float(occupancy))
+        self.cycle_tokens.append(int(tokens))
+
+    # -- rollup -----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The serving scenario record: aggregate throughput over the
+        span from first submit to last finish, TTFT percentiles, and
+        mean queue/occupancy — the `serve_*` fields bench.py reports."""
+        span = ((self._t_last - self._t_first)
+                if self._t_first is not None and self._t_last is not None
+                else None)
+        return {
+            "serve_requests": self.finished,
+            "serve_rejected": self.rejected,
+            "serve_timed_out": self.timed_out,
+            "serve_tokens": self.tokens_out,
+            "serve_tokens_per_sec": (
+                round(self.tokens_out / span, 2)
+                if span and span > 0 else None),
+            "serve_ttft_ms_p50": _r(_pct(self.ttft_s, 50), 1e3),
+            "serve_ttft_ms_p95": _r(_pct(self.ttft_s, 95), 1e3),
+            "serve_token_ms_p50": _r(_pct(self.token_s, 50), 1e3),
+            "serve_slot_occupancy": (
+                round(float(np.mean(self.occupancies)), 4)
+                if self.occupancies else None),
+            "serve_queue_depth_mean": (
+                round(float(np.mean(self.queue_depths)), 2)
+                if self.queue_depths else None),
+            "serve_queue_depth_max": (
+                max(self.queue_depths) if self.queue_depths else None),
+            "serve_window_tokens_mean": (
+                round(float(np.mean(self.cycle_tokens)), 2)
+                if self.cycle_tokens else None),
+        }
+
+    def _log(self, **record) -> None:
+        if self.logger is not None:
+            self.logger.log(**record)
+
+
+def _r(v, scale) -> float | None:
+    return None if v is None else round(v * scale, 2)

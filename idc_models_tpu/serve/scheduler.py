@@ -1,0 +1,272 @@
+"""Iteration-level scheduling over the slot engine: admission queue,
+deadlines, prefill/decode interleave, slot recycling.
+
+The engine (serve/engine.py) is a device-state machine with no opinion
+about WHICH request runs where or when; this module is the policy:
+
+- **FIFO admission with backpressure** — `AdmissionQueue` holds at most
+  `max_depth` waiting requests; a submit beyond that is REFUSED (the
+  caller sees `False` and decides: retry, shed, or block). Bounded
+  queues are the backpressure contract: an unbounded queue converts
+  overload into unbounded tail latency instead of an explicit signal.
+- **Deadlines** — a request may carry a deadline (seconds from submit).
+  Queued requests past it are dropped without ever occupying a slot;
+  RUNNING requests past it are cancelled mid-generation (partial tokens
+  returned, the slot recycled for the next request).
+- **Prefill-vs-decode interleave** — each `tick()` admits at most
+  `max_prefills_per_cycle` queued requests into free slots before
+  running one decode window. Prefill is the long-pole dispatch (O(P)
+  work vs the window's O(W)); capping admissions per cycle bounds how
+  long running requests stall behind a deep queue, while still refilling
+  vacated slots within a cycle of them freeing.
+- **Slot recycling** — EOS, budget exhaustion, and deadline cancels all
+  route through `SlotEngine.release`; the vacated row is eligible for
+  admission on the SAME tick the finish is observed, so slots never
+  idle a full cycle between requests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+
+@dataclasses.dataclass(eq=False)     # identity eq: prompts are arrays
+class Entry:
+    """One request's lifetime record inside the scheduler: identity and
+    limits in, timestamps/tokens/finish state out. The api layer wraps
+    this into the user-facing `Result`."""
+    rid: object
+    prompt: object                   # int32 [P]
+    budget: int
+    eos_id: int | None = None
+    rng: object = None               # per-request sampling key
+    # RELATIVE seconds-from-submit when handed to submit(); rewritten to
+    # the absolute clock time there
+    deadline: float | None = None
+    t_submit: float = 0.0
+    t_admit: float | None = None
+    t_first: float | None = None
+    t_done: float | None = None
+    slot: int | None = None
+    tokens: list = dataclasses.field(default_factory=list)
+    status: str = "pending"          # pending|running|ok|timeout|rejected
+    finish_reason: str | None = None  # eos|budget|deadline|None
+
+
+class AdmissionQueue:
+    """Bounded FIFO. `push` returns False at max_depth — the
+    backpressure signal — instead of growing without bound."""
+
+    def __init__(self, max_depth: int = 64):
+        if max_depth < 1:
+            raise ValueError(f"need max_depth >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self._q: deque[Entry] = deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, entry: Entry) -> bool:
+        if len(self._q) >= self.max_depth:
+            return False
+        self._q.append(entry)
+        return True
+
+    def pop(self) -> Entry:
+        return self._q.popleft()
+
+    def expire(self, now: float) -> list[Entry]:
+        """Drop queued entries past their deadline (they never reach a
+        slot); returns them for result bookkeeping."""
+        expired = [e for e in self._q
+                   if e.deadline is not None and now >= e.deadline]
+        if expired:
+            self._q = deque(e for e in self._q if e not in expired)
+        return expired
+
+
+class Scheduler:
+    """Continuous-batching loop: one `tick()` = expire deadlines, admit
+    up to `max_prefills_per_cycle` requests into free slots, run ONE
+    fused decode window of `window` tokens, recycle finished slots.
+    Returns the entries that finished this tick."""
+
+    def __init__(self, engine, *, window: int = 8, max_queue_depth: int = 64,
+                 max_prefills_per_cycle: int = 1, metrics=None,
+                 admit_after_collect: bool = True, clock=time.monotonic):
+        if window < 1:
+            raise ValueError(f"need window >= 1, got {window}")
+        self.engine = engine
+        self.window = window
+        self.queue = AdmissionQueue(max_queue_depth)
+        self.max_prefills_per_cycle = max(int(max_prefills_per_cycle), 1)
+        self.metrics = metrics
+        # refill slots the just-collected window freed before the next
+        # window dispatches (recycle idles one window, not two) — at the
+        # price of those prefills sitting in the device-idle gap instead
+        # of overlapping the in-flight window
+        self.admit_after_collect = admit_after_collect
+        self.clock = clock
+        self._running: dict[int, Entry] = {}
+
+    # -- admission -------------------------------------------------------
+
+    def submit(self, entry: Entry) -> bool:
+        """Validate + enqueue. Returns False (backpressure) when the
+        queue is at max depth; raises on requests that could NEVER be
+        served (too long for t_max, missing rng for sampling) — those
+        are caller errors, not load."""
+        p_len = len(entry.prompt)
+        if p_len < 1:
+            raise ValueError("empty prompt")
+        if entry.budget < 1:
+            raise ValueError(f"need max_new_tokens >= 1, got "
+                             f"{entry.budget}")
+        if p_len + entry.budget > self.engine.t_max:
+            raise ValueError(
+                f"prompt {p_len} + max_new_tokens {entry.budget} exceeds "
+                f"t_max {self.engine.t_max}")
+        if self.engine.temperature > 0.0 and entry.rng is None:
+            raise ValueError("sampling (temperature > 0) needs a "
+                             "per-request rng key")
+        # resolve the EFFECTIVE stop token now (request override, else
+        # the engine default; -1 opts out) so the finish_reason below
+        # and the engine agree on what "eos" means for this request
+        if entry.eos_id is None:
+            entry.eos_id = self.engine.eos_id
+        if entry.eos_id is not None and entry.eos_id < 0:
+            entry.eos_id = None
+        entry.t_submit = self.clock()
+        if entry.deadline is not None:
+            entry.deadline = entry.t_submit + entry.deadline
+        if not self.queue.push(entry):
+            entry.status = "rejected"
+            if self.metrics:
+                self.metrics.on_reject(entry.rid, entry.t_submit)
+            return False
+        if self.metrics:
+            self.metrics.on_submit(entry.rid, entry.t_submit)
+        return True
+
+    def _admit_free_slots(self) -> int:
+        """Pop queued entries into free slots, at most
+        max_prefills_per_cycle — the ONE admission bookkeeping path for
+        both tick() passes."""
+        admitted = 0
+        free = self.engine.free_slots()
+        while (admitted < self.max_prefills_per_cycle and free
+               and len(self.queue)):
+            e = self.queue.pop()
+            slot = free.pop(0)
+            self.engine.admit(slot, e.prompt, e.budget, rng=e.rng,
+                              eos_id=(e.eos_id if e.eos_id is not None
+                                      else -1))
+            e.slot, e.status, e.t_admit = slot, "running", self.clock()
+            self._running[slot] = e
+            admitted += 1
+        return admitted
+
+    # -- the cycle -------------------------------------------------------
+
+    def idle(self) -> bool:
+        return (not self._running and not len(self.queue)
+                and self.engine._pending is None)
+
+    def tick(self) -> list[Entry]:
+        """One pipelined cycle. Host work (admission prefills, result
+        bookkeeping) runs WHILE the previously begun window executes on
+        device; the tick ends by dispatching the next window. Slot
+        availability seen by admissions is one window stale — a row
+        freed by the in-flight window refills next tick."""
+        now = self.clock()
+        done: list[Entry] = []
+        # 1. queued requests past deadline never occupy a slot
+        for e in self.queue.expire(now):
+            e.status, e.finish_reason, e.t_done = "timeout", "deadline", now
+            self._finish(e, done)
+        # 2. interleave policy: refill known-free slots, at most
+        #    max_prefills_per_cycle prefills per cycle — the prefill
+        #    dispatches overlap the in-flight window's execution
+        self._admit_free_slots()
+        # 3. collect the in-flight window; recycle on EOS / budget.
+        #    Only the recycle decisions happen here — per-token
+        #    bookkeeping is deferred past the next dispatch (step 6) so
+        #    the device never idles behind host accounting
+        out = self.engine.collect()
+        t_now = self.clock()
+        got: list[tuple[Entry, list]] = []
+        finished: list[Entry] = []
+        for slot, toks in out.items():
+            e = self._running.get(slot)
+            if e is None:            # cancelled while the window flew
+                continue
+            got.append((e, toks))
+            if self.engine.finished(slot):
+                self.engine.release(slot)
+                del self._running[slot]
+                finished.append(e)
+        # 4. running requests past deadline are cancelled mid-generation
+        #    (after collect, so the partial tokens reach the result)
+        cancelled: list[Entry] = []
+        for slot, e in list(self._running.items()):
+            if e.deadline is not None and now >= e.deadline:
+                self.engine.release(slot)
+                del self._running[slot]
+                cancelled.append(e)
+        # 5. second admission pass: slots freed by the JUST-collected
+        #    window refill before the next window dispatches, so a
+        #    recycle costs one window of idleness, not two
+        if self.admit_after_collect:
+            self._admit_free_slots()
+        # 6. dispatch the next window over every occupied slot
+        occupancy = len(self._running) / self.engine.n_slots
+        if self._running:
+            self.engine.begin_window(self.window)
+        # 7. deferred bookkeeping — runs WHILE the new window computes
+        emitted = 0
+        for e, toks in got:
+            if toks and e.t_first is None:
+                e.t_first = t_now
+                if self.metrics:
+                    self.metrics.on_first_token(e.rid, t_now - e.t_submit)
+            e.tokens.extend(toks)
+            emitted += len(toks)
+        for e in finished:
+            e.status, e.t_done = "ok", t_now
+            e.finish_reason = (
+                "eos" if (e.eos_id is not None and e.tokens
+                          and e.tokens[-1] == e.eos_id)
+                else "budget")
+            self._finish(e, done)
+        # deadline cancels from step 4 finish here too, AFTER the token
+        # extension above folded in anything the flying window carried
+        for e in cancelled:
+            e.status, e.finish_reason = "timeout", "deadline"
+            e.t_done = now
+            self._finish(e, done)
+        if self._running and self.metrics:
+            self.metrics.on_cycle(queue_depth=len(self.queue),
+                                  occupancy=occupancy, tokens=emitted)
+        return done
+
+    def drain(self) -> list[Entry]:
+        """Tick until every queued and running request has finished."""
+        done = []
+        while not self.idle():
+            done.extend(self.tick())
+        return done
+
+    def _finish(self, e: Entry, done: list[Entry]) -> None:
+        done.append(e)
+        if self.metrics:
+            ttft = (e.t_first - e.t_submit
+                    if e.t_first is not None else None)
+            decode_s = (e.t_done - e.t_first
+                        if e.t_first is not None and e.t_done is not None
+                        else 0.0)
+            self.metrics.on_finish(
+                e.rid, n_tokens=len(e.tokens), ttft_s=ttft,
+                decode_s=decode_s,
+                reason=(e.finish_reason or e.status), t=e.t_done)

@@ -17,10 +17,15 @@ existence gate is implemented correctly (fixing Q5).
 from __future__ import annotations
 
 import os
+import shutil
 from pathlib import Path
 from typing import Any
 
 import jax
+
+# written INTO the checkpoint directory as the last step of a save;
+# its presence is the completion contract checkpoint_exists enforces
+_COMPLETE_MARKER = "_IDC_COMPLETE"
 
 
 def _checkpointer():
@@ -31,23 +36,64 @@ def _checkpointer():
 
 def checkpoint_exists(path: str | os.PathLike) -> bool:
     """The reference's intent at fed_model.py:175 (`os.path.exists`, not
-    the buggy `sys.path.exists`)."""
-    return Path(path).exists()
+    the buggy `sys.path.exists`) — hardened: a checkpoint directory
+    WITHOUT the completion marker is a torn partial left by a crash
+    mid-save and is refused (the restore gate, `load_or_train`, then
+    retrains instead of crashing into half-written arrays)."""
+    path = Path(path)
+    if not path.exists():
+        return False
+    if path.is_dir():
+        return (path / _COMPLETE_MARKER).exists()
+    # non-directory artifacts (e.g. single-file handlers) have no
+    # marker to check; existence is the best signal available
+    return True
 
 
 def save_checkpoint(path: str | os.PathLike, state: Any, *,
                     force: bool = True) -> str:
-    """Save a pytree (TrainState, ServerState, bare params...) to `path`."""
+    """Save a pytree (TrainState, ServerState, bare params...) to
+    `path`, ATOMICALLY: the tree is written to `<path>.tmp`, stamped
+    with a completion marker, and renamed into place with `os.replace`.
+    A crash at ANY point leaves either the old complete checkpoint or a
+    markerless partial that `checkpoint_exists` refuses — never a
+    half-written tree that restores garbage."""
     path = Path(path).absolute()
     path.parent.mkdir(parents=True, exist_ok=True)
-    _checkpointer().save(path, state, force=force)
+    tmp = path.with_name(path.name + ".tmp")
+    if tmp.exists():
+        shutil.rmtree(tmp)              # leftover from a prior crash
+    _checkpointer().save(tmp, state, force=force)
+    (tmp / _COMPLETE_MARKER).touch()
+    if path.exists():
+        # os.replace cannot overwrite a non-empty directory: retire the
+        # old checkpoint first. The unprotected window is between these
+        # two renames (metadata ops, microseconds) and a crash inside it
+        # still leaves the COMPLETE tree at <path>.old for manual
+        # recovery — never a torn <path>.
+        old = path.with_name(path.name + ".old")
+        if old.exists():
+            shutil.rmtree(old)
+        os.replace(path, old)
+        os.replace(tmp, path)
+        shutil.rmtree(old)
+    else:
+        os.replace(tmp, path)
     return str(path)
 
 
 def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
     """Restore into the structure/shardings of `target` (an abstract or
-    concrete pytree of the same shape as what was saved)."""
+    concrete pytree of the same shape as what was saved). Refuses torn
+    partial checkpoints (no completion marker)."""
     path = Path(path).absolute()
+    if path.is_dir() and not (path / _COMPLETE_MARKER).exists():
+        raise ValueError(
+            f"checkpoint {path} has no completion marker — either a "
+            f"torn partial left by a crash mid-save (delete it, or let "
+            f"load_or_train retrain) or a checkpoint from before the "
+            f"atomic-save change (touch {path / _COMPLETE_MARKER} to "
+            f"accept one you trust)")
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(
             x, "sharding", None)) if hasattr(x, "shape") else x,
@@ -57,9 +103,21 @@ def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
 
 def load_or_train(path: str | os.PathLike, target: Any, train_fn):
     """The pretrainer gate (C8): restore `path` if it exists, else run
-    `train_fn() -> state`, save it, and return it."""
+    `train_fn() -> state`, save it, and return it. A markerless
+    directory at `path` (torn partial — or a checkpoint from before the
+    atomic-save change) is retrained over, with a loud warning naming
+    the migration escape hatch first."""
     if checkpoint_exists(path):
         return restore_checkpoint(path, target), True
+    if Path(path).is_dir():
+        import warnings
+
+        warnings.warn(
+            f"checkpoint {path} exists but has no completion marker "
+            f"(torn partial, or saved before the atomic-save change) — "
+            f"RETRAINING over it; to restore a pre-existing checkpoint "
+            f"you trust, touch {Path(path) / _COMPLETE_MARKER} first",
+            stacklevel=2)
     state = train_fn()
     save_checkpoint(path, state)
     return state, False

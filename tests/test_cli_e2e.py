@@ -239,6 +239,44 @@ def test_cli_secure_fed_paillier(capsys):
     assert "Client 0 training took" in out   # C17 per-client Timers
 
 
+def test_cli_serve_synthetic_trace(tmp_path, capsys):
+    """The continuous-batching engine from the product surface: a
+    synthetic Poisson trace through `serve` on the virtual pod — the
+    summary line, the request accounting, and the jsonl artifact. Engine
+    semantics (parity, recycling, backpressure) are owned by
+    tests/test_serve.py; this drives the CLI wiring end to end."""
+    import json
+
+    out = _run(["serve", "--host-devices", "8", "--requests", "6",
+                "--slots", "2", "--window", "4", "--t-max", "32",
+                "--vocab", "11", "--embed-dim", "16", "--num-heads", "2",
+                "--mlp-dim", "32", "--num-blocks", "1",
+                "--path", str(tmp_path)], capsys)
+    assert "serving 6 requests on 2 slots" in out
+    assert "served: ok=6 timeout=0 rejected=0" in out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("serve summary:")][0]
+    summary = json.loads(line.split("serve summary:", 1)[1])
+    assert summary["serve_requests"] == 6
+    assert summary["serve_tokens_per_sec"] > 0
+    log = tmp_path / "logs" / "serve.jsonl"
+    assert log.exists()
+    events = {json.loads(l)["event"] for l in
+              log.read_text().splitlines()}
+    assert {"serve_submit", "serve_finish", "serve_summary"} <= events
+    # a replayed JSONL trace drives the same path (load_trace format)
+    from idc_models_tpu.serve import Request, save_trace
+
+    trace = [(0.0, Request(id="t0", prompt=(1, 2, 3), max_new_tokens=4)),
+             (0.01, Request(id="t1", prompt=(4, 5), max_new_tokens=6))]
+    tr = save_trace(tmp_path / "trace.jsonl", trace)
+    out = _run(["serve", "--host-devices", "8", "--trace", tr,
+                "--slots", "2", "--window", "4", "--t-max", "32",
+                "--vocab", "11", "--embed-dim", "16", "--num-heads", "2",
+                "--mlp-dim", "32", "--num-blocks", "1"], capsys)
+    assert "serving 2 requests" in out and "served: ok=2" in out
+
+
 def test_cli_lm(tmp_path, capsys):
     """The causal-LM workload from the product surface: the CLI wiring
     only (mesh line, metric line, generate line, jsonl artifact, ring

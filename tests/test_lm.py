@@ -358,6 +358,57 @@ def test_int_tokens_skip_compute_dtype_cast(devices):
     assert float(m_bf["loss"]) == float(m_f32["loss"])
 
 
+def test_generator_bounds_edges(devices):
+    """The t_max boundary exactly: a prompt of exactly t_max tokens
+    prefills fine, but ANY decode from there must be rejected BEFORE
+    dispatch (inside the fused scan an out-of-range append would be
+    silently dropped); steps=0/negative are rejected with clear
+    messages."""
+    params = _model(None).init(jax.random.key(51)).params
+    gen = Generator(params, embed_dim=E, num_heads=HEADS,
+                    num_blocks=BLOCKS, t_max=SEQ, cache_dtype=jnp.float32)
+    full = _toks(1, seed=53)                      # exactly t_max tokens
+    assert full.shape[1] == SEQ
+    logits, caches = gen.prefill(full)            # fine: fills the cache
+    assert logits.shape == (1, VOCAB)
+    for kc, _vc in caches:
+        assert np.asarray(kc)[:, -1].any()        # last slot occupied
+    # any decode from the full cache must fail before dispatch
+    with pytest.raises(ValueError, match="exceeds t_max"):
+        gen.decode(caches, logits, SEQ, 1)
+    # __call__ refuses a full-length prompt + any steps the same way
+    with pytest.raises(ValueError, match="exceeds"):
+        gen(full, 1)
+    # steps=0 / negative: rejected with a clear message, no dispatch
+    with pytest.raises(ValueError, match="steps >= 1"):
+        gen.decode(caches, logits, 4, 0)
+    with pytest.raises(ValueError, match="steps >= 1"):
+        gen.decode(caches, logits, 4, -3)
+    with pytest.raises(ValueError, match="steps >= 1"):
+        gen(full[:, :4], 0)
+
+
+def test_prefill_buckets(devices):
+    """Prompt length maps onto the fixed bucket set (n_ring * powers of
+    two, capped at t_max) — the compile-set contract the serving engine
+    warms up against."""
+    from idc_models_tpu.models.lm import prefill_bucket, prefill_buckets
+
+    assert prefill_buckets(32, 1) == (1, 2, 4, 8, 16, 32)
+    assert prefill_buckets(32, 4) == (4, 8, 16, 32)
+    assert prefill_buckets(24, 4) == (4, 8, 16, 24)
+    for n_ring, t_max in ((1, 32), (4, 32), (4, 24), (3, 24)):
+        buckets = prefill_buckets(t_max, n_ring)
+        assert all(b % n_ring == 0 for b in buckets)
+        for p in range(1, t_max + 1):
+            b = prefill_bucket(p, t_max, n_ring)
+            assert b in buckets and b >= p
+    with pytest.raises(ValueError, match="outside"):
+        prefill_bucket(0, 32, 1)
+    with pytest.raises(ValueError, match="outside"):
+        prefill_bucket(33, 32, 1)
+
+
 def test_generate_sampling_modes(devices):
     """temperature/top_k: greedy is deterministic and equals the
     default; sampling varies with the rng but respects top_k=1 ==

@@ -242,3 +242,64 @@ def test_plot_history_no_fine(tmp_path):
             "loss": [0.7, 0.6], "val_loss": [0.8, 0.7]}
     out = plot_history(tmp_path, hist, None, 4)
     assert os.path.exists(out) and out.endswith("plot_dev4.png")
+
+
+def test_checkpoint_save_is_atomic(devices, tmp_path):
+    """Torn-checkpoint hardening: a completed save carries the
+    completion marker; a partial left by a crash mid-save (no marker) is
+    refused by checkpoint_exists/restore, and load_or_train retrains
+    over it instead of restoring garbage."""
+    import pytest
+
+    from idc_models_tpu.train.checkpoint import _COMPLETE_MARKER
+
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    path = tmp_path / "ckpt"
+    save_checkpoint(path, state)
+    assert (path / _COMPLETE_MARKER).exists()
+    assert not path.with_name("ckpt.tmp").exists()   # renamed into place
+
+    # overwrite is atomic too and leaves no .tmp/.old residue
+    save_checkpoint(path, state)
+    assert checkpoint_exists(path)
+    assert not path.with_name("ckpt.tmp").exists()
+    assert not path.with_name("ckpt.old").exists()
+
+    # simulate the crash: strip the marker -> the gate refuses it
+    (path / _COMPLETE_MARKER).unlink()
+    assert not checkpoint_exists(path)
+    target = create_train_state(model, opt, jax.random.key(9))
+    with pytest.raises(ValueError, match="torn partial"):
+        restore_checkpoint(path, target)
+    calls = []
+
+    def train_fn():
+        calls.append(1)
+        return state
+
+    got, was_restored = load_or_train(path, target, train_fn)
+    assert not was_restored and len(calls) == 1      # retrained
+    assert checkpoint_exists(path)                   # and re-saved whole
+
+
+def test_jsonl_logger_arrays_and_close(tmp_path):
+    """The _jsonable hardening: small arrays inline via tolist (a dict
+    holding a jnp metrics VECTOR must not raise mid-run), oversized
+    arrays summarize instead of flooding the log, and close() flushes +
+    fsyncs so the records survive the process."""
+    path = tmp_path / "run.jsonl"
+    logger = JsonlLogger(path)
+    logger.log(event="step", vec=jnp.arange(3.0),
+               nested={"m": np.ones((2, 2), np.float32)},
+               big=np.zeros((64, 64), np.float32),
+               scalar=jnp.float32(1.5))
+    logger.close()
+    logger.close()                                   # idempotent
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert recs[0]["vec"] == [0.0, 1.0, 2.0]
+    assert recs[0]["nested"]["m"] == [[1.0, 1.0], [1.0, 1.0]]
+    assert recs[0]["big"] == {"__array__": True, "shape": [64, 64],
+                              "dtype": "float32"}
+    assert recs[0]["scalar"] == 1.5

@@ -1,0 +1,303 @@
+"""The continuous-batching serving engine (serve/) against its two hard
+contracts:
+
+1. TOKEN PARITY — with identical prompts/seeds, the engine's per-request
+   outputs are bit-identical to serial `Generator` calls (greedy and
+   seeded top-k), including across a slot-recycle boundary (a request
+   admitted into the slot another vacated mid-run). The engine shares
+   the serial path's prefill program, per-token forward, fold algebra,
+   and sampling rule — this gates that the sharing actually holds.
+2. ZERO RECOMPILATION — after warmup, admitting requests of varying
+   prompt lengths and budgets into a running engine triggers no new XLA
+   compilations (jit cache-size counters).
+
+Plus the scheduling semantics: FIFO admission with backpressure,
+deadlines (queued drop + running cancel), EOS/budget recycling, masked
+no-op appends for dead slots, and the serving metrics rollup.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.models.lm import Generator, attention_lm
+from idc_models_tpu.serve import (
+    LMServer, Request, SlotEngine, load_trace, poisson_trace, save_trace,
+)
+
+VOCAB, SEQ, E, HEADS, MLP, BLOCKS = 11, 32, 32, 2, 64, 2
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = attention_lm(VOCAB, SEQ, embed_dim=E, num_heads=HEADS,
+                         mlp_dim=MLP, num_blocks=BLOCKS)
+    return model.init(jax.random.key(0)).params
+
+
+def _kw(mesh=None):
+    return dict(embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+                t_max=SEQ, mesh=mesh, cache_dtype=jnp.float32)
+
+
+def _serial_tokens(gen, prompt, steps, *, rng=None):
+    """The serial reference: prefill + one fused decode, generated
+    tokens only."""
+    logits, caches = gen.prefill(jnp.asarray([prompt], jnp.int32))
+    toks, _, _ = gen.decode(caches, logits, len(prompt), steps, rng=rng)
+    return toks.tolist()[0]
+
+
+def test_token_parity_and_no_recompile_greedy(devices, params):
+    """The acceptance pair in one run: 8 greedy requests of VARYING
+    prompt lengths and budgets through 3 slots — so slots recycle
+    mid-run — must (a) emit bit-identical tokens to serial Generator
+    calls and (b) grow no jit cache after the warmup + first admission
+    wave."""
+    server = LMServer(params, n_slots=3, window=4, **_kw())
+    rng = np.random.default_rng(5)
+    reqs = [Request(id=f"r{i}",
+                    prompt=tuple(int(x) for x in
+                                 rng.integers(0, VOCAB, 3 + 2 * i)),
+                    max_new_tokens=4 + (i % 5) * 2)
+            for i in range(8)]
+    # first wave: two requests, then freeze the compile counters
+    server.run([(0.0, r) for r in reqs[:2]])
+    sizes = server.engine.cache_sizes()
+    # second wave: six NEW lengths/budgets into the running engine
+    server.run([(0.0, r) for r in reqs[2:]])
+    assert server.engine.cache_sizes() == sizes, (
+        server.engine.cache_sizes(), sizes)
+
+    gen = Generator(params, **_kw())
+    for r in reqs:
+        got = server.poll(r.id)
+        assert got is not None and got.status == "ok"
+        want = _serial_tokens(gen, r.prompt, r.max_new_tokens)
+        assert got.tokens == want, (r.id, got.tokens, want)
+
+
+def test_token_parity_across_slot_recycle(devices, params):
+    """Request C fills the slot request A vacated mid-run (B still
+    decoding) — C's output must equal its serial generation exactly."""
+    eng = SlotEngine(params, n_slots=2, **_kw())
+    eng.warmup(4)
+    rng = np.random.default_rng(7)
+    pa = rng.integers(0, VOCAB, 9)
+    pb = rng.integers(0, VOCAB, 5)
+    pc = rng.integers(0, VOCAB, 13)
+    eng.admit(0, pa, 5)
+    eng.admit(1, pb, 17)
+    got = {0: [], 1: []}
+    got_c, c_admitted = [], False
+    for _ in range(16):
+        for s, t in eng.step_window(4).items():
+            (got_c if (s == 0 and c_admitted) else got[s]).extend(t)
+        if eng.finished(0):
+            eng.release(0)
+            if not c_admitted:
+                eng.admit(0, pc, 7)
+                c_admitted = True
+        if eng.finished(1):
+            eng.release(1)
+        if c_admitted and not eng._occupied.any():
+            break
+    gen = Generator(params, **_kw())
+    assert got[0] == _serial_tokens(gen, tuple(pa), 5)
+    assert got[1] == _serial_tokens(gen, tuple(pb), 17)
+    assert got_c == _serial_tokens(gen, tuple(pc), 7)
+
+
+def test_token_parity_sampled_on_ring(devices, params):
+    """Seeded top-k sampling through the RING-SHARDED engine (caches
+    sharded over a seq=4 mesh): per-request streams must match serial
+    decode with the same per-request key, bit for bit."""
+    mesh = meshlib.seq_mesh(4)
+    server = LMServer(params, n_slots=2, window=4, temperature=1.3,
+                      top_k=4, **_kw(mesh))
+    rng = np.random.default_rng(9)
+    reqs = [Request(id=f"s{i}",
+                    prompt=tuple(int(x) for x in
+                                 rng.integers(0, VOCAB, 4 + 3 * i)),
+                    max_new_tokens=6, seed=100 + i)
+            for i in range(4)]
+    server.run([(0.0, r) for r in reqs])
+    gen = Generator(params, temperature=1.3, top_k=4, **_kw(mesh))
+    for r in reqs:
+        want = _serial_tokens(gen, r.prompt, r.max_new_tokens,
+                              rng=jax.random.key(r.seed))
+        assert server.poll(r.id).tokens == want, r.id
+
+
+def test_eos_stops_and_recycles(devices, params):
+    """A request whose stream hits its stop token finishes early
+    (finish_reason 'eos', EOS included), frees the slot for the queue,
+    and matches the serial stream truncated at the first EOS."""
+    gen = Generator(params, **_kw())
+    prompt = (1, 2, 3)
+    stream = _serial_tokens(gen, prompt, 12)
+    eos = stream[3]                      # guaranteed to appear
+    cut = stream[:stream.index(eos) + 1]
+    server = LMServer(params, n_slots=1, window=4, eos_id=eos, **_kw())
+    out = server.run([(0.0, Request(id="a", prompt=prompt,
+                                    max_new_tokens=12)),
+                      (0.0, Request(id="b", prompt=(4, 5),
+                                    max_new_tokens=3, eos_id=-1))])
+    a = server.poll("a")
+    assert a.finish_reason == "eos" and a.tokens == cut
+    b = server.poll("b")                 # eos_id=-1 opts out
+    assert b.finish_reason == "budget" and len(b.tokens) == 3
+    assert len(out) == 2
+
+
+def test_backpressure_and_rejection(devices, params):
+    """Bounded admission queue: submits beyond max_queue_depth return
+    False; run(on_full='reject') records rejected Results; 'block'
+    (default) serves everything in FIFO order."""
+    server = LMServer(params, n_slots=1, window=4, max_queue_depth=2,
+                      **_kw())
+    reqs = [Request(id=f"q{i}", prompt=(i + 1,), max_new_tokens=2)
+            for i in range(4)]
+    assert server.submit(reqs[0])
+    assert server.submit(reqs[1])
+    assert not server.submit(reqs[2])    # depth 2 -> backpressure
+    server.drain()
+    assert server.poll("q0").status == "ok"
+    rs = server.run([(0.0, Request(id="q9", prompt=(1,), max_new_tokens=2)),
+                     (0.0, Request(id="q10", prompt=(2,), max_new_tokens=2)),
+                     (0.0, Request(id="q11", prompt=(3,), max_new_tokens=2)),
+                     (0.0, Request(id="q12", prompt=(4,), max_new_tokens=2))],
+                    on_full="reject")
+    statuses = {r.id: r.status for r in rs}
+    assert statuses["q11"] == "rejected" or statuses["q12"] == "rejected"
+    # blocking mode serves every request eventually — and a request that
+    # merely WAITED for queue room must not count as rejected
+    server2 = LMServer(params, n_slots=1, window=4, max_queue_depth=2,
+                       **_kw())
+    rs2 = server2.run([(0.0, Request(id=f"b{i}", prompt=(i + 1,),
+                                     max_new_tokens=2))
+                       for i in range(5)])
+    assert sum(r.status == "ok" for r in rs2) == 5
+    assert server2.summary()["serve_rejected"] == 0
+    # duplicate ids are refused while the original is still in flight
+    server2.submit(Request(id="dup", prompt=(1,), max_new_tokens=2))
+    with pytest.raises(ValueError, match="already used"):
+        server2.submit(Request(id="dup", prompt=(2,), max_new_tokens=2))
+    server2.drain()
+    with pytest.raises(ValueError, match="already used"):
+        server2.submit(Request(id="dup", prompt=(2,), max_new_tokens=2))
+
+
+def test_deadlines_queued_and_running(devices, params):
+    """Deadlines on a FAKE clock: a queued request past its deadline
+    times out without occupying a slot; a running request is cancelled
+    mid-generation with its partial tokens returned."""
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    server = LMServer(params, n_slots=1, window=4, clock=clock, **_kw())
+    # "slow" occupies the slot; "late" waits in the queue past its
+    # deadline; "slow" itself dies mid-run at t=1
+    server.submit(Request(id="slow", prompt=(1, 2), max_new_tokens=24,
+                          deadline_s=1.0))
+    server.submit(Request(id="late", prompt=(3,), max_new_tokens=4,
+                          deadline_s=0.5))
+    server.step()                        # admits "slow", first window
+    now[0] = 0.6
+    server.step()                        # expires "late" in the queue
+    late = server.poll("late")
+    assert late.status == "timeout" and late.finish_reason == "deadline"
+    assert late.tokens == []
+    now[0] = 1.1
+    server.step()
+    server.drain()
+    slow = server.poll("slow")
+    assert slow.status == "timeout" and slow.finish_reason == "deadline"
+    assert 0 < len(slow.tokens) < 24     # partial output survives
+    # the vacated slot serves the next request normally
+    server.submit(Request(id="next", prompt=(4,), max_new_tokens=3))
+    server.drain()
+    assert server.poll("next").status == "ok"
+    # both deadline paths count in the summary's timeout field
+    assert server.summary()["serve_timed_out"] == 2
+
+
+def test_dead_slot_cache_untouched(devices, params):
+    """The masked append: windows decoded while a slot is dead leave its
+    cache rows bit-untouched (the recycled request's correctness rests
+    on this, and on insert overwriting the full row)."""
+    eng = SlotEngine(params, n_slots=2, **_kw())
+    eng.warmup(4)
+    eng.admit(0, (1, 2, 3), 4)
+    eng.admit(1, (4, 5), 20)
+    while not eng.finished(0):
+        eng.step_window(4)
+    eng.release(0)
+    before = [np.asarray(kc)[0].copy() for kc, _ in eng._caches]
+    eng.step_window(4)                   # slot 0 dead, slot 1 decoding
+    after = [np.asarray(kc)[0] for kc, _ in eng._caches]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+
+
+def test_admit_rejections(devices, params):
+    eng = SlotEngine(params, n_slots=1, **_kw())
+    with pytest.raises(ValueError, match="exceeds t_max"):
+        eng.admit(0, list(range(SEQ - 2)), 3)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.admit(0, (1, 2), 0)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.admit(0, np.zeros((1, 0), np.int32), 2)
+    eng.admit(0, (1, 2), 2)
+    with pytest.raises(ValueError, match="occupied"):
+        eng.admit(0, (1, 2), 2)
+    with pytest.raises(ValueError, match="seq-only"):
+        SlotEngine(params, n_slots=1, **_kw(meshlib.data_seq_mesh(2, 2)))
+    server = LMServer(params, n_slots=1, temperature=1.0, **_kw())
+    with pytest.raises(ValueError, match="rng"):
+        server.submit(Request(id="x", prompt=(1,), max_new_tokens=2))
+
+
+def test_metrics_summary_and_jsonl(devices, params, tmp_path):
+    """The serving metrics roll up into the bench-record fields and
+    stream through JsonlLogger in the standard record shape."""
+    import json
+
+    from idc_models_tpu.observe import JsonlLogger
+
+    log = tmp_path / "serve.jsonl"
+    with JsonlLogger(log) as logger:
+        server = LMServer(params, n_slots=2, window=4, logger=logger,
+                          **_kw())
+        server.run([(0.0, Request(id=f"m{i}", prompt=(1, 2, 3),
+                                  max_new_tokens=5))
+                    for i in range(3)])
+        s = server.summary()
+    assert s["serve_requests"] == 3 and s["serve_tokens"] == 15
+    assert s["serve_tokens_per_sec"] > 0
+    assert s["serve_ttft_ms_p50"] > 0
+    assert s["serve_ttft_ms_p95"] >= s["serve_ttft_ms_p50"]
+    assert 0 < s["serve_slot_occupancy"] <= 1
+    recs = [json.loads(line) for line in
+            log.read_text().splitlines()]
+    events = {r["event"] for r in recs}
+    assert {"serve_submit", "serve_first_token",
+            "serve_finish"} <= events
+    assert all("ts" in r for r in recs)
+
+
+def test_trace_roundtrip_and_poisson(devices, tmp_path):
+    trace = poisson_trace(6, rate_per_s=100.0, vocab=VOCAB, t_max=SEQ,
+                          seed=3, eos_id=2, deadline_s=5.0, sampled=True)
+    assert len(trace) == 6
+    ts = [t for t, _ in trace]
+    assert ts == sorted(ts) and all(t > 0 for t in ts)
+    for _, r in trace:
+        assert len(r.prompt) + r.max_new_tokens <= SEQ
+        assert r.seed is not None
+    p = save_trace(tmp_path / "t.jsonl", trace)
+    assert load_trace(p) == trace
