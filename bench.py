@@ -533,6 +533,76 @@ def _small_model():
     return small_cnn(10, 3, 1)
 
 
+def bench_federated_robustness(on_accelerator: bool, *, n_clients: int = 10,
+                               n_byzantine: int = 3):
+    """Byzantine-resilience scenario: final federated eval loss with
+    `n_byzantine` of `n_clients` clients running the sign-flip x1000
+    attack (faults.py), robust aggregator vs the weighted mean — the
+    same identical fault plan for both, so the comparison isolates the
+    aggregator. The mean has breakdown point 0 (one attacker steers the
+    server arbitrarily); trimmed mean with trim = n_byzantine bounds
+    every coordinate inside the honest range. The reported
+    `fed_byz_robust_advantage` (mean loss / trimmed loss) is the
+    scenario's headline: >> 1 means the robust path is doing its job."""
+    import jax
+
+    from idc_models_tpu import faults as faults_lib
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.data import synthetic
+    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.data.partition import (
+        pad_clients, partition_clients,
+    )
+    from idc_models_tpu.federated import (
+        get_aggregator, initialize_server, make_fedavg_round,
+        make_federated_eval,
+    )
+    from idc_models_tpu.train import rmsprop
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    n_dev = len(jax.devices())
+    n_mesh = meshlib.largest_dividing_mesh(n_clients, n_dev)
+    per_client = 128 if on_accelerator else 16
+    size = 50 if on_accelerator else 10
+    rounds = 8 if on_accelerator else 3
+    model = _small_model()
+    mesh = meshlib.client_mesh(n_mesh)
+    imgs, labels = synthetic.make_idc_like(n_clients * per_client,
+                                           size=size, seed=0)
+    ci, cl = partition_clients(ArrayDataset(imgs, labels), n_clients,
+                               iid=True, seed=0)
+    w = np.full((n_clients,), per_client, np.float32)
+    ci, cl, w = pad_clients(ci, cl, w, multiple=n_mesh)
+    ci = jax.device_put(ci, meshlib.sharding(mesh, meshlib.CLIENT_AXIS))
+    cl = jax.device_put(cl, meshlib.sharding(mesh, meshlib.CLIENT_AXIS))
+    plan = faults_lib.FaultPlan.byzantine(
+        n_clients, n_byzantine, kind="sign_flip", scale=1000.0, seed=7)
+    eval_fn = make_federated_eval(model, binary_cross_entropy, mesh)
+
+    def final_loss(agg):
+        server = initialize_server(model, jax.random.key(0))
+        rnd = make_fedavg_round(model, rmsprop(1e-3),
+                                binary_cross_entropy, mesh,
+                                local_epochs=1, batch_size=16,
+                                aggregator=agg, faults=plan)
+        for r in range(rounds):
+            server, _ = rnd(server, ci, cl, w,
+                            jax.random.fold_in(jax.random.key(1), r))
+        return float(eval_fn(server, ci, cl, w)["loss"])
+
+    mean_loss = final_loss(None)
+    trimmed_loss = final_loss(get_aggregator("trimmed_mean",
+                                             trim=n_byzantine))
+    return {
+        "fed_byz_clients": n_byzantine,
+        "fed_byz_total_clients": n_clients,
+        "fed_byz_rounds": rounds,
+        "fed_byz_mean_eval_loss": round(mean_loss, 4),
+        "fed_byz_trimmed_eval_loss": round(trimmed_loss, 4),
+        "fed_byz_robust_advantage": round(mean_loss / trimmed_loss, 2),
+    }
+
+
 def bench_secure_round(on_accelerator: bool):
     """Secure-aggregation round wall-clock at the reference's scale: 8
     small-CNN clients (secure_fed_model.py:41), pairwise-masked
@@ -824,6 +894,7 @@ def main() -> None:
     ring.update(bench_attention_model_step(on_accelerator))
     ring.update(bench_lm_decode(on_accelerator))
     ring.update(bench_serving(on_accelerator))
+    ring.update(bench_federated_robustness(on_accelerator))
     if on_accelerator:
         # second headline sample, minutes after the first (the shared
         # chip's load drifts on that timescale; back-to-back windows

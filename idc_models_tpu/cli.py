@@ -122,6 +122,38 @@ def _parse(argv):
                     help="save the federated server state every N rounds "
                          "(plus once at the end); a per-round blocking "
                          "orbax save would dominate the ~50 ms round")
+    sp.add_argument("--aggregator", default="mean",
+                    choices=("mean", "trimmed_mean", "median",
+                             "norm_clip"),
+                    help="round-boundary aggregation "
+                         "(federated/robust.py): mean = example-"
+                         "weighted FedAvg; trimmed_mean/median bound "
+                         "Byzantine influence coordinate-wise; "
+                         "norm_clip L2-clips each client's update")
+    sp.add_argument("--trim", type=int, default=1,
+                    help="clients trimmed per side with "
+                         "--aggregator trimmed_mean (tolerates that "
+                         "many Byzantine clients; needs > 2*trim "
+                         "participants)")
+    sp.add_argument("--clip-norm", type=float, default=10.0,
+                    help="per-client update L2 bound with "
+                         "--aggregator norm_clip")
+    sp.add_argument("--faults", default=None,
+                    help="fault-injection plan (faults.py), e.g. "
+                         "'sign_flip:0-2:x1000,crash:5' — deterministic "
+                         "per-round client faults applied before "
+                         "aggregation, for resilience drills")
+    sp.add_argument("--round-timeout", type=float, default=None,
+                    help="per-round wall budget in seconds; a slower "
+                         "round is discarded and retried with a "
+                         "reseeded client subset (federated/driver.py)")
+    sp.add_argument("--max-round-retries", type=int, default=2,
+                    help="retries per failed round before the run "
+                         "aborts with RoundFailure")
+    sp.add_argument("--loss-spike-ratio", type=float, default=10.0,
+                    help="divergence detector: a round whose train loss "
+                         "exceeds this multiple of the last good "
+                         "round's is rolled back (0 disables)")
 
     sp = sub.add_parser("secure-fed", aliases=["secure_fed"],
                         help="secure-aggregation FedAvg")
@@ -881,9 +913,10 @@ def _run_fed(ns):
     from idc_models_tpu.data.partition import (
         pad_clients, partition_clients, train_test_client_split,
     )
+    from idc_models_tpu import faults as faults_lib
     from idc_models_tpu.federated import (
-        initialize_server, make_fedavg_round, make_federated_eval,
-        seed_server_with,
+        DriverConfig, RoundFailure, initialize_server, make_fedavg_round,
+        make_federated_eval, run_rounds, seed_server_with,
     )
     from idc_models_tpu.models import registry
     from idc_models_tpu.observe import Timer, profile_trace
@@ -973,9 +1006,20 @@ def _run_fed(ns):
     # restored/pretrained arrays may live on a single device; the round
     # program wants them replicated over the client mesh
     server = jax.device_put(server, meshlib.replicated(mesh))
-    round_fn = make_fedavg_round(model, opt, _loss_for(preset.num_outputs),
-                                 mesh, local_epochs=preset.local_epochs,
-                                 batch_size=preset.batch_size)
+    plan = None
+    if getattr(ns, "faults", None):
+        plan = faults_lib.parse_fault_spec(ns.faults, n_clients)
+        print(f"[idc_models_tpu] injecting faults: {plan}",
+              file=sys.stderr)
+    from idc_models_tpu.federated import robust
+
+    agg_name = getattr(ns, "aggregator", "mean")
+    agg_kw = ({"trim": ns.trim} if agg_name == "trimmed_mean" else
+              {"max_norm": ns.clip_norm} if agg_name == "norm_clip" else {})
+    round_fn = make_fedavg_round(
+        model, opt, _loss_for(preset.num_outputs), mesh,
+        local_epochs=preset.local_epochs, batch_size=preset.batch_size,
+        aggregator=robust.get_aggregator(agg_name, **agg_kw), faults=plan)
     eval_fn = make_federated_eval(model, _loss_for(preset.num_outputs), mesh)
     print("round, train_loss, train_acc, test_loss, test_acc")
     every = max(int(getattr(ns, "checkpoint_every", 10)), 1)
@@ -997,40 +1041,76 @@ def _run_fed(ns):
                 continue
             if rec.get("event") == "round":
                 logged_through = max(logged_through, int(rec["round"]))
-    with Timer("Federated training", logger=logger), \
-            profile_trace(ns.profile_dir):
-        for r in range(int(server.round), preset.rounds):
-            # fold the round index so resumed runs reproduce the exact
-            # rng stream a straight-through run would have used
-            sub = jax.random.fold_in(jax.random.key(ns.seed + 1), r)
-            server, tm = round_fn(server, imgs, labels, w_train, sub)
-            em = eval_fn(server, imgs, labels, w_test)
-            # ONE host fetch for every metric: on a tunneled runtime each
-            # individual scalar fetch is a full ~50-90 ms sync
-            # round-trip, which at six per round costs 10x the 46 ms
-            # round itself (measured: 1.08 s/round before, ~0.2 after)
-            tm, em = _fetch_scalars((tm, em))
-            print(f"{r}, {float(tm['loss']):.4f}, "
-                  f"{float(tm['accuracy']):.4f}, {float(em['loss']):.4f}, "
-                  f"{float(em['accuracy']):.4f}")
-            dropped = int(tm.get("clients_dropped", 0))
-            if dropped:
-                print(f"[idc_models_tpu] round {r}: dropped {dropped} "
-                      f"client(s) with non-finite updates from the "
-                      f"aggregate", file=sys.stderr)
-            if logger and r > logged_through:
-                logger.log(event="round", round=r,
-                           train_loss=tm["loss"], train_acc=tm["accuracy"],
-                           test_loss=em["loss"], test_acc=em["accuracy"],
-                           clients_dropped=dropped)
-            # checkpoint every N rounds, not every round: the synchronous
-            # orbax save costs multiples of the ~50 ms round itself, and
-            # resume-from-round-(r - r % N) replays the identical rng
-            # stream anyway (fold_in(round) above)
-            if server_ckpt is not None and (r + 1) % every == 0:
-                save_checkpoint(server_ckpt, jax.device_get(server))
-    if server_ckpt is not None and int(server.round) % every != 0:
-        save_checkpoint(server_ckpt, jax.device_get(server))
+    def eval_round(sv):
+        # ONE host fetch for every metric: on a tunneled runtime each
+        # individual scalar fetch is a full ~50-90 ms sync round-trip,
+        # which at six per round costs 10x the 46 ms round itself
+        em = _fetch_scalars(eval_fn(sv, imgs, labels, w_test))
+        return {"test_loss": float(em["loss"]),
+                "test_acc": float(em["accuracy"])}
+
+    def print_round(entry):
+        print(f"{entry['round']}, {entry['loss']:.4f}, "
+              f"{entry['accuracy']:.4f}, {entry['test_loss']:.4f}, "
+              f"{entry['test_acc']:.4f}")
+        # the CLI owns the `round` jsonl records (driver logs only
+        # round_health) so the historical field names — train_loss/
+        # train_acc, consumed by existing run.jsonl tooling — survive
+        # the move to the driver
+        if entry.get("trim_degenerate"):
+            print(f"[idc_models_tpu] round {entry['round']}: trimmed "
+                  f"mean had NO kept band (live clients <= 2*trim) — "
+                  f"the server state was left UNCHANGED this round; "
+                  f"lower --trim or enroll more clients",
+                  file=sys.stderr)
+        if logger and entry["round"] > logged_through:
+            logger.log(event="round", round=entry["round"],
+                       train_loss=entry["loss"],
+                       train_acc=entry["accuracy"],
+                       test_loss=entry["test_loss"],
+                       test_acc=entry["test_acc"],
+                       clients_dropped=int(
+                           entry.get("clients_dropped", 0)))
+
+    spike = getattr(ns, "loss_spike_ratio", 10.0)
+    if spike is not None and spike != 0 and spike <= 1:
+        # only the documented 0 disables; negatives and (0, 1] are
+        # configuration mistakes that must not silently turn the
+        # divergence detector off
+        sys.exit(f"--loss-spike-ratio {spike} must be > 1 (a round is "
+                 f"rolled back when its loss exceeds ratio x the last "
+                 f"good loss; 0 disables the detector)")
+    config = DriverConfig(
+        rounds=preset.rounds,
+        timeout_s=getattr(ns, "round_timeout", None),
+        max_attempts=1 + max(int(getattr(ns, "max_round_retries", 2)), 0),
+        loss_spike_ratio=spike if spike and spike > 1 else None,
+        checkpoint_path=server_ckpt, checkpoint_every=every)
+    # the self-healing driver (federated/driver.py) owns the round loop:
+    # per-round wall budget, reseeded-subset retry, divergence rollback,
+    # periodic checkpoints, and round_health jsonl events
+    try:
+        with Timer("Federated training", logger=logger), \
+                profile_trace(ns.profile_dir):
+            result = run_rounds(
+                round_fn, server, imgs, labels, w_train, config=config,
+                seed=ns.seed + 1, eval_fn=eval_round,
+                on_round=print_round, logger=logger, verbose=True,
+                log_from_round=logged_through, log_round_records=False)
+    except RoundFailure as e:
+        sys.exit(f"[idc_models_tpu] federated training aborted: {e}")
+    server = result.server
+    for entry in result.history:
+        dropped = int(entry.get("clients_dropped", 0))
+        if dropped:
+            print(f"[idc_models_tpu] round {entry['round']}: dropped "
+                  f"{dropped} client(s) with non-finite updates from "
+                  f"the aggregate", file=sys.stderr)
+    retried = [e for e in result.events if e["status"] != "ok"]
+    if retried:
+        print(f"[idc_models_tpu] {len(retried)} round attempt(s) "
+              f"failed and were healed (rollback/reseed); see "
+              f"round_health events", file=sys.stderr)
     if logger:
         logger.close()
 

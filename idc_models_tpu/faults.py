@@ -1,0 +1,302 @@
+"""Deterministic, seeded fault injection for federated training.
+
+The reference's federated path has NO failure handling (SURVEY.md §5): a
+crashed, straggling, or poisoned client corrupts the FedAvg round
+silently. To build — and regression-test — the resilience layer
+(`federated/robust.py` aggregators, `federated/driver.py` self-healing
+driver), failures must be reproducible: this module provides declarative
+per-client fault plans that are pure functions of (plan, round), so the
+same plan replays bit-identically across runs.
+
+Faults are applied to the client UPDATE tensors after local training and
+before aggregation (threaded through `make_fedavg_round(faults=plan)`),
+which is where every real failure mode lands from the server's point of
+view:
+
+- ``crash``      the client never reports: its aggregation weight is
+                 forced to 0 (indistinguishable from a dropped
+                 connection);
+- ``straggler``  the client reports params from round r−k (its local
+                 training raced a stale broadcast);
+- ``nan`` / ``inf``  a poisoner (or a genuinely diverged client) reports
+                 non-finite tensors — caught by ``drop_nonfinite``;
+- ``scale``      a gradient-scaling attacker reports
+                 server + scale·(update − server): finite but huge, so
+                 finite-ness checks can NOT catch it (the gap robust
+                 aggregators close);
+- ``sign_flip``  the canonical Byzantine attacker reports
+                 server − scale·(update − server), pushing the mean
+                 AWAY from descent while staying finite.
+
+Plus generic hooks for transient data-pipeline read failures
+(`flaky` / `with_retries`), seeded the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections.abc import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fault codes — the integers the jitted round program branches on
+OK = 0
+CRASH = 1
+STRAGGLER = 2
+NAN = 3
+INF = 4
+SCALE = 5
+SIGN_FLIP = 6
+
+KINDS = ("crash", "straggler", "nan", "inf", "scale", "sign_flip")
+_CODE = {"crash": CRASH, "straggler": STRAGGLER, "nan": NAN, "inf": INF,
+         "scale": SCALE, "sign_flip": SIGN_FLIP}
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One declarative fault: `kind` applied to `client` on `rounds`
+    (None = every round). `scale` parameterizes the scale/sign_flip
+    attackers; `staleness` is the straggler's lag k (params from round
+    r−k)."""
+
+    kind: str
+    client: int
+    rounds: tuple[int, ...] | None = None
+    scale: float = 1.0
+    staleness: int = 1
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {KINDS}")
+        if self.client < 0:
+            raise ValueError(f"client must be >= 0, got {self.client}")
+        if not np.isfinite(self.scale):
+            raise ValueError(f"scale must be finite, got {self.scale} "
+                             f"(use kind='nan'/'inf' for non-finite "
+                             f"poisoning)")
+        if self.staleness < 1:
+            raise ValueError(f"staleness must be >= 1, got "
+                             f"{self.staleness}")
+        if self.rounds is not None:
+            object.__setattr__(self, "rounds",
+                               tuple(int(r) for r in self.rounds))
+
+
+class FaultPlan:
+    """A deterministic per-client fault schedule for a federated run.
+
+    `codes(r)` is a pure function of the plan and the round index, so a
+    run under the plan replays bit-identically: same plan + same rng
+    seed -> same round trajectory, down to the last bit (gated by
+    test_faults.py). When several faults name the same client for the
+    same round, the LAST one listed wins.
+    """
+
+    def __init__(self, n_clients: int, faults: Sequence[Fault] = ()):
+        if n_clients < 1:
+            raise ValueError(f"need n_clients >= 1, got {n_clients}")
+        self.n_clients = int(n_clients)
+        self.faults = tuple(faults)
+        for f in self.faults:
+            if f.client >= self.n_clients:
+                raise ValueError(
+                    f"fault {f.kind!r} names client {f.client} but the "
+                    f"plan covers {self.n_clients} clients")
+        lags = {f.staleness for f in self.faults
+                if f.kind == "straggler"}
+        if len(lags) > 1:
+            # ONE stale server tree is threaded through the jitted
+            # round per call, so mixed lags would silently collapse to
+            # the max — refuse rather than run a different fault model
+            # than the plan declares
+            raise ValueError(
+                f"straggler faults in one plan must share a single "
+                f"staleness, got {sorted(lags)}; use separate plans "
+                f"(or rounds=) for mixed lags")
+
+    @classmethod
+    def byzantine(cls, n_clients: int, n_byzantine: int, *,
+                  kind: str = "sign_flip", scale: float = 1.0,
+                  seed: int = 0,
+                  rounds: Sequence[int] | None = None) -> "FaultPlan":
+        """Seeded attacker sampling: `n_byzantine` distinct clients are
+        drawn with `seed` and given the same attack. The draw is
+        deterministic — the canonical way to build the "k of n clients
+        are Byzantine" experiment reproducibly."""
+        if not 0 <= n_byzantine <= n_clients:
+            raise ValueError(f"need 0 <= n_byzantine <= {n_clients}, "
+                             f"got {n_byzantine}")
+        ids = np.random.default_rng(seed).choice(
+            n_clients, size=n_byzantine, replace=False)
+        return cls(n_clients, [
+            Fault(kind, int(c), rounds=tuple(rounds) if rounds else None,
+                  scale=scale) for c in sorted(ids)])
+
+    def active(self, round_idx: int) -> list[Fault]:
+        return [f for f in self.faults
+                if f.rounds is None or round_idx in f.rounds]
+
+    def codes(self, round_idx: int) -> tuple[np.ndarray, np.ndarray]:
+        """(codes [n_clients] int32, scales [n_clients] float32) for one
+        round — the arrays the jitted round program branches on."""
+        codes = np.zeros((self.n_clients,), np.int32)
+        scales = np.ones((self.n_clients,), np.float32)
+        for f in self.active(round_idx):
+            codes[f.client] = _CODE[f.kind]
+            scales[f.client] = f.scale
+        return codes, scales
+
+    def staleness(self, round_idx: int) -> int:
+        """The stale-params lag k for this round's stragglers (max over
+        the round's active straggler faults; 1 when none)."""
+        ks = [f.staleness for f in self.active(round_idx)
+              if f.kind == "straggler"]
+        return max(ks) if ks else 1
+
+    @property
+    def max_staleness(self) -> int:
+        ks = [f.staleness for f in self.faults if f.kind == "straggler"]
+        return max(ks) if ks else 0
+
+    def __repr__(self) -> str:
+        return (f"FaultPlan(n_clients={self.n_clients}, "
+                f"faults={list(self.faults)!r})")
+
+
+def parse_fault_spec(spec: str, n_clients: int) -> FaultPlan:
+    """CLI fault grammar: comma-separated ``kind:clients[:param]``
+    groups, clients as a single id, an inclusive ``a-b`` range, or a
+    ``+``-joined list. The third field is the kind's OWN parameter —
+    scale (optionally ``x``-prefixed) for scale/sign_flip, staleness
+    lag for straggler — and is rejected for kinds that take none
+    (crash/nan/inf), so a mistyped drill fails loudly instead of
+    silently running a different fault model.
+
+        "sign_flip:0-2:x1000,crash:5"     3 sign-flip attackers + crash
+        "scale:1+4:100"                   2 scaling attackers
+        "straggler:3:2"                   one straggler at lag 2
+    """
+    faults: list[Fault] = []
+    for group in spec.split(","):
+        group = group.strip()
+        if not group:
+            continue
+        parts = group.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"bad fault group {group!r}: want kind:clients[:param]")
+        kind, clients = parts[0].strip(), parts[1].strip()
+        kw = {}
+        if len(parts) == 3:
+            param = parts[2].strip()
+            if kind in ("scale", "sign_flip"):
+                kw["scale"] = float(param.lstrip("x"))
+            elif kind == "straggler":
+                kw["staleness"] = int(param)
+            else:
+                raise ValueError(
+                    f"fault kind {kind!r} takes no parameter, got "
+                    f"{param!r} in group {group!r}")
+        if "-" in clients:
+            a, b = clients.split("-", 1)
+            ids = range(int(a), int(b) + 1)
+        else:
+            ids = [int(c) for c in clients.split("+")]
+        faults.extend(Fault(kind, int(c), **kw) for c in ids)
+    return FaultPlan(n_clients, faults)
+
+
+def apply_faults(codes, scales, new_params, new_model_state, weight,
+                 params, model_state, stale_params, stale_state):
+    """Apply one round's fault codes to a device's k client updates —
+    jit-traceable, called inside the round's shard_map body.
+
+    `codes`/`scales`/`weight` are [k]; `new_*` leaves carry the leading
+    [k] client axis; `params`/`model_state` are the incoming (broadcast)
+    server trees and `stale_*` the round-(r−k) server trees. Non-float
+    leaves pass through untouched (integer state cannot carry NaN and is
+    not a gradient target). Returns the faulted (new_params,
+    new_model_state, weight).
+    """
+    k = codes.shape[0]
+    weight = jnp.where(codes == CRASH, 0.0, weight)
+
+    def leafwise(new, server, stale):
+        if not jnp.issubdtype(new.dtype, jnp.inexact):
+            return new
+        shape = (k,) + (1,) * (new.ndim - 1)
+        c = codes.reshape(shape)
+        s = scales.reshape(shape).astype(new.dtype)
+        delta = new - server[None]
+        out = jnp.where(c == STRAGGLER, stale[None], new)
+        out = jnp.where(c == NAN, jnp.asarray(jnp.nan, new.dtype), out)
+        out = jnp.where(c == INF, jnp.asarray(jnp.inf, new.dtype), out)
+        out = jnp.where(c == SCALE, server[None] + s * delta, out)
+        out = jnp.where(c == SIGN_FLIP, server[None] - s * delta, out)
+        return out
+
+    new_params = jax.tree.map(leafwise, new_params, params, stale_params)
+    new_model_state = jax.tree.map(leafwise, new_model_state, model_state,
+                                   stale_state)
+    return new_params, new_model_state, weight
+
+
+# ---------------------------------------------------------------------------
+# Transient data-pipeline read failures
+# ---------------------------------------------------------------------------
+
+
+class TransientReadError(IOError):
+    """An injected transient read failure (the retryable kind: NFS blip,
+    object-store 5xx, preempted decode worker)."""
+
+
+def flaky(fn: Callable, *, failure_rate: float, seed: int = 0,
+          exception=TransientReadError) -> Callable:
+    """Wrap a read callable so a seeded `failure_rate` fraction of calls
+    raises `exception` BEFORE invoking `fn`. Which call indices fail is
+    a pure function of (seed, index): two wrappers built with the same
+    seed fail on exactly the same calls — deterministic chaos, so a
+    pipeline-hardening test can replay its failure schedule."""
+    if not 0.0 <= failure_rate <= 1.0:
+        raise ValueError(f"failure_rate must be in [0, 1], got "
+                         f"{failure_rate}")
+    counter = {"i": 0}
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        i = counter["i"]
+        counter["i"] += 1
+        if np.random.default_rng((seed, i)).random() < failure_rate:
+            raise exception(f"injected transient read failure "
+                            f"(call {i}, seed {seed})")
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def with_retries(fn: Callable, *, attempts: int = 3,
+                 exceptions=(TransientReadError,)) -> Callable:
+    """Retry `fn` up to `attempts` times on the given transient
+    exceptions, re-raising the last failure — the consumer-side hook
+    that turns an injected (or real) transient read failure into a
+    bounded retry instead of a dead pipeline."""
+    if attempts < 1:
+        raise ValueError(f"need attempts >= 1, got {attempts}")
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        for attempt in range(attempts):
+            try:
+                return fn(*args, **kwargs)
+            except exceptions:
+                if attempt == attempts - 1:
+                    raise
+        raise AssertionError("unreachable")
+
+    return wrapped

@@ -5,3 +5,17 @@ from idc_models_tpu.federated.fedavg import (  # noqa: F401
     make_federated_eval,
     seed_server_with,
 )
+from idc_models_tpu.federated.robust import (  # noqa: F401
+    Aggregator,
+    Median,
+    NormClip,
+    TrimmedMean,
+    WeightedMean,
+    get_aggregator,
+)
+from idc_models_tpu.federated.driver import (  # noqa: F401
+    DriverConfig,
+    DriverResult,
+    RoundFailure,
+    run_rounds,
+)

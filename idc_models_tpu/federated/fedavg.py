@@ -144,6 +144,18 @@ def make_local_trainer(
     return local_train
 
 
+_copy_tree = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+
+def copy_tree(tree):
+    """Deep-copy a pytree into FRESH device buffers — jnp.copy under a
+    non-donating jit. Snapshots taken this way survive a later donation
+    of the original arrays (the round programs donate their incoming
+    server state), which is what the driver's rollback anchor and the
+    fault harness's straggler history rely on."""
+    return _copy_tree(tree)
+
+
 def finite_clients(k: int, *trees) -> jax.Array:
     """[k] bool: which of a device's k vmapped clients produced an
     all-finite local result (every leaf of `trees` carries the leading
@@ -167,6 +179,8 @@ def make_fedavg_round(
     batch_size: int = 32,
     compute_dtype=jnp.float32,
     drop_nonfinite: bool = True,
+    aggregator=None,
+    faults=None,
 ):
     """Build the jitted one-round FedAvg program.
 
@@ -190,16 +204,36 @@ def make_fedavg_round(
       aggregate and the metrics without the caller having to know it
       died (the reference has no failure detection at all, SURVEY.md §5;
       `fed_metrics["clients_dropped"]` reports how many were cut);
+    - ``aggregator`` selects the round-boundary aggregation
+      (`federated/robust.py`): None keeps the example-weighted mean
+      bit-for-bit; "trimmed_mean"/"median"/"norm_clip" (or an
+      `robust.Aggregator` instance) bound the influence of
+      finite-but-malicious updates that drop_nonfinite cannot see, and
+      add their own metrics (clients_clipped / clients_trimmed);
+    - ``faults`` is an optional `faults.FaultPlan`: the plan's per-round
+      fault codes are applied to the client update tensors after local
+      training and BEFORE detection/aggregation (crash, straggler,
+      NaN/Inf poison, scale, sign-flip — see faults.py), deterministic
+      per (plan, round) so runs replay bit-identically. Stale straggler
+      params come from an internal per-round history of server states
+      (depth = the plan's max staleness);
     - metrics are the example-weighted means of per-client local-training
       loss/accuracy over all local steps (the `train_metrics` half of the
       reference's per-round CSV print, fed_model.py:229).
     """
+    from idc_models_tpu import faults as faults_lib
+    from idc_models_tpu.federated import robust
+
+    agg_fn = robust.get_aggregator(aggregator)
     n_devices = mesh.shape[meshlib.CLIENT_AXIS]
     local_train = make_local_trainer(
         model, optimizer, loss_fn, local_epochs=local_epochs,
         batch_size=batch_size, compute_dtype=compute_dtype)
+    with_faults = faults is not None
 
-    def per_device(params, model_state, imgs, labels, weight, rng):
+    def per_device(params, model_state, imgs, labels, weight, rng,
+                   codes=None, scales=None, stale_params=None,
+                   stale_state=None):
         # shard_map gives each device a [k, S, ...] block: its k clients.
         k = imgs.shape[0]
         dev = collectives.axis_index(meshlib.CLIENT_AXIS)
@@ -212,6 +246,14 @@ def make_fedavg_round(
             local_train, in_axes=(None, None, 0, 0, 0))(
             params, model_state, imgs, labels, rngs)
 
+        if with_faults:
+            # injected failures perturb the UPDATE tensors, upstream of
+            # detection and aggregation — exactly where real crashes/
+            # stragglers/attackers land from the server's point of view
+            new_params, new_model_state, weight = faults_lib.apply_faults(
+                codes, scales, new_params, new_model_state, weight,
+                params, model_state, stale_params, stale_state)
+
         dropped = jnp.zeros((), jnp.float32)
         if drop_nonfinite:
             # failure detection: cut any client whose update went
@@ -223,9 +265,10 @@ def make_fedavg_round(
             weight = jnp.where(ok, weight, 0.0)
 
         # Round boundary: the only collectives in the program.
-        agg = collectives.weighted_pmean_local(
+        agg, agg_metrics = agg_fn(
             {"params": new_params, "model_state": new_model_state},
-            weight, meshlib.CLIENT_AXIS)
+            weight, {"params": params, "model_state": model_state},
+            meshlib.CLIENT_AXIS)
         metrics = collectives.weighted_pmean_local(
             {"loss": jnp.mean(losses, axis=tuple(range(1, losses.ndim))),
              "accuracy": jnp.mean(accs, axis=tuple(range(1, accs.ndim)))},
@@ -241,30 +284,79 @@ def make_fedavg_round(
             lambda x: jnp.where(any_alive, x, jnp.float32(jnp.nan)),
             metrics)
         metrics["clients_dropped"] = dropped
+        metrics.update(agg_metrics)
         agg = jax.tree.map(
             lambda new, old: jnp.where(any_alive, new, old), agg,
             {"params": params, "model_state": model_state})
         return agg["params"], agg["model_state"], metrics
 
+    fault_specs = ((P(meshlib.CLIENT_AXIS), P(meshlib.CLIENT_AXIS),
+                    P(), P()) if with_faults else ())
     mapped = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(), P(), P(meshlib.CLIENT_AXIS), P(meshlib.CLIENT_AXIS),
-                  P(meshlib.CLIENT_AXIS), P()),
+                  P(meshlib.CLIENT_AXIS), P()) + fault_specs,
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
 
-    def round_fn(server: ServerState, images, labels, weights, rng):
-        _check_client_shapes(images, weights, n_devices)
+    if not with_faults:
+        def round_fn(server: ServerState, images, labels, weights, rng):
+            _check_client_shapes(images, weights, n_devices)
+            params, model_state, metrics = mapped(
+                server.params, server.model_state, images, labels,
+                jnp.asarray(weights, jnp.float32), rng)
+            new_server = server.replace(
+                round=server.round + 1, params=params,
+                model_state=model_state)
+            return new_server, metrics
+
+        return jax.jit(round_fn, donate_argnums=(0,))
+
+    def round_core(server, images, labels, weights, rng, codes, scales,
+                   stale_params, stale_state):
         params, model_state, metrics = mapped(
             server.params, server.model_state, images, labels,
-            jnp.asarray(weights, jnp.float32), rng)
+            jnp.asarray(weights, jnp.float32), rng, codes, scales,
+            stale_params, stale_state)
         new_server = server.replace(
-            round=server.round + 1, params=params, model_state=model_state)
+            round=server.round + 1, params=params,
+            model_state=model_state)
         return new_server, metrics
 
-    return jax.jit(round_fn, donate_argnums=(0,))
+    jitted = jax.jit(round_core, donate_argnums=(0,))
+    history: dict[int, Any] = {}
+
+    def faulty_round_fn(server: ServerState, images, labels, weights,
+                        rng, *, round_idx: int | None = None):
+        _check_client_shapes(images, weights, n_devices)
+        c = images.shape[0]
+        if faults.n_clients > c:
+            raise ValueError(
+                f"fault plan covers {faults.n_clients} clients but only "
+                f"{c} client shards were passed")
+        r = int(server.round) if round_idx is None else int(round_idx)
+        codes, scales = faults.codes(r)
+        codes = np.concatenate(
+            [codes, np.zeros((c - faults.n_clients,), np.int32)])
+        scales = np.concatenate(
+            [scales, np.ones((c - faults.n_clients,), np.float32)])
+        # straggler history: the server state ENTERING each round, keyed
+        # by round index; round r staleness k replays history[r-k]
+        # (clamped to the oldest retained entry on early rounds)
+        history[r] = copy_tree((server.params, server.model_state))
+        for old_r in [x for x in history
+                      if x < r - max(faults.max_staleness, 1)]:
+            del history[old_r]
+        want = r - faults.staleness(r)
+        stale = history.get(want, history[min(history)])
+        new_server, metrics = jitted(
+            server, images, labels, weights, rng, jnp.asarray(codes),
+            jnp.asarray(scales), *stale)
+        return new_server, metrics
+
+    return faulty_round_fn
 
 
 def _check_client_shapes(images, weights, n_devices: int) -> None:

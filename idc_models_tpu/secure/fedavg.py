@@ -107,6 +107,7 @@ def make_secure_fedavg_round(
     compute_dtype=jnp.float32,
     mask_impl: str = "threefry",
     recover_nonfinite: bool = True,
+    aggregator=None,
 ):
     """Build the jitted one-round secure-FedAvg program.
 
@@ -158,7 +159,29 @@ def make_secure_fedavg_round(
     and is excluded from the training metrics;
     ``metrics["clients_recovered"]`` reports the count. The reference
     has no failure handling at all (SURVEY.md §5).
+
+    ``aggregator`` (federated/robust.py) must be SECURE-COMPATIBLE: the
+    masked path sums quantized per-client contributions, so only
+    aggregators that are a per-client transform followed by a mean can
+    ride it — "mean" (default) and "norm_clip" (clip each client's
+    update delta before quantization/masking; the Byzantine-influence
+    bound then holds against the masked aggregate too, and
+    ``metrics["clients_clipped"]`` reports the count). trimmed_mean /
+    median need plaintext cross-client views per coordinate — exactly
+    what the protocol forbids — and are rejected at build time.
     """
+    from idc_models_tpu.federated import robust
+
+    agg = robust.get_aggregator(aggregator)
+    if not agg.secure_compatible:
+        raise ValueError(
+            f"aggregator {agg!r} is not compatible with secure "
+            f"aggregation: the masked path sums quantized per-client "
+            f"contributions, so only per-client-transform + mean "
+            f"aggregators (mean, norm_clip) can ride it; trimmed_mean/"
+            f"median need plaintext cross-client views, which the "
+            f"protocol exists to prevent — use the plain "
+            f"make_fedavg_round for those")
     if mask_impl not in ("auto", "threefry", "pallas"):
         raise ValueError(f"unknown mask_impl {mask_impl!r}")
     # platform decisions key on the MESH's devices, not the process
@@ -215,6 +238,21 @@ def make_secure_fedavg_round(
                 new_params = jax.tree.map(keep, new_params, params)
                 new_model_state = jax.tree.map(keep, new_model_state,
                                                model_state)
+
+            # secure-compatible robustness: the per-client transform
+            # (e.g. norm_clip's delta clipping) runs BEFORE quantization
+            # and masking, so the aggregate the server unmasks is
+            # already influence-bounded; metrics count real live clients
+            upd, per_client_m = agg.per_client(
+                {"params": new_params, "model_state": new_model_state},
+                {"params": params, "model_state": model_state})
+            new_params = upd["params"]
+            new_model_state = upd["model_state"]
+            agg_metrics = {
+                key: collectives.psum(
+                    jnp.sum(jnp.where(ok & real, vals, 0.0)),
+                    meshlib.CLIENT_AXIS)
+                for key, vals in per_client_m.items()}
 
             # "First fraction" follows the model's layer order over the
             # FULL get_weights() enumeration — params and BN moving
@@ -317,6 +355,7 @@ def make_secure_fedavg_round(
             # lone finite 0 that a finite-filtering consumer would keep
             metrics["clip_saturated"] = jnp.where(
                 alive > 0, clip_saturated, jnp.float32(jnp.nan))
+            metrics.update(agg_metrics)
             return agg_params, agg_state, metrics
 
         return per_device

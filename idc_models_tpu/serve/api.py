@@ -53,14 +53,17 @@ class Result:
     """What came back: `tokens` are the GENERATED ids only (prompt not
     echoed), truncated at EOS (inclusive) when one is configured.
     `status` is "ok" (ran to EOS/budget), "timeout" (deadline hit —
-    possibly with partial tokens), or "rejected" (queue full at submit
-    with on_full="reject")."""
+    possibly with partial tokens), "rejected" (queue full at submit
+    with on_full="reject"), or "error" (the engine failed mid-flight;
+    `error` carries the failure detail and `tokens` whatever was
+    generated before it)."""
     id: str
     tokens: list
     status: str
     finish_reason: str | None = None
     ttft_ms: float | None = None
     latency_ms: float | None = None
+    error: str | None = None
 
 
 class LMServer:
@@ -133,9 +136,21 @@ class LMServer:
 
     def step(self) -> list[Result]:
         """One scheduler tick (admissions + one fused decode window);
-        returns the requests that finished on it."""
+        returns the requests that finished on it. If the ENGINE fails
+        mid-tick the error propagates, but the in-flight requests are
+        first recorded as status="error" Results (slots released, queue
+        intact) so poll() answers for them and a recovering caller can
+        keep serving."""
         finished = []
-        for e in self.scheduler.tick():
+        try:
+            ticked = self.scheduler.tick()
+        except Exception:
+            for e in self.scheduler.pop_failed():
+                r = _to_result(e)
+                self._results[r.id] = r
+                self._inflight.discard(r.id)
+            raise
+        for e in ticked:
             r = _to_result(e)
             self._results[r.id] = r
             self._inflight.discard(r.id)
@@ -210,7 +225,7 @@ class LMServer:
 def _to_result(e) -> Result:
     return Result(
         id=e.rid, tokens=list(e.tokens), status=e.status,
-        finish_reason=e.finish_reason,
+        finish_reason=e.finish_reason, error=e.error,
         ttft_ms=(None if e.t_first is None
                  else (e.t_first - e.t_submit) * 1e3),
         latency_ms=(None if e.t_done is None

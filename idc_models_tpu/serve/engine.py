@@ -404,6 +404,15 @@ class SlotEngine:
                               n_steps))
         self._pending = (toks, snapshot)
 
+    def abort_window(self) -> None:
+        """Discard an in-flight window without collecting it — the
+        failure-cleanup hook (scheduler._abort_running): after an
+        engine error the window's results are lost either way, but a
+        window still marked in flight would wedge idle()/collect()
+        forever. The host budget/position shadows keep their
+        pre-dispatch values (the window never 'happened')."""
+        self._pending = None
+
     def collect(self) -> dict[int, list[int]]:
         """Block on the in-flight window's tokens ({} if none) and
         replay the device retirement rule onto the host shadows: live

@@ -51,8 +51,9 @@ class Entry:
     t_done: float | None = None
     slot: int | None = None
     tokens: list = dataclasses.field(default_factory=list)
-    status: str = "pending"          # pending|running|ok|timeout|rejected
-    finish_reason: str | None = None  # eos|budget|deadline|None
+    status: str = "pending"     # pending|running|ok|timeout|rejected|error
+    finish_reason: str | None = None  # eos|budget|deadline|error|None
+    error: str | None = None         # engine failure detail (status=error)
 
 
 class AdmissionQueue:
@@ -110,6 +111,9 @@ class Scheduler:
         self.admit_after_collect = admit_after_collect
         self.clock = clock
         self._running: dict[int, Entry] = {}
+        # entries killed by an engine failure mid-tick: tick() re-raises
+        # the engine error, so the caller collects them here (pop_failed)
+        self._failed: list[Entry] = []
 
     # -- admission -------------------------------------------------------
 
@@ -193,8 +197,20 @@ class Scheduler:
         # 3. collect the in-flight window; recycle on EOS / budget.
         #    Only the recycle decisions happen here — per-token
         #    bookkeeping is deferred past the next dispatch (step 6) so
-        #    the device never idles behind host accounting
-        out = self.engine.collect()
+        #    the device never idles behind host accounting.
+        #    An engine failure (device OOM, poisoned program, runtime
+        #    loss) must not leak the in-flight slots: every running
+        #    entry is failed + released, THEN the error propagates —
+        #    the queue stays serviceable for a caller that recovers
+        try:
+            out = self.engine.collect()
+        except Exception as e:
+            # step-1 expiries were already finalized into `done`, which
+            # this raise would otherwise discard — surface them through
+            # pop_failed alongside the aborted entries
+            self._failed.extend(done)
+            self._abort_running(e)
+            raise
         t_now = self.clock()
         got: list[tuple[Entry, list]] = []
         finished: list[Entry] = []
@@ -223,8 +239,50 @@ class Scheduler:
         # 6. dispatch the next window over every occupied slot
         occupancy = len(self._running) / self.engine.n_slots
         if self._running:
-            self.engine.begin_window(self.window)
+            try:
+                self.engine.begin_window(self.window)
+            except Exception as e:
+                # entries the just-collected window COMPLETED (EOS/
+                # budget/deadline) are real results, not casualties:
+                # finalize them with their true statuses — plus the
+                # step-1 expiries — into the pop_failed channel this
+                # raise would otherwise discard, then abort the rest
+                self._finalize_window(got, finished, cancelled, t_now,
+                                      now, self._failed)
+                self._failed.extend(done)
+                self._abort_running(e)
+                raise
         # 7. deferred bookkeeping — runs WHILE the new window computes
+        emitted = self._finalize_window(got, finished, cancelled, t_now,
+                                        now, done)
+        if self._running and self.metrics:
+            self.metrics.on_cycle(queue_depth=len(self.queue),
+                                  occupancy=occupancy, tokens=emitted)
+        return done
+
+    def drain(self) -> list[Entry]:
+        """Tick until every queued and running request has finished."""
+        done = []
+        while not self.idle():
+            done.extend(self.tick())
+        return done
+
+    def pop_failed(self) -> list[Entry]:
+        """Entries finalized by a tick that raised, since the last call
+        — the caller's hook to turn them into Results after tick()
+        re-raised. Holds both the engine-failure casualties
+        (status="error") and entries the failed tick had already
+        completed normally (EOS/budget/deadline), whose true statuses
+        are preserved."""
+        out, self._failed = self._failed, []
+        return out
+
+    def _finalize_window(self, got, finished, cancelled, t_now, now,
+                         sink) -> int:
+        """The per-window result bookkeeping (token extension, first-
+        token stamps, finish statuses) — one implementation for the
+        normal deferred pass AND the engine-failure salvage path, so
+        the two cannot drift. Returns the emitted-token count."""
         emitted = 0
         for e, toks in got:
             if toks and e.t_first is None:
@@ -239,24 +297,33 @@ class Scheduler:
                 "eos" if (e.eos_id is not None and e.tokens
                           and e.tokens[-1] == e.eos_id)
                 else "budget")
-            self._finish(e, done)
-        # deadline cancels from step 4 finish here too, AFTER the token
-        # extension above folded in anything the flying window carried
+            self._finish(e, sink)
+        # deadline cancels finish AFTER the token extension above folded
+        # in anything the flying window carried
         for e in cancelled:
             e.status, e.finish_reason = "timeout", "deadline"
             e.t_done = now
-            self._finish(e, done)
-        if self._running and self.metrics:
-            self.metrics.on_cycle(queue_depth=len(self.queue),
-                                  occupancy=occupancy, tokens=emitted)
-        return done
+            self._finish(e, sink)
+        return emitted
 
-    def drain(self) -> list[Entry]:
-        """Tick until every queued and running request has finished."""
-        done = []
-        while not self.idle():
-            done.extend(self.tick())
-        return done
+    def _abort_running(self, exc: Exception) -> None:
+        """Engine failure cleanup: mark every in-flight entry failed and
+        release its slot so the engine/queue are not wedged when the
+        caller survives the re-raised error."""
+        now = self.clock()
+        detail = f"{type(exc).__name__}: {exc}"
+        for slot, e in list(self._running.items()):
+            try:
+                self.engine.release(slot)
+            except Exception:  # noqa: S110 — engine already failed;
+                pass           # cleanup must reach every slot regardless
+            e.status, e.finish_reason = "error", "error"
+            e.error, e.t_done = detail, now
+            self._finish(e, self._failed)
+        self._running.clear()
+        # a window the failed engine still considers in flight would
+        # wedge idle()/collect(); the device work is lost either way
+        self.engine.abort_window()
 
     def _finish(self, e: Entry, done: list[Entry]) -> None:
         done.append(e)

@@ -16,16 +16,39 @@ existence gate is implemented correctly (fixing Q5).
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import shutil
 from pathlib import Path
 from typing import Any
 
 import jax
+import numpy as np
 
 # written INTO the checkpoint directory as the last step of a save;
 # its presence is the completion contract checkpoint_exists enforces
 _COMPLETE_MARKER = "_IDC_COMPLETE"
+# content digest over the saved leaves: bit-rot/truncation DETECTION on
+# restore — the marker proves the save finished, the digest proves the
+# bytes read back are the bytes written
+_DIGEST_FILE = "_IDC_DIGEST.json"
+
+
+def _tree_digest(state: Any) -> str:
+    """sha256 over every leaf's shape + raw bytes in flatten order — a
+    content fingerprint a flipped bit or truncated chunk cannot
+    survive. Leaves are fetched/viewed as numpy; non-array leaves hash
+    their repr."""
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state)):
+        if hasattr(leaf, "shape"):
+            a = np.ascontiguousarray(np.asarray(leaf))
+            h.update(str((a.shape, a.dtype.str)).encode())
+            h.update(a.tobytes())
+        else:
+            h.update(repr(leaf).encode())
+    return h.hexdigest()
 
 
 def _checkpointer():
@@ -64,6 +87,9 @@ def save_checkpoint(path: str | os.PathLike, state: Any, *,
     if tmp.exists():
         shutil.rmtree(tmp)              # leftover from a prior crash
     _checkpointer().save(tmp, state, force=force)
+    if tmp.is_dir() and jax.process_index() == 0:
+        (tmp / _DIGEST_FILE).write_text(
+            json.dumps({"sha256": _tree_digest(state)}))
     (tmp / _COMPLETE_MARKER).touch()
     if path.exists():
         # os.replace cannot overwrite a non-empty directory: retire the
@@ -85,7 +111,12 @@ def save_checkpoint(path: str | os.PathLike, state: Any, *,
 def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
     """Restore into the structure/shardings of `target` (an abstract or
     concrete pytree of the same shape as what was saved). Refuses torn
-    partial checkpoints (no completion marker)."""
+    partial checkpoints (no completion marker) and CORRUPT ones: any
+    restore-time failure (truncated chunk, unreadable metadata) is
+    re-raised as a ValueError naming the checkpoint, and when the save
+    recorded a content digest the restored leaves are verified against
+    it — a bit-flip that slips past the storage layer raises here
+    instead of returning a silently-garbage TrainState."""
     path = Path(path).absolute()
     if path.is_dir() and not (path / _COMPLETE_MARKER).exists():
         raise ValueError(
@@ -98,7 +129,27 @@ def restore_checkpoint(path: str | os.PathLike, target: Any) -> Any:
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(
             x, "sharding", None)) if hasattr(x, "shape") else x,
         target)
-    return _checkpointer().restore(path, abstract)
+    try:
+        restored = _checkpointer().restore(path, abstract)
+    except ValueError:
+        raise
+    except Exception as e:
+        raise ValueError(
+            f"checkpoint {path} failed to restore ({type(e).__name__}) "
+            f"— corrupt or incompatible on-disk state; delete it (or "
+            f"let load_or_train retrain over it)") from e
+    digest_file = path / _DIGEST_FILE
+    if path.is_dir() and digest_file.exists():
+        want = json.loads(digest_file.read_text()).get("sha256")
+        got = _tree_digest(restored)
+        if want != got:
+            raise ValueError(
+                f"checkpoint {path} is CORRUPT: restored content digest "
+                f"{got[:12]}... does not match the digest recorded at "
+                f"save time {str(want)[:12]}... (bit rot, truncation, or "
+                f"a partial overwrite) — refusing to hand back garbage "
+                f"state; delete it or let load_or_train retrain")
+    return restored
 
 
 def load_or_train(path: str | os.PathLike, target: Any, train_fn):
@@ -106,12 +157,20 @@ def load_or_train(path: str | os.PathLike, target: Any, train_fn):
     `train_fn() -> state`, save it, and return it. A markerless
     directory at `path` (torn partial — or a checkpoint from before the
     atomic-save change) is retrained over, with a loud warning naming
-    the migration escape hatch first."""
-    if checkpoint_exists(path):
-        return restore_checkpoint(path, target), True
-    if Path(path).is_dir():
-        import warnings
+    the migration escape hatch first. A checkpoint that LOOKS complete
+    but fails to restore (truncated/bit-flipped after the save) falls
+    back to retraining too — corruption costs a retrain, never a run
+    on garbage weights."""
+    import warnings
 
+    if checkpoint_exists(path):
+        try:
+            return restore_checkpoint(path, target), True
+        except ValueError as e:
+            warnings.warn(
+                f"checkpoint {path} is unrestorable ({e}) — RETRAINING "
+                f"and overwriting it", stacklevel=2)
+    elif Path(path).is_dir():
         warnings.warn(
             f"checkpoint {path} exists but has no completion marker "
             f"(torn partial, or saved before the atomic-save change) — "

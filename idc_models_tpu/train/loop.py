@@ -248,6 +248,21 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
             "loss": float(jnp.mean(jnp.stack(losses))),
             "accuracy": float(jnp.mean(jnp.stack(accs))),
         }
+        if not np.isfinite(ep["loss"]):
+            # fail FAST and loudly: a NaN here would silently poison
+            # every remaining epoch AND the saved checkpoint (the
+            # optimizer state is already corrupt) — find the first bad
+            # step so the error names where training went over the edge
+            bad = next((i for i, l in enumerate(losses)
+                        if not np.isfinite(float(l))), None)
+            where = (f"epoch {epoch + 1}, step {bad + 1}/{len(losses)}"
+                     if bad is not None else f"epoch {epoch + 1}")
+            raise FloatingPointError(
+                f"non-finite training loss ({ep['loss']}) at {where}: "
+                f"the parameters and optimizer state are corrupt from "
+                f"that step on, so continuing (or checkpointing) would "
+                f"only persist garbage — lower the lr, check the input "
+                f"data for NaN/Inf, or enable loss scaling")
         if evaluator is not None:
             vm = evaluator(state, val_ds)
             ep["val_loss"] = vm["loss"]

@@ -1,0 +1,207 @@
+"""The fault-injection harness (faults.py): deterministic plans, each
+fault kind's observable effect inside the round, bit-identical replay,
+and the transient-read hooks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu import faults
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.data.idc import ArrayDataset
+from idc_models_tpu.data.partition import partition_clients
+from idc_models_tpu.federated import initialize_server, make_fedavg_round
+from idc_models_tpu.models import small_cnn
+from idc_models_tpu.train import rmsprop
+from idc_models_tpu.train.losses import binary_cross_entropy
+
+N = 8
+
+
+def _clients(seed=0):
+    imgs, labels = synthetic.make_idc_like(N * 16, size=10, seed=seed)
+    ci, cl = partition_clients(ArrayDataset(imgs, labels), N, iid=True,
+                               seed=seed)
+    return ci, cl, np.full((N,), 16.0, np.float32)
+
+
+def _round(plan=None, **kw):
+    model = small_cnn(10, 3, 1)
+    mesh = meshlib.client_mesh(N)
+    rnd = make_fedavg_round(model, rmsprop(1e-3), binary_cross_entropy,
+                            mesh, local_epochs=1, batch_size=16,
+                            faults=plan, **kw)
+    return model, rnd
+
+
+def test_plan_codes_and_spec_parse():
+    plan = faults.FaultPlan(4, [
+        faults.Fault("crash", 0, rounds=(1,)),
+        faults.Fault("sign_flip", 2, scale=100.0),
+    ])
+    c0, s0 = plan.codes(0)
+    c1, _ = plan.codes(1)
+    assert c0.tolist() == [0, 0, faults.SIGN_FLIP, 0]
+    assert c1.tolist() == [faults.CRASH, 0, faults.SIGN_FLIP, 0]
+    assert s0[2] == 100.0
+
+    parsed = faults.parse_fault_spec("sign_flip:0-2:x1000,crash:3", 8)
+    kinds = {(f.kind, f.client) for f in parsed.faults}
+    assert kinds == {("sign_flip", 0), ("sign_flip", 1),
+                     ("sign_flip", 2), ("crash", 3)}
+    assert all(f.scale == 1000.0 for f in parsed.faults
+               if f.kind == "sign_flip")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultPlan(4, [faults.Fault("meteor", 0)])
+    with pytest.raises(ValueError, match="covers"):
+        faults.FaultPlan(2, [faults.Fault("crash", 5)])
+    # one stale tree per round: mixed straggler lags are refused, not
+    # silently collapsed to the max
+    with pytest.raises(ValueError, match="single staleness"):
+        faults.FaultPlan(4, [faults.Fault("straggler", 0, staleness=1),
+                             faults.Fault("straggler", 1, staleness=3)])
+    # the third spec field is the kind's OWN parameter: staleness for
+    # straggler, rejected for kinds that take none
+    lagged = faults.parse_fault_spec("straggler:3:2", 8)
+    assert lagged.faults[0].staleness == 2
+    with pytest.raises(ValueError, match="takes no parameter"):
+        faults.parse_fault_spec("crash:2:x100", 8)
+    # seeded sampling is deterministic
+    a = faults.FaultPlan.byzantine(10, 3, seed=5)
+    b = faults.FaultPlan.byzantine(10, 3, seed=5)
+    assert [f.client for f in a.faults] == [f.client for f in b.faults]
+
+
+def test_crash_equals_manual_weight_zero(devices):
+    """A crash fault is indistinguishable from the caller zeroing the
+    client's weight: same aggregate, bit for bit."""
+    ci, cl, w = _clients()
+    rng = jax.random.key(3)
+    model, rnd_fault = _round(
+        faults.FaultPlan(N, [faults.Fault("crash", 2)]))
+    _, rnd_plain = _round()
+    server = initialize_server(model, jax.random.key(0))
+    s_f, m_f = rnd_fault(server, ci, cl, w, rng)
+    w_manual = w.copy()
+    w_manual[2] = 0.0
+    server2 = initialize_server(model, jax.random.key(0))
+    s_m, m_m = rnd_plain(server2, ci, cl, w_manual, rng)
+    for a, b in zip(jax.tree.leaves(jax.device_get(s_f.params)),
+                    jax.tree.leaves(jax.device_get(s_m.params))):
+        np.testing.assert_array_equal(a, b)
+    assert int(m_f["clients_dropped"]) == 0   # crash != divergence
+
+
+def test_nan_inf_poisoners_are_dropped(devices):
+    """NaN/Inf poisoners produce non-finite updates — exactly what
+    drop_nonfinite exists for: both are cut, the server stays finite."""
+    ci, cl, w = _clients(seed=1)
+    model, rnd = _round(faults.FaultPlan(N, [
+        faults.Fault("nan", 1), faults.Fault("inf", 4)]))
+    server = initialize_server(model, jax.random.key(0))
+    server, m = rnd(server, ci, cl, w, jax.random.key(5))
+    assert int(m["clients_dropped"]) == 2
+    assert all(np.all(np.isfinite(l))
+               for l in jax.tree.leaves(jax.device_get(server.params)))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_scale_and_sign_flip_survive_finiteness_check(devices):
+    """The Byzantine attackers stay FINITE, so drop_nonfinite cannot see
+    them — the mean aggregate is steered far from the honest one (the
+    gap robust aggregators close)."""
+    ci, cl, w = _clients(seed=2)
+    model, rnd_att = _round(faults.FaultPlan(N, [
+        faults.Fault("sign_flip", 0, scale=1000.0),
+        faults.Fault("scale", 3, scale=1000.0)]))
+    _, rnd_plain = _round()
+    rng = jax.random.key(7)
+    s_a, m_a = rnd_att(initialize_server(model, jax.random.key(0)),
+                       ci, cl, w, rng)
+    s_p, _ = rnd_plain(initialize_server(model, jax.random.key(0)),
+                       ci, cl, w, rng)
+    assert int(m_a["clients_dropped"]) == 0          # invisible to detection
+    assert all(np.all(np.isfinite(l))
+               for l in jax.tree.leaves(jax.device_get(s_a.params)))
+    delta = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(
+        jax.tree.leaves(s_a.params), jax.tree.leaves(s_p.params)))
+    # RMSprop's normalized step is ~lr per coordinate, so an honest
+    # round moves the mean by ~1e-3; the x1000 attackers at weight 2/8
+    # steer it ~250x that
+    assert delta > 0.1, delta
+
+
+def test_straggler_replays_stale_params(devices):
+    """A straggler's update is the server params from round r-k: with
+    the round-1 weight concentrated on the straggler, the round-1
+    aggregate equals the round-0 INCOMING state."""
+    ci, cl, w = _clients(seed=3)
+    model, rnd = _round(faults.FaultPlan(N, [
+        faults.Fault("straggler", 0, rounds=(1,), staleness=1)]))
+    server = initialize_server(model, jax.random.key(0))
+    initial = jax.device_get(server.params)
+    server, _ = rnd(server, ci, cl, w, jax.random.key(1))     # round 0
+    w1 = np.zeros_like(w)
+    w1[0] = 1.0                         # only the straggler contributes
+    server, _ = rnd(server, ci, cl, w1, jax.random.key(2))    # round 1
+    for a, b in zip(jax.tree.leaves(jax.device_get(server.params)),
+                    jax.tree.leaves(initial)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fault_plan_replays_bit_identically(devices):
+    """Two fresh builds under the same plan + seeds produce the same
+    multi-round trajectory down to the last bit (the harness's core
+    contract: failures are REPRODUCIBLE)."""
+    ci, cl, w = _clients(seed=4)
+    plan = faults.FaultPlan.byzantine(N, 2, kind="sign_flip",
+                                      scale=50.0, seed=9)
+
+    def run():
+        model, rnd = _round(plan)
+        server = initialize_server(model, jax.random.key(0))
+        for r in range(3):
+            server, m = rnd(server, ci, cl, w,
+                            jax.random.fold_in(jax.random.key(1), r))
+        return jax.device_get(server.params)
+
+    p1, p2 = run(), run()
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_flaky_reads_and_retries():
+    """Transient-read hooks: seeded failure schedule replays exactly;
+    with_retries absorbs transient failures and re-raises persistent
+    ones."""
+    calls = []
+
+    def read(i):
+        calls.append(i)
+        return i * 2
+
+    def schedule(seed):
+        f = faults.flaky(read, failure_rate=0.5, seed=seed)
+        out = []
+        for i in range(20):
+            try:
+                f(i)
+                out.append(True)
+            except faults.TransientReadError:
+                out.append(False)
+        return out
+
+    assert schedule(3) == schedule(3)           # deterministic replay
+    assert not all(schedule(3)) and any(schedule(3))
+
+    # retries recover every transient failure at rate << 1
+    flaky_read = faults.flaky(read, failure_rate=0.3, seed=1)
+    robust_read = faults.with_retries(flaky_read, attempts=30)
+    assert [robust_read(i) for i in range(10)] == [i * 2
+                                                   for i in range(10)]
+    # a permanent failure still surfaces
+    always = faults.flaky(read, failure_rate=1.0, seed=0)
+    with pytest.raises(faults.TransientReadError):
+        faults.with_retries(always, attempts=3)(0)

@@ -1,0 +1,196 @@
+"""The self-healing round driver (federated/driver.py): healthy runs,
+divergence rollback, timeout retry with a reseeded client subset,
+bounded retries, and health-event logging."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu import mesh as meshlib
+from idc_models_tpu.data import synthetic
+from idc_models_tpu.data.idc import ArrayDataset
+from idc_models_tpu.data.partition import partition_clients
+from idc_models_tpu.federated import (
+    DriverConfig, RoundFailure, initialize_server, make_fedavg_round,
+    run_rounds,
+)
+from idc_models_tpu.federated.driver import reseeded_subset
+from idc_models_tpu.models import small_cnn
+from idc_models_tpu.observe import JsonlLogger
+from idc_models_tpu.train import rmsprop
+from idc_models_tpu.train.losses import binary_cross_entropy
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def fed():
+    imgs, labels = synthetic.make_idc_like(N * 16, size=10, seed=0)
+    ci, cl = partition_clients(ArrayDataset(imgs, labels), N, iid=True,
+                               seed=0)
+    w = np.full((N,), 16.0, np.float32)
+    model = small_cnn(10, 3, 1)
+    mesh = meshlib.client_mesh(N)
+    rnd = make_fedavg_round(model, rmsprop(1e-3), binary_cross_entropy,
+                            mesh, local_epochs=1, batch_size=16)
+    return model, rnd, ci, cl, w
+
+
+def _nan_server(s):
+    return s.replace(params=jax.tree.map(lambda x: x * jnp.nan, s.params))
+
+
+def test_healthy_run_and_history(fed, tmp_path):
+    model, rnd, ci, cl, w = fed
+    logger = JsonlLogger(tmp_path / "run.jsonl")
+    server = initialize_server(model, jax.random.key(0))
+    res = run_rounds(rnd, server, ci, cl, w,
+                     config=DriverConfig(rounds=3), seed=1,
+                     eval_fn=lambda s: {"probe": 1.0}, logger=logger)
+    logger.close()
+    assert int(res.server.round) == 3
+    assert [h["round"] for h in res.history] == [0, 1, 2]
+    assert all(h["attempts"] == 1 and h["probe"] == 1.0
+               for h in res.history)
+    assert all(e["status"] == "ok" for e in res.events)
+    recs = [json.loads(l)
+            for l in (tmp_path / "run.jsonl").read_text().splitlines()]
+    assert sum(r["event"] == "round" for r in recs) == 3
+    assert sum(r["event"] == "round_health" for r in recs) == 3
+
+
+def test_divergent_round_rolls_back_and_completes(fed):
+    """An injected divergent round triggers rollback to the last good
+    server state; the retry heals it and training completes the
+    remaining rounds on finite params."""
+    model, rnd, ci, cl, w = fed
+    attempts = []
+
+    def flaky(server, images, labels, weights, rng):
+        s, m = rnd(server, images, labels, weights, rng)
+        r = int(s.round) - 1
+        a = sum(1 for x in attempts if x == r)
+        attempts.append(r)
+        if r == 1 and a == 0:
+            s = _nan_server(s)          # round 1 diverges on try 0
+        return s, m
+
+    server = initialize_server(model, jax.random.key(0))
+    res = run_rounds(flaky, server, ci, cl, w,
+                     config=DriverConfig(rounds=3), seed=1)
+    statuses = [(e["round"], e["attempt"], e["status"])
+                for e in res.events]
+    assert (1, 0, "diverged") in statuses
+    assert (1, 1, "ok") in statuses
+    assert int(res.server.round) == 3
+    assert all(np.all(np.isfinite(l))
+               for l in jax.tree.leaves(jax.device_get(res.server.params)))
+    assert res.history[1]["attempts"] == 2
+
+
+def test_loss_spike_rolls_back(fed):
+    model, rnd, ci, cl, w = fed
+    calls = []
+
+    def spiky(server, images, labels, weights, rng):
+        s, m = rnd(server, images, labels, weights, rng)
+        calls.append(int(s.round) - 1)
+        if int(s.round) - 1 == 1 and calls.count(1) == 1:
+            m = dict(m)
+            m["loss"] = jnp.float32(1e9)   # finite but exploded
+        return s, m
+
+    server = initialize_server(model, jax.random.key(0))
+    res = run_rounds(spiky, server, ci, cl, w,
+                     config=DriverConfig(rounds=3, loss_spike_ratio=5.0),
+                     seed=1)
+    assert [e["status"] for e in res.events
+            if e["round"] == 1] == ["diverged", "ok"]
+    assert int(res.server.round) == 3
+
+
+def test_timeout_retries_with_reseeded_subset(fed):
+    """A round past its wall budget is discarded and retried with a
+    RESEEDED, smaller client subset (deterministic per (seed, round,
+    attempt))."""
+    model, rnd, ci, cl, w = fed
+    t = [0.0]
+    seen = []
+
+    def slow(server, images, labels, weights, rng):
+        seen.append(np.asarray(jax.device_get(weights)).copy())
+        t[0] += 100.0 if len(seen) == 1 else 0.1
+        return rnd(server, images, labels, weights, rng)
+
+    server = initialize_server(model, jax.random.key(0))
+    res = run_rounds(slow, server, ci, cl, w,
+                     config=DriverConfig(rounds=2, timeout_s=10.0,
+                                         timeout_exempt_first=False),
+                     seed=1, clock=lambda: t[0])
+    assert [(e["round"], e["attempt"], e["status"])
+            for e in res.events][:2] == [(0, 0, "timeout"), (0, 1, "ok")]
+    # attempt 1 ran a strict subset of the attempt-0 population
+    assert (seen[1] > 0).sum() < (seen[0] > 0).sum()
+    assert np.all(w[seen[1] > 0] > 0)
+    # and that subset is deterministic
+    np.testing.assert_array_equal(
+        seen[1], reseeded_subset(w, 1, 0, 1, 0.7))
+    assert int(res.server.round) == 2
+
+    # default config: the chronologically FIRST attempt is exempt (its
+    # wall time is dominated by XLA compiles, not straggling), so the
+    # same slow first round passes and no retry happens
+    t[0] = 0.0
+    seen.clear()
+    server = initialize_server(model, jax.random.key(0))
+    res = run_rounds(slow, server, ci, cl, w,
+                     config=DriverConfig(rounds=2, timeout_s=10.0),
+                     seed=1, clock=lambda: t[0])
+    assert all(e["status"] == "ok" for e in res.events)
+    assert len(seen) == 2
+
+
+def test_bounded_retries_then_raise(fed):
+    model, rnd, ci, cl, w = fed
+
+    def dead(server, images, labels, weights, rng):
+        s, m = rnd(server, images, labels, weights, rng)
+        return _nan_server(s), m
+
+    server = initialize_server(model, jax.random.key(0))
+    with pytest.raises(RoundFailure, match="failed 2 attempt"):
+        run_rounds(dead, server, ci, cl, w,
+                   config=DriverConfig(rounds=2, max_attempts=2), seed=1)
+
+    # a raising round_fn is retried too, then chained into the failure
+    def broken(server, images, labels, weights, rng):
+        raise RuntimeError("device fell off")
+
+    server = initialize_server(model, jax.random.key(0))
+    with pytest.raises(RoundFailure) as ei:
+        run_rounds(broken, server, ci, cl, w,
+                   config=DriverConfig(rounds=1, max_attempts=2), seed=1)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    assert int(ei.value.server.round) == 0      # rollback anchor exposed
+
+
+def test_driver_checkpoints_and_resumes(fed, tmp_path):
+    from idc_models_tpu.train import checkpoint_exists, restore_checkpoint
+
+    model, rnd, ci, cl, w = fed
+    path = tmp_path / "server"
+    server = initialize_server(model, jax.random.key(0))
+    res = run_rounds(rnd, server, ci, cl, w,
+                     config=DriverConfig(rounds=3, checkpoint_path=path,
+                                         checkpoint_every=2), seed=1)
+    assert checkpoint_exists(path)
+    restored = restore_checkpoint(
+        path, jax.device_get(initialize_server(model, jax.random.key(9))))
+    assert int(restored.round) == 3
+    # resuming a finished run is a no-op, not an error
+    res2 = run_rounds(rnd, restored, ci, cl, w,
+                      config=DriverConfig(rounds=3), seed=1)
+    assert res2.history == [] and int(res2.server.round) == 3

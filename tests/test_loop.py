@@ -303,3 +303,72 @@ def test_jsonl_logger_arrays_and_close(tmp_path):
     assert recs[0]["big"] == {"__array__": True, "shape": [64, 64],
                               "dtype": "float32"}
     assert recs[0]["scalar"] == 1.5
+
+
+def test_fit_nonfinite_loss_fails_fast(devices, tmp_path):
+    """A NaN training loss must abort the run IMMEDIATELY with an error
+    naming the epoch/step — not silently poison every remaining epoch
+    and the saved checkpoint."""
+    import pytest
+
+    mesh = meshlib.data_mesh(8)
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    ds = _data(64)
+    poisoned = ArrayDataset(np.full_like(ds.images, np.nan), ds.labels)
+    ckpt = tmp_path / "fit_ckpt"
+    with pytest.raises(FloatingPointError, match=r"epoch 1"):
+        fit(model, opt, binary_cross_entropy, state, poisoned, None, mesh,
+            epochs=3, batch_size=32, verbose=False,
+            checkpoint_dir=str(ckpt))
+    # the poisoned epoch was never checkpointed: nothing to resume into
+    assert not (ckpt / "meta.json").exists()
+
+
+def test_checkpoint_corruption_detected(devices, tmp_path):
+    """Bit-flip and truncation of a COMPLETED checkpoint: restore must
+    raise cleanly (never hand back a garbage TrainState), and
+    load_or_train must fall back to retraining."""
+    import pytest
+
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    state = create_train_state(model, opt, jax.random.key(0))
+    target = create_train_state(model, opt, jax.random.key(9))
+
+    def corrupt(path, mode):
+        data_files = sorted(
+            (p for p in path.rglob("*")
+             if p.is_file() and not p.name.startswith("_IDC")),
+            key=lambda p: p.stat().st_size, reverse=True)
+        victim = data_files[0]
+        raw = bytearray(victim.read_bytes())
+        if mode == "bitflip":
+            raw[len(raw) // 2] ^= 0xFF
+            victim.write_bytes(bytes(raw))
+        else:
+            victim.write_bytes(bytes(raw[: len(raw) // 2]))
+
+    for mode in ("bitflip", "truncate"):
+        path = tmp_path / f"ckpt_{mode}"
+        save_checkpoint(path, state)
+        assert checkpoint_exists(path)
+        corrupt(path, mode)
+        with pytest.raises(ValueError):
+            restore_checkpoint(path, target)
+
+        calls = []
+
+        def train_fn():
+            calls.append(1)
+            return state
+
+        with pytest.warns(UserWarning, match="RETRAINING"):
+            got, was_restored = load_or_train(path, target, train_fn)
+        assert not was_restored and len(calls) == 1
+        # the fallback re-saved a WHOLE checkpoint over the corpse
+        restored = restore_checkpoint(path, target)
+        for a, b in zip(jax.tree.leaves(restored),
+                        jax.tree.leaves(state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -301,3 +301,85 @@ def test_trace_roundtrip_and_poisson(devices, tmp_path):
         assert r.seed is not None
     p = save_trace(tmp_path / "t.jsonl", trace)
     assert load_trace(p) == trace
+
+
+def test_engine_failure_releases_slots_and_surfaces_error(devices, params):
+    """Satellite contract: if the engine fails mid-tick, the in-flight
+    requests become status="error" Results (with the failure detail),
+    their slots are released, the error re-raises — and the server
+    keeps serving new requests afterwards instead of wedging."""
+    server = LMServer(params, **_kw(), n_slots=2, window=4, eos_id=None)
+    assert server.submit(Request(id="a", prompt=(1, 2, 3),
+                                 max_new_tokens=8))
+    assert server.submit(Request(id="b", prompt=(4, 5),
+                                 max_new_tokens=8))
+    server.step()                     # admit a; window in flight
+    server.step()                     # admit b; next window in flight
+    assert server.scheduler._running
+
+    real_collect = server.engine.collect
+
+    def boom():
+        raise RuntimeError("device fell off the bus")
+
+    server.engine.collect = boom
+    with pytest.raises(RuntimeError, match="fell off the bus"):
+        server.step()
+    server.engine.collect = real_collect
+
+    # every in-flight request got an error Result with the detail
+    for rid in ("a", "b"):
+        r = server.poll(rid)
+        assert r is not None and r.status == "error"
+        assert "fell off the bus" in r.error
+    # slots were released, nothing is running, the queue is sane
+    assert server.scheduler._running == {}
+    assert sorted(server.engine.free_slots()) == [0, 1]
+    assert server.scheduler.idle()
+
+    # the server is still serviceable: a fresh request completes ok and
+    # matches the serial path (the engine state machine was not wedged)
+    gen = Generator(params, **_kw())
+    assert server.submit(Request(id="c", prompt=(1, 2, 3),
+                                 max_new_tokens=6))
+    out = server.drain()
+    assert [r.id for r in out] == ["c"] and out[0].status == "ok"
+    assert out[0].error is None
+    assert out[0].tokens == _serial_tokens(gen, [1, 2, 3], 6)
+
+
+def test_engine_failure_preserves_completed_entries(devices, params):
+    """A request that COMPLETED on the failed tick (budget reached at
+    collect) keeps its real 'ok' Result — only the genuinely in-flight
+    request becomes an error — even though tick() re-raised before its
+    normal bookkeeping ran."""
+    server = LMServer(params, **_kw(), n_slots=2, window=4, eos_id=None)
+    assert server.submit(Request(id="done", prompt=(1, 2, 3),
+                                 max_new_tokens=4))   # == one window
+    assert server.submit(Request(id="run", prompt=(4, 5),
+                                 max_new_tokens=12))
+    calls = {"n": 0}
+    real_begin = server.engine.begin_window
+
+    def failing_begin(n):
+        calls["n"] += 1
+        if calls["n"] >= 2:          # the window AFTER "done" finishes
+            raise RuntimeError("begin blew up")
+        return real_begin(n)
+
+    server.engine.begin_window = failing_begin
+    server.step()                    # admit both, window 1 in flight
+    with pytest.raises(RuntimeError, match="begin blew up"):
+        server.step()                # collect: "done" finishes; begin dies
+    server.engine.begin_window = real_begin
+
+    done = server.poll("done")
+    assert done.status == "ok" and done.finish_reason == "budget"
+    assert len(done.tokens) == 4 and done.error is None
+    # the serial path agrees with the salvaged tokens
+    gen = Generator(params, **_kw())
+    assert done.tokens == _serial_tokens(gen, [1, 2, 3], 4)
+    failed = server.poll("run")
+    assert failed.status == "error" and "begin blew up" in failed.error
+    assert len(failed.tokens) == 4   # the collected window's tokens kept
+    assert server.scheduler.idle()
