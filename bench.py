@@ -872,6 +872,136 @@ def bench_serving(on_accelerator: bool):
     }
 
 
+def bench_serving_shared_prefix(on_accelerator: bool):
+    """Chunked prefill + radix prefix cache vs monolithic admission on
+    SHARED-PREFIX traffic — the scenario the prefix cache exists for.
+
+    N requests arrive over K distinct system prompts (long shared
+    prefix, short unique tail) mixed with long-prompt stragglers. The
+    treated server admits prompts one CHUNK per decode window and reuses
+    chunk-boundary KV snapshots across requests sharing a prefix; the
+    baseline runs the historical one-dispatch-per-prompt admission. Both
+    emit bit-identical greedy tokens (asserted — the comparison is pure
+    scheduling). Reported: the prefix hit rate, both TTFT p95s, and the
+    per-cycle decode stall (host time between windows spent on
+    admission/prefill — the thing a monolithic 16k-token prefill
+    inflates and chunking bounds). Interleaved pairs, best-of, same
+    discipline as bench_serving. Plus the int8-KV capacity ratio:
+    ring-cache bytes per slot bf16 vs int8 at identical config — slots
+    per HBM byte is the reciprocal."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import attention_lm
+    from idc_models_tpu.serve import LMServer, Request, SlotEngine
+
+    if on_accelerator:
+        vocab, e, heads, blocks, mlp = 1024, 512, 8, 2, 2048
+        t_max, n_slots, window = 2048, 8, 32
+        chunk, sys_len, n_req, k_prefix = 256, 1792, 24, 4
+        tail_lens, budgets = (8, 32), (16, 48)
+    else:
+        # long prompts relative to the model so prefill COMPUTE (not
+        # dispatch overhead) is what the prefix cache removes — the
+        # regime the feature targets; tiny prompts make monolithic
+        # admission win on dispatch count alone
+        vocab, e, heads, blocks, mlp = 32, 64, 2, 2, 128
+        t_max, n_slots, window = 256, 4, 8
+        chunk, sys_len, n_req, k_prefix = 32, 224, 16, 4
+        tail_lens, budgets = (3, 8), (6, 12)
+    mesh = meshlib.seq_mesh(1)
+    model = attention_lm(vocab, t_max, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks, mesh=mesh)
+    params = model.init(jax.random.key(0)).params
+    kw = dict(embed_dim=e, num_heads=heads, num_blocks=blocks,
+              t_max=t_max, mesh=mesh, cache_dtype=jnp.bfloat16)
+
+    rng = np.random.default_rng(7)
+    prefixes = [tuple(int(x) for x in rng.integers(0, vocab, sys_len))
+                for _ in range(k_prefix)]
+
+    def mk_trace(tag, n):
+        tr = []
+        for i in range(n):
+            tail = tuple(int(x) for x in rng.integers(
+                0, vocab, int(rng.integers(*tail_lens))))
+            tr.append((0.0, Request(
+                id=f"{tag}{i}", prompt=prefixes[i % k_prefix] + tail,
+                max_new_tokens=int(rng.integers(budgets[0],
+                                                budgets[1])))))
+        return tr
+
+    warm_trace = mk_trace("warm", k_prefix)
+    trace = mk_trace("r", n_req)
+
+    def run_pass(chunked: bool):
+        from idc_models_tpu.serve import ServingMetrics
+
+        server = LMServer(
+            params, n_slots=n_slots, window=window,
+            max_prefills_per_cycle=4,
+            prefill_chunk=chunk if chunked else None,
+            prefix_cache_mb=256.0 if chunked else 0.0, **kw)
+        if chunked:
+            # steady-state measurement: one request per prefix warms
+            # the radix cache, then the metrics (serving AND prefix
+            # counters) reset so the reported summary covers ONLY the
+            # timed trace — without the reset, the cold warm-trace
+            # requests dominate the p95s this scenario exists to
+            # compare (cold misses are a once-per-prefix transient,
+            # not the steady state)
+            server.run(warm_trace)
+            pc = server.engine.prefix_cache
+            pc.hits = pc.misses = pc.evictions = 0
+            pc.hit_tokens = pc.lookup_tokens = 0
+            server.metrics = ServingMetrics(prefix_cache=pc)
+            server.scheduler.metrics = server.metrics
+        results = server.run(trace)
+        toks = {r.id: tuple(r.tokens)
+                for r in results if r.id.startswith("r")}  # fence
+        return toks, server.summary()
+
+    run_pass(True)                                   # compile both paths
+    run_pass(False)
+    best_c, best_m = None, None
+    for _ in range(2):                               # interleaved pairs
+        tok_c, sum_c = run_pass(True)
+        tok_m, sum_m = run_pass(False)
+        assert tok_c == tok_m                        # pure scheduling
+        if (best_c is None
+                or sum_c["serve_ttft_ms_p95"] < best_c["serve_ttft_ms_p95"]):
+            best_c = sum_c
+        if (best_m is None
+                or sum_m["serve_ttft_ms_p95"] < best_m["serve_ttft_ms_p95"]):
+            best_m = sum_m
+
+    # int8 capacity at identical config: bytes of ring-cache state per
+    # slot (+ scales) — the denominator of slots-per-HBM-budget
+    eng16 = SlotEngine(params, n_slots=2, **kw)
+    eng8 = SlotEngine(params, n_slots=2, kv_dtype="int8", **kw)
+    ratio = eng16.kv_bytes_per_slot() / eng8.kv_bytes_per_slot()
+
+    return {
+        "serve_prefix_requests": n_req,
+        "serve_prefix_distinct_prefixes": k_prefix,
+        "serve_prefix_hit_rate": best_c["serve_prefix_hit_rate"],
+        "serve_prefix_token_hit_rate": best_c["serve_prefix_token_hit_rate"],
+        "serve_ttft_ms_p95_shared_prefix": best_c["serve_ttft_ms_p95"],
+        "serve_ttft_ms_p95_shared_prefix_monolithic":
+            best_m["serve_ttft_ms_p95"],
+        "serve_chunked_prefill_decode_stall_ms":
+            best_c["serve_prefill_stall_ms_mean"],
+        "serve_monolithic_prefill_decode_stall_ms":
+            best_m["serve_prefill_stall_ms_mean"],
+        "serve_chunked_prefill_decode_stall_ms_max":
+            best_c["serve_prefill_stall_ms_max"],
+        "serve_monolithic_prefill_decode_stall_ms_max":
+            best_m["serve_prefill_stall_ms_max"],
+        "serve_int8_kv_slot_capacity_ratio": round(ratio, 3),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -894,6 +1024,7 @@ def main() -> None:
     ring.update(bench_attention_model_step(on_accelerator))
     ring.update(bench_lm_decode(on_accelerator))
     ring.update(bench_serving(on_accelerator))
+    ring.update(bench_serving_shared_prefix(on_accelerator))
     ring.update(bench_federated_robustness(on_accelerator))
     if on_accelerator:
         # second headline sample, minutes after the first (the shared

@@ -300,6 +300,22 @@ def _parse(argv):
                     help="admission-queue backpressure bound")
     sp.add_argument("--max-prefills-per-cycle", type=int, default=1,
                     help="prefill-vs-decode interleave cap per cycle")
+    sp.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked prefill: admit prompts C tokens per "
+                         "decode window instead of one monolithic "
+                         "dispatch (0 = off; must divide --t-max). "
+                         "Long prompts stop stalling in-flight decodes")
+    sp.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                    help="radix prefix cache budget in MB (0 = off; "
+                         "needs --prefill-chunk): requests sharing a "
+                         "token prefix reuse chunk-boundary KV "
+                         "snapshots instead of recomputing them")
+    sp.add_argument("--kv-dtype", default="bf16",
+                    choices=("bf16", "int8"),
+                    help="ring-cache K/V storage: int8 halves HBM per "
+                         "slot (per-(slot,head) scales, ~2x slots per "
+                         "budget) at the cost of bounded logit drift — "
+                         "leave bf16 when exact parity matters")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -839,6 +855,14 @@ def _run_serve(ns):
                  f"{ns.seq_parallel}")
     if ns.temperature < 0.0:
         sys.exit(f"--temperature {ns.temperature} must be >= 0")
+    # fail fast — BEFORE any --train-steps pre-training runs
+    if ns.prefill_chunk and (ns.prefill_chunk < 1
+                             or ns.t_max % ns.prefill_chunk):
+        sys.exit(f"--prefill-chunk {ns.prefill_chunk} must be >= 1 and "
+                 f"divide --t-max {ns.t_max}")
+    if ns.prefix_cache_mb > 0 and not ns.prefill_chunk:
+        sys.exit("--prefix-cache-mb needs --prefill-chunk (snapshots "
+                 "live on chunk boundaries)")
     mesh = meshlib.seq_mesh(ns.seq_parallel)
     # the model trains through the SAME ring the serving mesh uses —
     # omitting mesh here would silently train single-device full
@@ -879,7 +903,10 @@ def _run_serve(ns):
         window=ns.window, mesh=mesh, cache_dtype=jnp.float32,
         temperature=ns.temperature, top_k=ns.top_k or None,
         eos_id=ns.eos, max_queue_depth=ns.max_queue_depth,
-        max_prefills_per_cycle=ns.max_prefills_per_cycle, logger=logger)
+        max_prefills_per_cycle=ns.max_prefills_per_cycle, logger=logger,
+        prefill_chunk=ns.prefill_chunk or None,
+        prefix_cache_mb=ns.prefix_cache_mb,
+        kv_dtype=("int8" if ns.kv_dtype == "int8" else None))
     if ns.trace:
         trace = load_trace(ns.trace)
     else:
@@ -899,6 +926,20 @@ def _run_serve(ns):
     print(f"served: ok={n_ok} timeout={summary['serve_timed_out']} "
           f"rejected={summary['serve_rejected']} "
           f"tokens={summary['serve_tokens']}")
+    # TTFT decomposed so an operator can tell queueing from compute:
+    # p95 TTFT = queue wait (add slots / shed load) + prefill compute
+    # (shrink prompts, chunk smaller, warm the prefix cache). Absent
+    # when nothing emitted a first token (all expired/rejected).
+    if summary.get("serve_ttft_ms_p95") is not None:
+        print(f"ttft p95 {summary['serve_ttft_ms_p95']} ms = queue-wait "
+              f"{summary['serve_queue_wait_ms_p95']} ms + prefill "
+              f"{summary['serve_prefill_ms_p95']} ms (p95s)")
+    if summary.get("serve_prefix_hit_rate") is not None:
+        print(f"prefix cache: hit rate "
+              f"{summary['serve_prefix_hit_rate']} "
+              f"({summary['serve_prefix_hits']} hits, "
+              f"{summary['serve_prefix_evictions']} evictions, "
+              f"{summary['serve_prefix_bytes']} bytes)")
     print("serve summary:", json.dumps(summary))
     if logger:
         logger.log(event="serve_summary", **summary)

@@ -44,7 +44,7 @@ from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
 from idc_models_tpu.models.attention import _seq_pin, transformer_block
 from idc_models_tpu.ring_decode import (
-    cache_sharding, init_cache, make_ring_decode,
+    cache_sharding, init_cache, make_chunk_ring_decode, make_ring_decode,
 )
 
 
@@ -171,6 +171,8 @@ class _ServeFns(NamedTuple):
     prefill: object       # (params, tokens) -> (logits, caches)
     decode_loop: object   # (params, caches, logits, rng, offsets)
     #                       -> (tokens, logits, caches)
+    prefill_chunk: object  # (params, caches, tokens, start, p_end)
+    #                        -> (logits, caches)
 
 
 def _serve_config(params, *, embed_dim, num_heads, num_blocks, t_max,
@@ -240,6 +242,20 @@ def prefill_buckets(t_max: int, n_ring: int) -> tuple[int, ...]:
     return tuple(out)
 
 
+def check_prefill_chunk(chunk: int, t_max: int) -> int:
+    """The one chunk-length contract: chunks tile the cache exactly, so
+    chunk k always starts at k*chunk and never hangs past t_max (the
+    ragged FINAL chunk is handled by the traced true length, not by a
+    different shape — one compiled chunk program serves every prompt)."""
+    chunk = int(chunk)
+    if not 1 <= chunk <= t_max:
+        raise ValueError(f"prefill_chunk {chunk} outside [1, {t_max}]")
+    if t_max % chunk:
+        raise ValueError(f"prefill_chunk {chunk} must divide t_max "
+                         f"{t_max} so chunk boundaries tile the cache")
+    return chunk
+
+
 def _pad_prompt(tokens, t_max: int, n_ring: int):
     """[B, P] -> ([B, bucket] zero-padded, true length P). Pad tokens
     embed position >= P but are masked out of the cache and, causally,
@@ -270,6 +286,42 @@ def _make_pick(cfg: _ServeConfig):
     return pick
 
 
+def _project_qkv(cfg: _ServeConfig, ln, p, h, seq_shape: tuple):
+    """Pre-LN q/k/v projection of one block — THE single definition
+    shared by the one-token decode forward (seq_shape=(1,)), the chunk
+    prefill (seq_shape=(C,)), and the monolithic ring prefill
+    (seq_shape=(P',)). A dtype/bias/reshape fix lands in every path at
+    once or not at all — the bit-parity contracts between them hinge on
+    this sharing."""
+    b = h.shape[0]
+    head_dim = cfg.embed_dim // cfg.num_heads
+    a, _ = ln.apply(p["ln1"], {}, h)
+    split = lambda y: y.reshape(b, *seq_shape, cfg.num_heads, head_dim)
+    q = split(a @ p["mha"]["wq"].astype(a.dtype))
+    k = split(a @ p["mha"]["wk"].astype(a.dtype))
+    v = split(a @ p["mha"]["wv"].astype(a.dtype))
+    return q, k, v
+
+
+def _attn_residual(p, h, o):
+    """Out-projection + residual, one definition for every path."""
+    return h + (o @ p["mha"]["wo"].astype(o.dtype)
+                + p["mha"]["bo"].astype(o.dtype))
+
+
+def _mlp_residual(ln, p, h):
+    """Pre-LN MLP + residual, one definition for every path."""
+    a, _ = ln.apply(p["ln2"], {}, h)
+    m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
+    return h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"])
+
+
+def _final_logits(ln, params, h):
+    """Final LN + vocab head, one definition for every path."""
+    h, _ = ln.apply(params["ln_f"], {}, h)
+    return h @ params["head"]["kernel"] + params["head"]["bias"]
+
+
 def _token_forward(cfg: _ServeConfig, ln, params, caches, tok, pos, fold):
     """One token per row through every block — the single definition of
     the decode-time forward: embed (+position), then per block
@@ -282,28 +334,18 @@ def _token_forward(cfg: _ServeConfig, ln, params, caches, tok, pos, fold):
     cache fold, so the serial scalar-pos path and the engine's masked
     per-row path share every other op bit-for-bit."""
     b = tok.shape[0]
-    head_dim = cfg.embed_dim // cfg.num_heads
     h = (jnp.take(params["embed"], tok, axis=0)
          + params["pos"][pos])                          # [B, E]
     new_caches = []
     for i in range(cfg.num_blocks):
         p = params[f"block{i}"]
         kc, vc = caches[i]
-        a, _ = ln.apply(p["ln1"], {}, h)
-        split = lambda y: y.reshape(b, 1, cfg.num_heads, head_dim)
-        q = split(a @ p["mha"]["wq"].astype(a.dtype))
-        k = split(a @ p["mha"]["wk"].astype(a.dtype))
-        v = split(a @ p["mha"]["wv"].astype(a.dtype))
+        q, k, v = _project_qkv(cfg, ln, p, h, (1,))
         o, kc, vc = fold(i, kc, vc, q, k, v)
-        o = o.reshape(b, cfg.embed_dim)
-        h = h + (o @ p["mha"]["wo"].astype(o.dtype)
-                 + p["mha"]["bo"].astype(o.dtype))
-        a, _ = ln.apply(p["ln2"], {}, h)
-        m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
-        h = h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"])
+        h = _attn_residual(p, h, o.reshape(b, cfg.embed_dim))
+        h = _mlp_residual(ln, p, h)
         new_caches.append((kc, vc))
-    h, _ = ln.apply(params["ln_f"], {}, h)
-    logits = h @ params["head"]["kernel"] + params["head"]["bias"]
+    logits = _final_logits(ln, params, h)
     return logits, tuple(new_caches)
 
 
@@ -368,26 +410,16 @@ def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
         kvs = []
         for i in range(cfg.num_blocks):
             p = params[f"block{i}"]
-            a, _ = ln.apply(p["ln1"], {}, h)
-            split = lambda y: y.reshape(b, p_pad, cfg.num_heads,
-                                        head_dim)
-            q = split(a @ p["mha"]["wq"].astype(a.dtype))
-            k = split(a @ p["mha"]["wk"].astype(a.dtype))
-            v = split(a @ p["mha"]["wv"].astype(a.dtype))
+            q, k, v = _project_qkv(cfg, ln, p, h, (p_pad,))
             o = ring(q, k, v)
             o = o.reshape(b, p_pad, cfg.embed_dim)
-            h = pin(h + (o @ p["mha"]["wo"].astype(o.dtype)
-                         + p["mha"]["bo"].astype(o.dtype)))
-            a, _ = ln.apply(p["ln2"], {}, h)
-            m = jax.nn.gelu(a @ p["fc1"]["kernel"] + p["fc1"]["bias"])
-            h = pin(h + (m @ p["fc2"]["kernel"] + p["fc2"]["bias"]))
+            h = pin(_attn_residual(p, h, o))
+            h = pin(_mlp_residual(ln, p, h))
             kvs.append((k, v))
         # last REAL position's activations — p_len is traced, so this is
         # a dynamic gather, not a static index
         h_last = lax.dynamic_slice_in_dim(h, p_len - 1, 1, axis=1)[:, 0]
-        h_last, _ = ln.apply(params["ln_f"], {}, h_last)
-        logits = (h_last @ params["head"]["kernel"]
-                  + params["head"]["bias"])
+        logits = _final_logits(ln, params, h_last)
         sh = cache_sharding(mesh)
         keep = (jnp.arange(p_pad) < p_len)[None, :, None, None]
 
@@ -401,6 +433,50 @@ def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
         return logits, tuple((to_cache(k), to_cache(v)) for k, v in kvs)
 
     prefill = jax.jit(prefill_body)
+
+    chunk_fold = make_chunk_ring_decode(mesh, jit=False)
+
+    def chunk_body(params, caches, tokens, start, p_end):
+        # one prompt CHUNK through every block, consuming and extending
+        # an existing ring cache: the admission-path complement of the
+        # monolithic `prefill_body`. `tokens` is [B, C] at fixed C (the
+        # chunk length is a shape key; ONE length -> one executable);
+        # `start` is the chunk's first global position and `p_end` the
+        # prompt's true end within this chunk (both traced), so the
+        # ragged final chunk runs the same program. Structure per block
+        # mirrors `_token_forward` widened to C positions, with the
+        # chunk fold (append + per-query causal attend over the whole
+        # cache + ring merge) in place of the one-token fold.
+        b, c = tokens.shape
+        pos_tab = lax.dynamic_slice_in_dim(params["pos"], start, c,
+                                           axis=0)
+        h = jnp.take(params["embed"], tokens, axis=0) + pos_tab
+        new_caches = []
+        for i in range(cfg.num_blocks):
+            p = params[f"block{i}"]
+            kc, vc = caches[i]
+            q, k, v = _project_qkv(cfg, ln, p, h, (c,))
+            o, kc, vc = chunk_fold(kc, vc, q, k, v, start, p_end)
+            h = _attn_residual(p, h, o.reshape(b, c, cfg.embed_dim))
+            h = _mlp_residual(ln, p, h)
+            new_caches.append((kc, vc))
+        # logits of the LAST REAL position in this chunk (p_end is
+        # traced -> dynamic gather); intermediate chunks' logits are
+        # discarded by the caller, the final chunk's seed decode
+        h_last = lax.dynamic_slice_in_dim(h, p_end - start - 1, 1,
+                                          axis=1)[:, 0]
+        logits = _final_logits(ln, params, h_last)
+        sh = cache_sharding(mesh)
+        # pin the outgoing caches to the canonical sharding spelling so
+        # chunk -> chunk -> insert chains reuse one jit cache entry per
+        # program (same discipline as the engine's pin_state)
+        new_caches = tuple(
+            (lax.with_sharding_constraint(kc, sh),
+             lax.with_sharding_constraint(vc, sh))
+            for kc, vc in new_caches)
+        return logits, new_caches
+
+    prefill_chunk = jax.jit(chunk_body, donate_argnums=(1,))
 
     pick = _make_pick(cfg)
 
@@ -425,7 +501,8 @@ def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
 
     decode_loop = jax.jit(decode_body, donate_argnums=(1,))
 
-    return _ServeFns(init_caches, step, prefill, decode_loop)
+    return _ServeFns(init_caches, step, prefill, decode_loop,
+                     prefill_chunk)
 
 
 def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
@@ -481,6 +558,34 @@ def make_lm_decoder(params, *, embed_dim: int, num_heads: int,
     return fns.init_caches, step, prefill_tokens
 
 
+def chunked_prefill(fns: _ServeFns, params, tokens: np.ndarray,
+                    chunk: int, caches=None, start: int = 0):
+    """Drive the chunk program over `tokens[:, start:]`: ceil((P-start)/
+    chunk) dispatches at ONE compiled shape, each consuming the previous
+    chunk's caches (donated) and extending them in place. `caches=None`
+    starts from fresh zeroed ring caches; passing caches + a chunk-
+    aligned `start` resumes from a prefix snapshot (the prefix-cache hit
+    path). Returns (last-real-position logits, caches) — bit-identical
+    whether the prefix came from a snapshot or was recomputed, because
+    both run the same executables over the same values."""
+    b, p_len = tokens.shape
+    if start % chunk or not 0 <= start < p_len:
+        raise ValueError(f"chunk resume start {start} must be a chunk "
+                         f"multiple inside the prompt (P={p_len})")
+    if caches is None:
+        caches = fns.init_caches(b)
+    logits = None
+    c0 = start
+    while c0 < p_len:
+        end = min(c0 + chunk, p_len)
+        padded = np.zeros((b, chunk), np.int32)
+        padded[:, :end - c0] = tokens[:, c0:end]
+        logits, caches = fns.prefill_chunk(params, caches, padded,
+                                           np.int32(c0), np.int32(end))
+        c0 += chunk
+    return logits, caches
+
+
 class Generator:
     """Reusable compiled serving path: ring prefill + fused scan decode.
 
@@ -509,7 +614,8 @@ class Generator:
     def __init__(self, params, *, embed_dim: int, num_heads: int,
                  num_blocks: int, t_max: int, mesh: Mesh | None = None,
                  cache_dtype=jnp.bfloat16, block_impl: str = "jnp",
-                 temperature: float = 0.0, top_k: int | None = None):
+                 temperature: float = 0.0, top_k: int | None = None,
+                 prefill_chunk: int | None = None):
         self._cfg = _serve_config(
             params, embed_dim=embed_dim, num_heads=num_heads,
             num_blocks=num_blocks, t_max=t_max, mesh=mesh,
@@ -519,20 +625,41 @@ class Generator:
         self._params = _place_params(params, self._cfg.mesh)
         self.t_max = t_max
         self.temperature = float(temperature)
+        # chunked prefill: the prompt runs through the chunk program C
+        # tokens at a time instead of one monolithic bucketed dispatch.
+        # None (default) keeps the historical single-dispatch path
+        # bit-for-bit; an int selects the Sarathi-style path the serving
+        # ENGINE uses, so engine-vs-serial parity can be asserted with
+        # both sides prefilling identically.
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else check_prefill_chunk(prefill_chunk,
+                                                       t_max))
 
     def init_caches(self, batch: int):
         """Fresh zeroed ring caches (one (k, v) pair per block)."""
         return self._fns.init_caches(batch)
 
     def prefill(self, prompt):
-        """Prompt [B, P] -> (last-position logits [B, vocab], caches),
-        one ring-sharded pass (O(P/n) per device). Prompts are padded
-        to a prefill bucket (`prefill_bucket`) with the true length
-        traced, so distinct prompt lengths share compiled programs."""
-        n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
-        padded, p_len = _pad_prompt(_check_prompt(prompt, self.t_max),
-                                    self.t_max, n_ring)
-        return self._fns.prefill(self._params, padded, np.int32(p_len))
+        """Prompt [B, P] -> (last-position logits [B, vocab], caches).
+
+        Default (`prefill_chunk=None`): one ring-sharded pass (O(P/n)
+        per device), prompts padded to a prefill bucket
+        (`prefill_bucket`) with the true length traced, so distinct
+        prompt lengths share compiled programs.
+
+        With `prefill_chunk=C`: ceil(P/C) chunk-program dispatches, each
+        extending the same ring caches — the path a chunked-admission
+        serving engine runs, exposed here so serial reference outputs
+        can be produced through the IDENTICAL programs."""
+        if self.prefill_chunk is None:
+            n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
+            padded, p_len = _pad_prompt(_check_prompt(prompt, self.t_max),
+                                        self.t_max, n_ring)
+            return self._fns.prefill(self._params, padded,
+                                     np.int32(p_len))
+        tokens = np.asarray(_check_prompt(prompt, self.t_max))
+        return chunked_prefill(self._fns, self._params,
+                               tokens, self.prefill_chunk)
 
     def decode(self, caches, logits, pos0: int, steps: int, *, rng=None):
         """Emit `steps` tokens in ONE dispatch from (caches, logits) at
@@ -585,6 +712,7 @@ class Generator:
         grow any of these)."""
         return {"step": self._fns.step._cache_size(),
                 "prefill": self._fns.prefill._cache_size(),
+                "prefill_chunk": self._fns.prefill_chunk._cache_size(),
                 "decode_loop": self._fns.decode_loop._cache_size()}
 
 

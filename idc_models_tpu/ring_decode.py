@@ -204,11 +204,25 @@ def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
 
 def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
                              scale: float | None = None,
-                             jit: bool = False):
+                             jit: bool = False,
+                             quantized: bool = False):
     """Per-slot decode fold for the continuous-batching engine
     (serve/engine.py): ``fn(k_cache, v_cache, q_t, k_t, v_t, pos, live)
     -> (out_t, k_cache, v_cache)`` where every batch row is an
     INDEPENDENT sequence at its OWN position.
+
+    With ``quantized=True`` the caches hold int8 K/V and the signature
+    grows per-(row, head) dequantization scales: ``fn(kc, vc, q_t, k_t,
+    v_t, pos, live, k_scale, v_scale)`` with both scales float32 [B, H].
+    Because a scale is constant over the slot dimension and head_dim,
+    dequantization FACTORS OUT of both einsums — scores multiply by
+    k_scale and the value accumulator by v_scale AFTER the contraction —
+    so the int8 cache is never materialized as a float copy (the whole
+    point: the HBM win is capacity AND bandwidth). Appends quantize the
+    new token's K/V with the row's existing scale (clipped to ±127):
+    scales are set once at insert from the prefill content, so decode
+    tokens whose activations outgrow the prompt's range clip — the
+    documented int8 accuracy caveat (docs/LONG_CONTEXT.md).
 
     `pos` is int32 [B] (row b's new token sits at global position
     pos[b]) and `live` is bool [B]: rows with live=False append NOTHING
@@ -226,7 +240,8 @@ def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     decode window, whose top-level jit owns donation."""
     n = mesh.shape[axis]
 
-    def per_device(kc, vc, q, kt, vt, pos, live):
+    def per_device(kc, vc, q, kt, vt, pos, live, k_scale=None,
+                   v_scale=None):
         b, t_shard, h, d = kc.shape
         i = collectives.axis_index(axis)
         scale_ = scale if scale is not None else d ** -0.5
@@ -239,6 +254,17 @@ def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         owner = posc // t_shard
         slot = posc % t_shard
         mine = (owner == i) & live
+
+        if quantized:
+            # quantize the incoming token with the ROW's frozen scale
+            # (insert-time absmax); a dead row's zero scale divides to
+            # inf but clips finitely and the live gate discards it
+            kt = jnp.clip(jnp.round(
+                kt.astype(jnp.float32) / k_scale[:, None, :, None]),
+                -127, 127)
+            vt = jnp.clip(jnp.round(
+                vt.astype(jnp.float32) / v_scale[:, None, :, None]),
+                -127, 127)
 
         # per-row O(1) append: each row reads its ONE slot and writes the
         # new token back only when this shard owns the row's position AND
@@ -254,6 +280,10 @@ def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         # fold (see make_ring_decode); visibility is per ROW now
         s = jnp.einsum("bhd,bkhd->bhk", q[:, 0], kc,
                        preferred_element_type=jnp.float32) * scale_
+        if quantized:
+            # dequantize by FACTORING the per-(row, head) scale out of
+            # the contraction — no float copy of the cache exists
+            s = s * k_scale[:, :, None]
         visible = ((i * t_shard + jnp.arange(t_shard))[None, :]
                    <= posc[:, None])                       # [B, t_shard]
         s = jnp.where(visible[:, None, :], s, _MASKED)
@@ -263,6 +293,8 @@ def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         l_loc = jnp.sum(p, axis=-1)
         acc_loc = jnp.einsum("bhk,bkhd->bhd", p, vc,
                              preferred_element_type=jnp.float32)
+        if quantized:
+            acc_loc = acc_loc * v_scale[..., None]
         m_glob = lax.pmax(m_loc, axis)
         corr = jnp.exp(m_loc - m_glob)
         l_glob = collectives.psum(l_loc * corr, axis)
@@ -274,15 +306,23 @@ def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     bo = others if others else None
     cache_spec = P(bo, axis, None, None)
     tok_spec = P(bo, None, None, None)
+    # scales are per (row, head): the batch dim shards with the caches'
+    # over the non-seq axes (P() would mis-shape the per-device divide
+    # on any mesh with a non-trivial non-seq axis)
+    scale_specs = (P(bo, None), P(bo, None)) if quantized else ()
     mapped = shard_map(
         per_device, mesh=mesh,
         in_specs=(cache_spec, cache_spec, tok_spec, tok_spec, tok_spec,
-                  P(), P()),
+                  P(), P()) + scale_specs,
         out_specs=(tok_spec, cache_spec, cache_spec),
         check_vma=False,
     )
 
-    def checked(kc, vc, q_t, k_t, v_t, pos, live):
+    def checked(kc, vc, q_t, k_t, v_t, pos, live, *scales):
+        if quantized and len(scales) != 2:
+            raise ValueError("quantized fold needs (k_scale, v_scale)")
+        if not quantized and scales:
+            raise ValueError("scales passed to a non-quantized fold")
         if q_t.shape[1] != 1:
             raise ValueError(
                 f"batched ring decode takes ONE token per row per step: "
@@ -306,7 +346,123 @@ def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
                 raise ValueError(
                     f"live pos {bad.tolist()} outside the cache "
                     f"(t_max {kc.shape[1]})")
-        return mapped(kc, vc, q_t, k_t, v_t, pos, live)
+        return mapped(kc, vc, q_t, k_t, v_t, pos, live, *scales)
+
+    if not jit:
+        return checked
+    return jax.jit(checked, donate_argnums=(0, 1))
+
+
+def make_chunk_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
+                           scale: float | None = None,
+                           jit: bool = False):
+    """Chunked-prefill fold (Sarathi-style): ``fn(k_cache, v_cache, q, k,
+    v, start, p_end) -> (out, k_cache, v_cache)`` runs C prompt tokens
+    at once against an EXISTING ring cache — the middle ground between
+    the one-token decode fold and the whole-prompt training ring.
+
+    q/k/v are the chunk's projections, [B, C, H, D] (replicated over
+    `axis`); the chunk occupies global positions [start, start + C) and
+    only positions < `p_end` are REAL (both int32 scalars, traced — so
+    one compiled program serves every chunk of a prompt AND the ragged
+    final chunk). The fold:
+
+    1. appends the chunk's real K/V into the cache — each device
+       rewrites its resident shard through a gather + where (positions
+       outside [start, p_end) keep their stored value). This is
+       O(t_shard) traffic per chunk rather than the decode fold's O(1)
+       per token, but it runs once per C tokens and XLA keeps the
+       rewrite in place under donation;
+    2. attends every chunk query against the WHOLE updated cache with a
+       per-query causal visibility mask (cache position <= query
+       position — which covers both the already-cached prefix and
+       causality INSIDE the chunk, since the chunk's own K/V are in the
+       cache by step 1);
+    3. merges across the ring with the same stable (m, l, acc) softmax
+       algebra as the decode folds — two collectives per CHUNK instead
+       of per token.
+
+    Query rows at positions >= p_end (the ragged tail's padding) append
+    nothing and their outputs are garbage the caller discards; they
+    cannot NaN (their visibility set is non-empty). Requires
+    start + C <= t_max (the caller sizes chunks so a chunk never hangs
+    past the cache). Defaults to ``jit=False`` for tracing into the
+    chunk-prefill program (models/lm.py), whose top-level jit owns
+    donation."""
+    n = mesh.shape[axis]
+
+    def per_device(kc, vc, q, kt, vt, start, p_end):
+        b, t_shard, h, d = kc.shape
+        c = q.shape[1]
+        i = collectives.axis_index(axis)
+        scale_ = scale if scale is not None else d ** -0.5
+        start = jnp.asarray(start, jnp.int32)
+        p_end = jnp.asarray(p_end, jnp.int32)
+        g = i * t_shard + jnp.arange(t_shard, dtype=jnp.int32)  # [t_shard]
+        # 1. append: this shard's slots that fall inside [start, p_end)
+        # take the chunk row at (g - start); everything else keeps its
+        # stored value. A shard fully outside the chunk's span rewrites
+        # itself with itself — bit-untouched.
+        take_new = (g >= start) & (g < p_end)                 # [t_shard]
+        src = jnp.clip(g - start, 0, c - 1)                   # [t_shard]
+
+        def splice(cache, tok):
+            gathered = jnp.take(tok, src, axis=1).astype(cache.dtype)
+            return jnp.where(take_new[None, :, None, None], gathered,
+                             cache)
+
+        kc = splice(kc, kt)
+        vc = splice(vc, vt)
+        # 2. per-query local attend against the resident shard
+        qpos = start + jnp.arange(c, dtype=jnp.int32)         # [C]
+        s = jnp.einsum("bchd,bkhd->bhck", q, kc,
+                       preferred_element_type=jnp.float32) * scale_
+        visible = g[None, :] <= qpos[:, None]                 # [C, t_shard]
+        s = jnp.where(visible[None, None], s, _MASKED)
+        m_loc = jnp.max(s, axis=-1)                           # [B, H, C]
+        p = jnp.exp(s - m_loc[..., None])
+        p = jnp.where(visible[None, None], p, 0.0)
+        l_loc = jnp.sum(p, axis=-1)                           # [B, H, C]
+        acc_loc = jnp.einsum("bhck,bkhd->bhcd", p, vc,
+                             preferred_element_type=jnp.float32)
+        # 3. one stable softmax merge across the ring (per chunk, not
+        # per token)
+        m_glob = lax.pmax(m_loc, axis)
+        corr = jnp.exp(m_loc - m_glob)
+        l_glob = collectives.psum(l_loc * corr, axis)
+        acc_glob = collectives.psum(acc_loc * corr[..., None], axis)
+        out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]  # [B,H,C,D]
+        return jnp.moveaxis(out, 1, 2).astype(q.dtype), kc, vc  # [B,C,H,D]
+
+    others = tuple(a for a in mesh.axis_names if a != axis)
+    bo = others if others else None
+    cache_spec = P(bo, axis, None, None)
+    tok_spec = P(bo, None, None, None)
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(cache_spec, cache_spec, tok_spec, tok_spec, tok_spec,
+                  P(), P()),
+        out_specs=(tok_spec, cache_spec, cache_spec),
+        check_vma=False,
+    )
+
+    def checked(kc, vc, q, k, v, start, p_end):
+        if q.ndim != 4 or q.shape[1] < 1:
+            raise ValueError(f"chunk fold expects [B, C, H, D] queries, "
+                             f"got shape {jnp.shape(q)}")
+        if kc.shape[1] % n:
+            raise ValueError(
+                f"cache length {kc.shape[1]} not divisible by the ring "
+                f"size {n} over mesh axis {axis!r}")
+        # concrete out-of-range starts are caller bugs, same contract as
+        # the scalar fold (a chunk hanging past t_max would silently
+        # drop its tail's append)
+        if isinstance(start, (int, np.integer)):
+            if not 0 <= int(start) <= kc.shape[1] - q.shape[1]:
+                raise ValueError(
+                    f"chunk start {int(start)} + chunk {q.shape[1]} "
+                    f"outside the cache (t_max {kc.shape[1]})")
+        return mapped(kc, vc, q, k, v, start, p_end)
 
     if not jit:
         return checked

@@ -3,6 +3,7 @@ from idc_models_tpu.serve.api import (  # noqa: F401
 )
 from idc_models_tpu.serve.engine import SlotEngine  # noqa: F401
 from idc_models_tpu.serve.metrics import ServingMetrics  # noqa: F401
+from idc_models_tpu.serve.prefix_cache import PrefixCache  # noqa: F401
 from idc_models_tpu.serve.scheduler import (  # noqa: F401
     AdmissionQueue, Scheduler,
 )

@@ -81,13 +81,27 @@ class LMServer:
                  eos_id: int | None = None, max_queue_depth: int = 64,
                  max_prefills_per_cycle: int = 1,
                  admit_after_collect: bool = True, logger=None,
-                 warmup: bool = True, clock=time.monotonic):
+                 warmup: bool = True, clock=time.monotonic,
+                 prefill_chunk: int | None = None,
+                 prefix_cache_mb: float = 0.0,
+                 kv_dtype: str | None = None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
         from idc_models_tpu.serve.metrics import ServingMetrics
+        from idc_models_tpu.serve.prefix_cache import PrefixCache
         from idc_models_tpu.serve.scheduler import Scheduler
 
+        # prefix reuse rides the chunk grid: snapshots are taken at
+        # chunk boundaries and extended by the chunk program, so the
+        # knob implies chunked admission
+        prefix_cache = None
+        if prefix_cache_mb and prefix_cache_mb > 0:
+            if prefill_chunk is None:
+                raise ValueError("prefix_cache_mb needs prefill_chunk")
+            prefix_cache = PrefixCache(
+                prefill_chunk, int(prefix_cache_mb * 1024 * 1024),
+                logger=logger)
         self.engine = SlotEngine(
             params, embed_dim=embed_dim, num_heads=num_heads,
             num_blocks=num_blocks, t_max=t_max, n_slots=n_slots,
@@ -95,8 +109,9 @@ class LMServer:
             cache_dtype=(jnp.bfloat16 if cache_dtype is None
                          else cache_dtype),
             block_impl=block_impl, temperature=temperature, top_k=top_k,
-            pad_id=pad_id, eos_id=eos_id)
-        self.metrics = ServingMetrics(logger)
+            pad_id=pad_id, eos_id=eos_id, prefill_chunk=prefill_chunk,
+            prefix_cache=prefix_cache, kv_dtype=kv_dtype)
+        self.metrics = ServingMetrics(logger, prefix_cache=prefix_cache)
         self.scheduler = Scheduler(
             self.engine, window=window, max_queue_depth=max_queue_depth,
             max_prefills_per_cycle=max_prefills_per_cycle,

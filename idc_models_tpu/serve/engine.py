@@ -68,7 +68,7 @@ from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
 from idc_models_tpu.models.lm import (
     _make_pick, _place_params, _serve_config, _serving_fns,
-    _token_forward, prefill_bucket, prefill_buckets,
+    _token_forward, check_prefill_chunk, prefill_bucket, prefill_buckets,
 )
 from idc_models_tpu.ring_decode import make_batched_ring_decode
 
@@ -100,21 +100,46 @@ def _key_data(rng) -> np.ndarray:
 _key_data._checked = False
 
 
+class _PendingPrefill:
+    """Host-side record of one chunked prefill in flight: the prompt,
+    the single-request caches being extended chunk by chunk, and where
+    the next chunk starts (past any prefix-cache hit)."""
+
+    __slots__ = ("prompt", "budget", "rng", "eos_id", "caches", "logits",
+                 "next_start")
+
+    def __init__(self, *, prompt, budget, rng, eos_id, caches, logits,
+                 next_start):
+        self.prompt = prompt
+        self.budget = budget
+        self.rng = rng
+        self.eos_id = eos_id
+        self.caches = caches
+        self.logits = logits
+        self.next_start = next_start
+
+
 class _EngineFns(NamedTuple):
     init_caches: object
-    window: object    # (params, caches, logits, kd, pos, rem, eos, W)
+    init_scales: object
+    window: object    # (params, caches, logits, kd, pos, rem, eos,
+    #                    kscales, vscales, W)
     insert: object    # (state..., new_caches, new_logits, slot, ...)
 
 
 @functools.lru_cache(maxsize=16)
-def _engine_fns(cfg, pad_id: int) -> _EngineFns:
+def _engine_fns(cfg, pad_id: int, quant: bool = False) -> _EngineFns:
     """Compile-once engine programs per decode configuration — the same
     process-wide sharing discipline as `models/lm._serving_fns`: params
     are explicit arguments, so two engines with one config share every
-    executable."""
+    executable. With ``quant`` the batch caches hold int8 K/V plus
+    per-(slot, head) float32 scales (one pair of [S, H] arrays per
+    block): insert quantizes the prefilled float caches (absmax/127 per
+    head) and the window's fold dequantizes by factoring the scales out
+    of the contractions — see `ring_decode.make_batched_ring_decode`."""
     mesh, t_max = cfg.mesh, cfg.t_max
     head_dim = cfg.embed_dim // cfg.num_heads
-    fold = make_batched_ring_decode(mesh, jit=False)
+    fold = make_batched_ring_decode(mesh, jit=False, quantized=quant)
     ln = core.layer_norm(cfg.embed_dim)
     pick = _make_pick(cfg)
     # the TRAILING-NONE-FREE spelling of the ring cache layout: jit
@@ -141,21 +166,39 @@ def _engine_fns(cfg, pad_id: int) -> _EngineFns:
 
     def init_caches(n_slots: int):
         # same zeroed layout as ring_decode.init_cache, but placed under
-        # the engine's canonical (normalized) sharding spelling
+        # the engine's canonical (normalized) sharding spelling; int8
+        # when quantized — HALF the HBM of the bf16 rows, which is what
+        # lets n_slots scale at a fixed budget
         def mk():
             return meshlib.put_with_sharding(
                 np.zeros((n_slots, t_max, cfg.num_heads, head_dim),
-                         jnp.dtype(cfg.cache_dtype)), cache_sh)
+                         jnp.int8 if quant
+                         else jnp.dtype(cfg.cache_dtype)), cache_sh)
 
         return tuple((mk(), mk()) for _ in range(cfg.num_blocks))
 
-    def masked_step(params, caches, tok, pos, live):
-        return _token_forward(
-            cfg, ln, params, caches, tok, pos,
-            lambda _i, kc, vc, q, k, v: fold(kc, vc, q, k, v, pos, live))
+    def init_scales(n_slots: int):
+        # per-(slot, head) dequant scales, one (k, v) pair per block;
+        # () on the float path so every signature stays uniform
+        if not quant:
+            return ()
+
+        def mk():
+            return meshlib.put_with_sharding(
+                np.zeros((n_slots, cfg.num_heads), np.float32), rep)
+
+        return tuple((mk(), mk()) for _ in range(cfg.num_blocks))
+
+    def masked_step(params, caches, tok, pos, live, scales):
+        def block_fold(i, kc, vc, q, k, v):
+            extra = (scales[i] if quant else ())
+            return fold(kc, vc, q, k, v, pos, live, *extra)
+
+        return _token_forward(cfg, ln, params, caches, tok, pos,
+                              block_fold)
 
     def window_body(params, caches, logits, kd, pos, remaining, eos,
-                    n_steps):
+                    scales, n_steps):
         # the whole window is ONE device program, like the serial fused
         # scan — but each slot carries its own position, budget, and rng
         # stream, and dead slots ride along as bit-level no-ops
@@ -183,7 +226,7 @@ def _engine_fns(cfg, pad_id: int) -> _EngineFns:
                 kd = jnp.where(live[:, None],
                                jax.random.key_data(pair[:, 0]), kd)
             new_logits, caches = masked_step(params, caches, toks, pos,
-                                             live)
+                                             live, scales)
             logits = jnp.where(live[:, None], new_logits, logits)
             pos = jnp.where(live, pos + 1, pos)
             remaining = jnp.where(live, remaining - 1, remaining)
@@ -198,19 +241,32 @@ def _engine_fns(cfg, pad_id: int) -> _EngineFns:
         return (jnp.moveaxis(toks, 0, 1), caches, logits, kd, pos,
                 remaining)
 
-    # eos (argnum 6) is read-only across windows and deliberately NOT
-    # donated — the same device array feeds every window until an
-    # admission replaces it
-    window = jax.jit(window_body, static_argnums=(7,),
+    # eos (argnum 6) and the dequant scales (argnum 7) are read-only
+    # across windows and deliberately NOT donated — the same device
+    # arrays feed every window until an admission replaces them
+    window = jax.jit(window_body, static_argnums=(8,),
                      donate_argnums=(1, 2, 3, 4, 5))
 
-    def insert_body(caches, logits, kd, pos, rem, eos, new_caches,
-                    new_logits, slot, p_len, budget, eos_id, kd_row):
+    def insert_body(caches, logits, kd, pos, rem, eos, scales,
+                    new_caches, new_logits, slot, p_len, budget, eos_id,
+                    kd_row):
         # batch-axis scatter with the slot index (and every per-slot
         # scalar) TRACED: one compiled program admits any request into
         # any slot
-        out = []
-        for (kc, vc), (nk, nv) in zip(caches, new_caches):
+        out, out_scales = [], []
+        for i, ((kc, vc), (nk, nv)) in enumerate(zip(caches,
+                                                     new_caches)):
+            if quant:
+                ks_row, vs_row = scales[i]
+                nk, k_s = _quantize_row(nk)
+                nv, v_s = _quantize_row(nv)
+                ks_row = lax.dynamic_update_slice(ks_row, k_s[None],
+                                                  (slot, 0))
+                vs_row = lax.dynamic_update_slice(vs_row, v_s[None],
+                                                  (slot, 0))
+                out_scales.append((
+                    lax.with_sharding_constraint(ks_row, rep),
+                    lax.with_sharding_constraint(vs_row, rep)))
             kc = lax.dynamic_update_slice(kc, nk.astype(kc.dtype),
                                           (slot, 0, 0, 0))
             vc = lax.dynamic_update_slice(vc, nv.astype(vc.dtype),
@@ -223,10 +279,22 @@ def _engine_fns(cfg, pad_id: int) -> _EngineFns:
         rem = rem.at[slot].set(budget)
         eos = eos.at[slot].set(eos_id)
         caches, logits = pin_state(tuple(out), logits)
-        return caches, logits, kd, pos, rem, eos
+        return (caches, logits, kd, pos, rem, eos,
+                tuple(out_scales) if quant else ())
 
-    insert = jax.jit(insert_body, donate_argnums=(0, 1, 2, 3, 4, 5))
-    return _EngineFns(init_caches, window, insert)
+    def _quantize_row(x):
+        # [1, t_max, H, D] float -> (int8 values, [H] per-head scale):
+        # absmax/127 over every (position, dim) of the row, clamped so
+        # an all-zero row (fresh cache tail) divides safely
+        xf = x.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(xf), axis=(0, 1, 3)),
+                        1e-8) / 127.0                      # [H]
+        q = jnp.clip(jnp.round(xf / s[None, None, :, None]),
+                     -127, 127).astype(jnp.int8)
+        return q, s
+
+    insert = jax.jit(insert_body, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+    return _EngineFns(init_caches, init_scales, window, insert)
 
 
 class SlotEngine:
@@ -251,14 +319,65 @@ class SlotEngine:
                  mesh=None, cache_dtype=jnp.bfloat16,
                  block_impl: str = "jnp", temperature: float = 0.0,
                  top_k: int | None = None, pad_id: int = 0,
-                 eos_id: int | None = None):
+                 eos_id: int | None = None,
+                 prefill_chunk: int | None = None,
+                 prefix_cache=None, kv_dtype: str | None = None):
         if n_slots < 1:
             raise ValueError(f"need n_slots >= 1, got {n_slots}")
+        # kv_dtype: None/"bf16" keeps the float ring cache rows
+        # (cache_dtype, the historical path bit-for-bit); "int8" stores
+        # quantized rows + per-(slot, head) scales — ~2x the slots per
+        # HBM byte, with the accuracy caveat documented in
+        # docs/LONG_CONTEXT.md
+        if kv_dtype not in (None, "bf16", "int8"):
+            raise ValueError(f"kv_dtype must be None, 'bf16' or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_int8 = kv_dtype == "int8"
+        if prefix_cache is not None and prefill_chunk is None:
+            raise ValueError(
+                "a prefix cache needs chunked prefill (prefill_chunk=C):"
+                " snapshots live on chunk boundaries and only the chunk "
+                "program can extend a cached prefix")
         self._cfg = _serve_config(
             params, embed_dim=embed_dim, num_heads=num_heads,
             num_blocks=num_blocks, t_max=t_max, mesh=mesh,
             cache_dtype=cache_dtype, block_impl=block_impl,
             temperature=temperature, top_k=top_k)
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else check_prefill_chunk(prefill_chunk,
+                                                       t_max))
+        self.prefix_cache = prefix_cache
+        if (prefix_cache is not None
+                and prefix_cache.chunk != self.prefill_chunk):
+            raise ValueError(
+                f"prefix cache chunk {prefix_cache.chunk} != engine "
+                f"prefill_chunk {self.prefill_chunk}")
+        if prefix_cache is not None:
+            # store snapshots TRUNCATED to the prefix length (positions
+            # past it are zeros by construction — storing the full
+            # [1, t_max] row would inflate every snapshot's budget cost
+            # by t_max/prefix); a hit pads back and re-places under the
+            # ring sharding, so the chunk program sees exactly the
+            # layout it was warmed with (fresh arrays — never the
+            # stored master) and the resume is bit-identical
+            from idc_models_tpu.ring_decode import cache_sharding
+
+            sh = cache_sharding(self._cfg.mesh)
+            pad_to = t_max
+
+            def _pack(caches, n_tokens):
+                return jax.tree.map(lambda a: a[:, :n_tokens], caches)
+
+            def _unpack(caches):
+                def grow(a):
+                    a = jnp.asarray(a)
+                    a = jnp.pad(a, ((0, 0), (0, pad_to - a.shape[1]),
+                                    (0, 0), (0, 0)))
+                    return meshlib.put_with_sharding(a, sh)
+
+                return jax.tree.map(grow, caches)
+
+            prefix_cache.set_packer(_pack, _unpack)
         non_seq = [a for a in self._cfg.mesh.axis_names
                    if a != meshlib.SEQ_AXIS
                    and self._cfg.mesh.shape[a] > 1]
@@ -268,7 +387,7 @@ class SlotEngine:
                 f"a time ([1, P] batches cannot shard over axes "
                 f"{non_seq}); build the engine on mesh.seq_mesh(n)")
         self._sfns = _serving_fns(self._cfg)
-        self._efns = _engine_fns(self._cfg, int(pad_id))
+        self._efns = _engine_fns(self._cfg, int(pad_id), self.kv_int8)
         self._params = _place_params(params, self._cfg.mesh)
         self._n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
         self.t_max = t_max
@@ -295,12 +414,18 @@ class SlotEngine:
             np.zeros(n_slots, np.int32), rep)
         self._eos = meshlib.put_with_sharding(
             np.full(n_slots, -1, np.int32), rep)
+        self._scales = self._efns.init_scales(n_slots)
         # host shadows (never fetched back from device)
         self._pos_h = np.zeros(n_slots, np.int64)
         self._rem_h = np.zeros(n_slots, np.int64)
         self._eos_h = np.full(n_slots, -1, np.int64)
         self._occupied = np.zeros(n_slots, bool)
         self._pending = None     # (toks_dev, rem_snapshot, occ_snapshot)
+        # in-progress chunked prefills: slot -> _PendingPrefill. These
+        # slots are RESERVED (excluded from free_slots, not yet decoded
+        # by windows) until the final chunk lands and insert scatters
+        # the request into the batch row.
+        self._prefills: dict[int, _PendingPrefill] = {}
 
     # -- slot lifecycle -------------------------------------------------
 
@@ -313,6 +438,7 @@ class SlotEngine:
                      else None)
         return [s for s in range(self.n_slots)
                 if not self._occupied[s]
+                and s not in self._prefills
                 and (in_flight is None or not in_flight[s])]
 
     def occupancy(self) -> float:
@@ -330,21 +456,14 @@ class SlotEngine:
         self._occupied[slot] = False
         self._rem_h[slot] = 0
 
-    def admit(self, slot: int, prompt, max_new_tokens: int, *,
-              rng=None, eos_id: int | None = None) -> None:
-        """Prefill `prompt` ([P] or [1, P]) and scatter it into `slot`:
-        one bucketed prefill dispatch + one insert dispatch, while every
-        other slot's state stays put. `rng` seeds this REQUEST's
-        sampling stream — an integer seed or the exact key a serial
-        `Generator.decode` call would take. May be called while a window
-        is in flight: the insert lands after it, and the slot (vacant in
-        the flying window) starts decoding on the next one."""
+    def _validate_admit(self, slot, prompt, max_new_tokens, rng):
+        """The one admission contract, shared by the monolithic and
+        chunked paths: [1, P] int32 prompt, within-budget lengths, an
+        rng when sampling, a genuinely free slot."""
         if self._occupied[slot]:
             raise ValueError(f"slot {slot} is occupied")
-        # host-side prompt prep (the eager-jnp equivalent costs ~6 tiny
-        # device dispatches per ADMISSION — measured to be a third of
-        # the whole serve loop's wall at smoke scale): numpy pad to the
-        # prefill bucket, hand the jitted prefill the numpy array
+        if slot in self._prefills:
+            raise ValueError(f"slot {slot} has a prefill in progress")
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim == 1:
             prompt = prompt[None]
@@ -366,24 +485,132 @@ class SlotEngine:
         if self.temperature > 0.0 and rng is None:
             raise ValueError("sampling (temperature > 0) needs an rng "
                              "key (or integer seed) per request")
-        bucket = prefill_bucket(p_len, self.t_max, self._n_ring)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[:, :p_len] = prompt
-        logits1, caches1 = self._sfns.prefill(self._params, padded,
-                                              np.int32(p_len))
+        return prompt
+
+    def _insert(self, slot, caches1, logits1, p_len, max_new_tokens,
+                eos_id, rng) -> None:
+        """Scatter a fully prefilled request into the batch row — the
+        shared tail of both admission paths."""
         eos = self.eos_id if eos_id is None else eos_id
         eos = -1 if eos is None else int(eos)
         kd_row = (_key_data(rng) if rng is not None
                   else np.zeros(2, np.uint32))
         (self._caches, self._logits, self._kd, self._pos, self._rem,
-         self._eos) = self._efns.insert(
+         self._eos, self._scales) = self._efns.insert(
             self._caches, self._logits, self._kd, self._pos, self._rem,
-            self._eos, caches1, logits1, np.int32(slot), np.int32(p_len),
-            np.int32(max_new_tokens), np.int32(eos), kd_row)
+            self._eos, self._scales, caches1, logits1, np.int32(slot),
+            np.int32(p_len), np.int32(max_new_tokens), np.int32(eos),
+            kd_row)
         self._pos_h[slot] = p_len
         self._rem_h[slot] = max_new_tokens
         self._eos_h[slot] = eos
         self._occupied[slot] = True
+
+    def admit(self, slot: int, prompt, max_new_tokens: int, *,
+              rng=None, eos_id: int | None = None) -> None:
+        """Prefill `prompt` ([P] or [1, P]) and scatter it into `slot`,
+        while every other slot's state stays put. `rng` seeds this
+        REQUEST's sampling stream — an integer seed or the exact key a
+        serial `Generator.decode` call would take. May be called while a
+        window is in flight: the insert lands after it, and the slot
+        (vacant in the flying window) starts decoding on the next one.
+
+        Without `prefill_chunk` this is one bucketed prefill dispatch +
+        one insert. With it, the whole prompt still lands in ONE call —
+        ceil(P/C) chunk dispatches driven to completion here — which is
+        the convenience path; a scheduler that wants to interleave
+        chunks with decode windows drives `start_prefill`/`prefill_step`
+        itself."""
+        if self.prefill_chunk is not None:
+            self.start_prefill(slot, prompt, max_new_tokens, rng=rng,
+                               eos_id=eos_id)
+            while not self.prefill_step(slot):
+                pass
+            return
+        prompt = self._validate_admit(slot, prompt, max_new_tokens, rng)
+        p_len = prompt.shape[1]
+        # host-side prompt prep (the eager-jnp equivalent costs ~6 tiny
+        # device dispatches per ADMISSION — measured to be a third of
+        # the whole serve loop's wall at smoke scale): numpy pad to the
+        # prefill bucket, hand the jitted prefill the numpy array
+        bucket = prefill_bucket(p_len, self.t_max, self._n_ring)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[:, :p_len] = prompt
+        logits1, caches1 = self._sfns.prefill(self._params, padded,
+                                              np.int32(p_len))
+        self._insert(slot, caches1, logits1, p_len, max_new_tokens,
+                     eos_id, rng)
+
+    # -- chunked prefill --------------------------------------------------
+
+    def start_prefill(self, slot: int, prompt, max_new_tokens: int, *,
+                      rng=None, eos_id: int | None = None) -> None:
+        """Reserve `slot` and register a chunked prefill for `prompt`
+        WITHOUT dispatching anything: each later `prefill_step(slot)`
+        runs exactly one chunk (the scheduler interleaves one per decode
+        window, so a 16k-token prompt no longer stalls in-flight decodes
+        behind one monolithic dispatch). Consults the prefix cache for
+        the longest cached prefix — the suffix is all that will prefill.
+        The slot is excluded from `free_slots` until the final chunk's
+        insert (or `cancel_prefill`)."""
+        if self.prefill_chunk is None:
+            raise RuntimeError("engine built without prefill_chunk")
+        prompt = self._validate_admit(slot, prompt, max_new_tokens, rng)
+        start, caches, logits = 0, None, None
+        if self.prefix_cache is not None:
+            start, caches, logits = self.prefix_cache.lookup(prompt[0])
+            start = min(start, prompt.shape[1])
+        if caches is None:
+            caches = self._sfns.init_caches(1)
+        self._prefills[slot] = _PendingPrefill(
+            prompt=prompt, budget=int(max_new_tokens), rng=rng,
+            eos_id=eos_id, caches=caches, logits=logits,
+            next_start=start)
+
+    def prefill_step(self, slot: int) -> bool:
+        """Advance `slot`'s pending prefill by ONE chunk dispatch;
+        returns True when the request is fully admitted (final chunk +
+        insert happen together — the insert is a cheap scatter). Each
+        completed full-chunk boundary snapshots into the prefix cache,
+        so the NEXT request sharing the prefix prefills only its
+        suffix."""
+        pend = self._prefills.get(slot)
+        if pend is None:
+            raise ValueError(f"slot {slot} has no prefill in progress")
+        p_len = pend.prompt.shape[1]
+        c = self.prefill_chunk
+        if pend.next_start >= p_len:
+            # whole prompt served from the prefix cache (p_len on a
+            # chunk boundary): nothing to prefill, insert directly
+            done = True
+        else:
+            end = min(pend.next_start + c, p_len)
+            padded = np.zeros((1, c), np.int32)
+            padded[:, :end - pend.next_start] = pend.prompt[
+                :, pend.next_start:end]
+            pend.logits, pend.caches = self._sfns.prefill_chunk(
+                self._params, pend.caches, padded,
+                np.int32(pend.next_start), np.int32(end))
+            pend.next_start = end
+            if (self.prefix_cache is not None and end % c == 0):
+                self.prefix_cache.insert(pend.prompt[0, :end],
+                                         pend.caches, pend.logits)
+            done = pend.next_start >= p_len
+        if done:
+            del self._prefills[slot]
+            self._insert(slot, pend.caches, pend.logits, p_len,
+                         pend.budget, pend.eos_id, pend.rng)
+        return done
+
+    def cancel_prefill(self, slot: int) -> None:
+        """Drop a pending prefill (deadline hit while still chunking):
+        the partial caches are discarded and the slot returns to
+        free_slots immediately — nothing ever reached the batch row."""
+        self._prefills.pop(slot, None)
+
+    def prefilling(self) -> list[int]:
+        """Slots with a chunked prefill in progress, admission order."""
+        return list(self._prefills)
 
     # -- decode ---------------------------------------------------------
 
@@ -401,7 +628,7 @@ class SlotEngine:
         toks, self._caches, self._logits, self._kd, self._pos, self._rem = (
             self._efns.window(self._params, self._caches, self._logits,
                               self._kd, self._pos, self._rem, self._eos,
-                              n_steps))
+                              self._scales, n_steps))
         self._pending = (toks, snapshot)
 
     def abort_window(self) -> None:
@@ -451,30 +678,61 @@ class SlotEngine:
         """Jit-cache entry counts for the no-recompile contract: after
         warmup, admitting requests of ANY prompt length/budget into any
         slot must not grow these (gated by test)."""
-        return {"window": self._efns.window._cache_size(),
-                "insert": self._efns.insert._cache_size(),
-                "prefill": self._sfns.prefill._cache_size()}
+        out = {"window": self._efns.window._cache_size(),
+               "insert": self._efns.insert._cache_size(),
+               "prefill": self._sfns.prefill._cache_size()}
+        if self.prefill_chunk is not None:
+            out["prefill_chunk"] = self._sfns.prefill_chunk._cache_size()
+        return out
 
     def warmup(self, n_steps: int) -> None:
-        """Compile every program the serve loop will touch: the prefill
-        at every bucket length, the insert, and the masked window at
-        `n_steps` — so admission traffic after this triggers ZERO XLA
-        compilations. Runs on the real (empty) engine state with a ZERO
-        budget, so every row stays dead and the warmup dispatches are
-        bit-level no-ops."""
-        logits1 = caches1 = None
-        for b in prefill_buckets(self.t_max, self._n_ring):
-            logits1, caches1 = self._sfns.prefill(
-                self._params, np.zeros((1, b), np.int32), np.int32(b))
+        """Compile every program the serve loop will touch — so
+        admission traffic after this triggers ZERO XLA compilations:
+        the prefill shapes the admission path uses (every bucket length
+        monolithically, the ONE chunk shape when chunked — both
+        chunk-from-fresh and chunk-from-chunk chains), the insert, and
+        the masked window at `n_steps`. Runs on the real (empty) engine
+        state with a ZERO budget, so every row stays dead and the
+        warmup dispatches are bit-level no-ops."""
+        if self.prefill_chunk is not None:
+            c = self.prefill_chunk
+            caches1 = self._sfns.init_caches(1)
+            # two chunk steps: the first consumes init_caches' arrays,
+            # the second the chunk program's own (pinned) outputs — the
+            # steady-state chain every multi-chunk prompt runs
+            logits1, caches1 = self._sfns.prefill_chunk(
+                self._params, caches1, np.zeros((1, c), np.int32),
+                np.int32(0), np.int32(c))
+            if 2 * c <= self.t_max:
+                logits1, caches1 = self._sfns.prefill_chunk(
+                    self._params, caches1, np.zeros((1, c), np.int32),
+                    np.int32(c), np.int32(2 * c))
+        else:
+            logits1 = caches1 = None
+            for b in prefill_buckets(self.t_max, self._n_ring):
+                logits1, caches1 = self._sfns.prefill(
+                    self._params, np.zeros((1, b), np.int32), np.int32(b))
         # two full insert->window cycles: the steady-state inputs of
         # each program are the (sharding-pinned) OUTPUTS of the others,
         # so the second cycle warms exactly the executables the serve
         # loop reuses forever
         for _ in range(2):
             (self._caches, self._logits, self._kd, self._pos, self._rem,
-             self._eos) = self._efns.insert(
+             self._eos, self._scales) = self._efns.insert(
                 self._caches, self._logits, self._kd, self._pos,
-                self._rem, self._eos, caches1, logits1, np.int32(0),
-                np.int32(1), np.int32(0), np.int32(-1),
+                self._rem, self._eos, self._scales, caches1, logits1,
+                np.int32(0), np.int32(1), np.int32(0), np.int32(-1),
                 np.zeros(2, np.uint32))
             self.step_window(n_steps)
+
+    def kv_bytes_per_slot(self) -> int:
+        """HBM bytes of ring-cache state per decode slot (K + V rows
+        across blocks, plus dequant scales when int8) — the denominator
+        of the int8 capacity claim: slots_at_budget = budget // this."""
+        per = 0
+        for kc, vc in self._caches:
+            per += (kc.nbytes + vc.nbytes) // self.n_slots
+        for pair in self._scales:
+            for s in pair:
+                per += s.nbytes // self.n_slots
+        return per

@@ -29,8 +29,11 @@ class ServingMetrics:
     emitted. All times are seconds on the caller's clock.
     """
 
-    def __init__(self, logger=None):
+    def __init__(self, logger=None, prefix_cache=None):
         self.logger = logger
+        # when a PrefixCache is attached its serve_prefix_* counters
+        # roll into summary() next to the serving fields
+        self.prefix_cache = prefix_cache
         self.submitted = 0
         self.rejected = 0
         self.timed_out = 0
@@ -38,10 +41,14 @@ class ServingMetrics:
         self.tokens_out = 0
         self.cycles = 0
         self.ttft_s: list[float] = []
+        self.queue_wait_s: list[float] = []  # submit -> slot claimed
+        self.prefill_s: list[float] = []     # slot claimed -> first token
         self.token_s: list[float] = []      # per-token decode latency
         self.queue_depths: list[int] = []
         self.occupancies: list[float] = []
         self.cycle_tokens: list[int] = []
+        self.cycle_prefill_s: list[float] = []  # per-cycle decode stall
+        self._wait_by_rid: dict = {}
         self._t_first: float | None = None
         self._t_last: float | None = None
 
@@ -57,13 +64,32 @@ class ServingMetrics:
         self.rejected += 1
         self._log(event="serve_reject", id=rid)
 
+    def on_admit(self, rid, wait_s: float) -> None:
+        """A request claimed a slot `wait_s` seconds after submit — the
+        QUEUE-WAIT half of its eventual TTFT (the other half, from slot
+        claim to first token, is prefill compute + window wait). New
+        event type, new keys only: existing serve.jsonl consumers see
+        an unchanged record schema for the events they already parse."""
+        self.queue_wait_s.append(wait_s)
+        self._wait_by_rid[rid] = wait_s
+        self._log(event="serve_admit", id=rid, queue_wait_ms=wait_s * 1e3)
+
     def on_first_token(self, rid, ttft_s: float) -> None:
         self.ttft_s.append(ttft_s)
+        wait = self._wait_by_rid.pop(rid, None)
+        prefill = None if wait is None else max(ttft_s - wait, 0.0)
+        if prefill is not None:
+            self.prefill_s.append(prefill)
         self._log(event="serve_first_token", id=rid,
-                  ttft_ms=ttft_s * 1e3)
+                  ttft_ms=ttft_s * 1e3,
+                  prefill_ms=None if prefill is None else prefill * 1e3)
 
     def on_finish(self, rid, *, n_tokens: int, ttft_s: float | None,
                   decode_s: float, reason: str, t: float) -> None:
+        # a request cancelled before its first token never reaches
+        # on_first_token — drop its queue-wait entry here too or the
+        # dict grows for the server's lifetime under deadline pressure
+        self._wait_by_rid.pop(rid, None)
         self.finished += 1
         if reason in ("timeout", "deadline"):
             self.timed_out += 1
@@ -78,11 +104,12 @@ class ServingMetrics:
     # -- engine cycle ----------------------------------------------------
 
     def on_cycle(self, *, queue_depth: int, occupancy: float,
-                 tokens: int = 0) -> None:
+                 tokens: int = 0, prefill_s: float = 0.0) -> None:
         self.cycles += 1
         self.queue_depths.append(int(queue_depth))
         self.occupancies.append(float(occupancy))
         self.cycle_tokens.append(int(tokens))
+        self.cycle_prefill_s.append(float(prefill_s))
 
     # -- rollup -----------------------------------------------------------
 
@@ -93,7 +120,7 @@ class ServingMetrics:
         span = ((self._t_last - self._t_first)
                 if self._t_first is not None and self._t_last is not None
                 else None)
-        return {
+        out = {
             "serve_requests": self.finished,
             "serve_rejected": self.rejected,
             "serve_timed_out": self.timed_out,
@@ -103,6 +130,16 @@ class ServingMetrics:
                 if span and span > 0 else None),
             "serve_ttft_ms_p50": _r(_pct(self.ttft_s, 50), 1e3),
             "serve_ttft_ms_p95": _r(_pct(self.ttft_s, 95), 1e3),
+            # TTFT decomposed: time queued (submit -> slot claimed) vs
+            # time computing (slot claimed -> first token, i.e. prefill
+            # + first decode window) — which half dominates tells an
+            # operator whether to add slots or shrink prompts/chunks
+            "serve_queue_wait_ms_p50": _r(_pct(self.queue_wait_s, 50),
+                                          1e3),
+            "serve_queue_wait_ms_p95": _r(_pct(self.queue_wait_s, 95),
+                                          1e3),
+            "serve_prefill_ms_p50": _r(_pct(self.prefill_s, 50), 1e3),
+            "serve_prefill_ms_p95": _r(_pct(self.prefill_s, 95), 1e3),
             "serve_token_ms_p50": _r(_pct(self.token_s, 50), 1e3),
             "serve_slot_occupancy": (
                 round(float(np.mean(self.occupancies)), 4)
@@ -115,7 +152,18 @@ class ServingMetrics:
             "serve_window_tokens_mean": (
                 round(float(np.mean(self.cycle_tokens)), 2)
                 if self.cycle_tokens else None),
+            # host time per cycle spent admitting/prefilling before the
+            # next window dispatch — the decode stall chunking bounds
+            "serve_prefill_stall_ms_mean": (
+                _r(float(np.mean(self.cycle_prefill_s)), 1e3)
+                if self.cycle_prefill_s else None),
+            "serve_prefill_stall_ms_max": (
+                _r(float(np.max(self.cycle_prefill_s)), 1e3)
+                if self.cycle_prefill_s else None),
         }
+        if self.prefix_cache is not None:
+            out.update(self.prefix_cache.summary())
+        return out
 
     def _log(self, **record) -> None:
         if self.logger is not None:

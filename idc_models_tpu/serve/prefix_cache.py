@@ -1,0 +1,266 @@
+"""Radix prefix cache: KV snapshots at chunk boundaries, reused across
+requests that share a token prefix.
+
+Two requests carrying the same system prompt recompute identical K/V
+state from scratch under plain admission; with chunked prefill
+(models/lm.py `prefill_chunk`) every completed chunk boundary is a
+natural snapshot point — the caches at boundary k*C are a pure function
+of tokens[:k*C]. This module stores those snapshots in a radix tree
+whose edges are CHUNK-sized token tuples (vLLM/SGLang's prefix reuse,
+quantized to the chunk grid), and `SlotEngine.start_prefill` asks it for
+the longest cached prefix before prefilling only the suffix.
+
+Correctness contract (gated by tests/test_prefix_cache.py):
+
+- a HIT hands back deep COPIES of the stored arrays — the chunk program
+  donates its input caches, so the stored master must never enter a
+  donating dispatch;
+- a hit is bit-identical to recomputing the prefix, because the stored
+  snapshot IS the chunk program's output for those tokens (same
+  executables, same values — nothing approximate is stored);
+- eviction (LRU under `max_bytes`) only ever causes EXTRA prefill work:
+  a lookup after evict misses and the engine re-prefills from scratch —
+  stale state is structurally impossible because snapshots are keyed by
+  the full token prefix and never mutated in place.
+
+Snapshots are device-resident by default (HBM — a hit costs one device
+copy per array, no host round-trip); `host=True` stores numpy copies
+instead, trading hit latency for HBM (the budget then bounds host RSS).
+Counters (`hits`/`misses`/`evictions`/token-weighted hit rate) feed
+`ServingMetrics.summary()` and stream as `serve_prefix_*` events when a
+logger is attached — new event types only, the existing serve.jsonl
+record schema is untouched.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return sum(int(a.nbytes) for a in jax.tree.leaves(tree))
+
+
+def _copy_tree(tree, host: bool):
+    # host=True: genuine numpy COPIES (np.asarray would alias an
+    # already-numpy master — the contract is that nothing handed out or
+    # taken in shares buffers with the stored snapshot)
+    import jax
+    import jax.numpy as jnp
+
+    if host:
+        return jax.tree.map(lambda a: np.array(a, copy=True), tree)
+    return jax.tree.map(lambda a: jnp.array(a, copy=True), tree)
+
+
+class _Node:
+    __slots__ = ("children", "snapshot", "nbytes", "stamp", "parent",
+                 "edge", "hit_count")
+
+    def __init__(self, parent=None, edge=None):
+        self.children: dict[tuple, _Node] = {}
+        self.snapshot = None          # (caches, logits) or None
+        self.nbytes = 0
+        self.stamp = 0                # LRU clock at last touch
+        self.parent = parent
+        self.edge = edge              # the chunk tuple leading here
+        self.hit_count = 0            # lookups served from this node
+
+
+class PrefixCache:
+    """Radix tree of chunk-boundary KV snapshots with an LRU byte budget.
+
+    `chunk` fixes the snapshot grid: node depth d holds the state after
+    tokens[:d*chunk]. `max_bytes` bounds the summed nbytes of stored
+    snapshots (0 disables storage entirely — lookups always miss)."""
+
+    def __init__(self, chunk: int, max_bytes: int, *,
+                 host: bool = False, logger=None):
+        if chunk < 1:
+            raise ValueError(f"need chunk >= 1, got {chunk}")
+        if max_bytes < 0:
+            raise ValueError(f"need max_bytes >= 0, got {max_bytes}")
+        self.chunk = int(chunk)
+        self.max_bytes = int(max_bytes)
+        self.host = bool(host)
+        self.logger = logger
+        self._pack = None             # (caches, n_tokens) -> stored tree
+        self._unpack = None           # stored tree -> caller tree
+        self._root = _Node()
+        self._clock = 0
+        self.nbytes = 0
+        self.n_snapshots = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.hit_tokens = 0           # prefix tokens served from cache
+        self.lookup_tokens = 0        # prompt tokens seen by lookup
+
+    def set_packer(self, pack, unpack) -> None:
+        """Install a storage transform: ``pack(caches, n_tokens)`` maps
+        the live caches to what is STORED (the engine slices rows to
+        the prefix length — positions past it are zeros by
+        construction, so storing them buys nothing and a budget sized
+        for N prefixes would otherwise hold ~N*prefix/t_max of them);
+        ``unpack(stored)`` maps a stored tree back to what lookup hands
+        out (pad + re-place under the ring sharding — bit-identical
+        resume, and `unpack` must return FRESH arrays, never aliases of
+        the stored master). Identity when unset."""
+        self._pack, self._unpack = pack, unpack
+
+    # -- lookup / insert --------------------------------------------------
+
+    def _chunks(self, tokens) -> list[tuple]:
+        # one C-level tolist() (not a python int() per element): insert
+        # runs once per completed chunk boundary, so an admission pays
+        # O(P) host tokenization per boundary — with this constant it
+        # is dominated by the device chunk dispatch it accompanies
+        toks = np.asarray(tokens).reshape(-1).tolist()
+        n_full = len(toks) // self.chunk
+        return [tuple(toks[i * self.chunk:(i + 1) * self.chunk])
+                for i in range(n_full)]
+
+    def lookup(self, tokens):
+        """Longest cached prefix of `tokens` on the chunk grid.
+
+        Returns ``(start, caches, logits)``: `start` tokens are already
+        in the returned caches (0, None, None on a miss). The arrays are
+        fresh copies, safe to feed a donating chunk program; the stored
+        master is untouched."""
+        node, depth = self._root, 0
+        best, best_depth = None, 0
+        for edge in self._chunks(tokens):
+            node = node.children.get(edge)
+            if node is None:
+                break
+            depth += 1
+            if node.snapshot is not None:
+                best, best_depth = node, depth
+        self.lookup_tokens += int(np.asarray(tokens).size)
+        if best is None:
+            self.misses += 1
+            self._log(event="serve_prefix_miss",
+                      prompt_tokens=int(np.asarray(tokens).size))
+            return 0, None, None
+        self._clock += 1
+        best.stamp = self._clock
+        best.hit_count += 1
+        self.hits += 1
+        start = best_depth * self.chunk
+        self.hit_tokens += start
+        self._log(event="serve_prefix_hit", prefix_tokens=start,
+                  prompt_tokens=int(np.asarray(tokens).size))
+        caches, logits = best.snapshot
+        # BOTH halves leave as fresh arrays — logits too, even though
+        # today's call sites never donate or mutate them: the stored
+        # master must survive any future caller, not just the current
+        # ones. (unpack allocates fresh padded arrays by contract, so
+        # it subsumes the copy.)
+        caches = (self._unpack(caches) if self._unpack is not None
+                  else _copy_tree(caches, self.host))
+        return start, caches, _copy_tree(logits, self.host)
+
+    def insert(self, tokens, caches, logits) -> bool:
+        """Store the state after `tokens` (length must sit on the chunk
+        grid). Copies the arrays; returns False (and stores nothing)
+        when the snapshot alone exceeds the whole budget or the key is
+        already present (the existing entry is LRU-touched)."""
+        toks = np.asarray(tokens).reshape(-1)
+        if toks.size == 0 or toks.size % self.chunk:
+            raise ValueError(
+                f"prefix length {toks.size} is not a multiple of the "
+                f"chunk {self.chunk} — snapshots live on chunk "
+                f"boundaries only")
+        node = self._root
+        for edge in self._chunks(toks):
+            node = node.children.setdefault(edge, _Node(node, edge))
+        self._clock += 1
+        node.stamp = self._clock
+        if node.snapshot is not None:
+            return False
+        if self._pack is not None:
+            caches = self._pack(caches, int(toks.size))
+        snap = (_copy_tree(caches, self.host),
+                _copy_tree(logits, self.host))
+        size = _tree_bytes(snap)
+        if size > self.max_bytes:
+            self._prune(node)
+            return False
+        node.snapshot = snap
+        node.nbytes = size
+        self.nbytes += size
+        self.n_snapshots += 1
+        while self.nbytes > self.max_bytes and self.n_snapshots > 1:
+            self._evict_lru(protect=node)
+        return True
+
+    # -- eviction ---------------------------------------------------------
+
+    def _walk(self):
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.snapshot is not None:
+                yield n
+
+    def _evict_lru(self, protect=None) -> None:
+        # every chunk boundary of every prompt is snapshotted
+        # speculatively; only some ever serve a hit. Evict never-hit
+        # (speculative) snapshots before hit-proven ones, LRU within
+        # each class — a burst of long unique-tail prompts then churns
+        # its own useless snapshots instead of flushing the shared
+        # system-prefix state the cache exists for.
+        victims = [n for n in self._walk() if n is not protect]
+        if not victims:
+            return
+        v = min(victims, key=lambda n: (min(n.hit_count, 1), n.stamp))
+        self.nbytes -= v.nbytes
+        self.n_snapshots -= 1
+        self.evictions += 1
+        self._log(event="serve_prefix_evict", freed_bytes=v.nbytes)
+        v.snapshot, v.nbytes = None, 0
+        self._prune(v)
+
+    def _prune(self, node) -> None:
+        while (node is not self._root and node.snapshot is None
+               and not node.children and node.parent is not None):
+            del node.parent.children[node.edge]
+            node = node.parent
+
+    def clear(self) -> None:
+        self._root = _Node()
+        self.nbytes = 0
+        self.n_snapshots = 0
+
+    # -- observability ----------------------------------------------------
+
+    def hit_rate(self) -> float | None:
+        total = self.hits + self.misses
+        return None if total == 0 else self.hits / total
+
+    def token_hit_rate(self) -> float | None:
+        return (None if self.lookup_tokens == 0
+                else self.hit_tokens / self.lookup_tokens)
+
+    def summary(self) -> dict:
+        """The `serve_prefix_*` fields merged into the serving rollup."""
+        return {
+            "serve_prefix_hits": self.hits,
+            "serve_prefix_misses": self.misses,
+            "serve_prefix_evictions": self.evictions,
+            "serve_prefix_hit_rate": (
+                None if self.hit_rate() is None
+                else round(self.hit_rate(), 4)),
+            "serve_prefix_token_hit_rate": (
+                None if self.token_hit_rate() is None
+                else round(self.token_hit_rate(), 4)),
+            "serve_prefix_bytes": self.nbytes,
+            "serve_prefix_snapshots": self.n_snapshots,
+        }
+
+    def _log(self, **record) -> None:
+        if self.logger is not None:
+            self.logger.log(**record)

@@ -111,9 +111,14 @@ class Scheduler:
         self.admit_after_collect = admit_after_collect
         self.clock = clock
         self._running: dict[int, Entry] = {}
+        # chunked-prefill engines: entries whose prompt is still being
+        # chunked into a reserved slot (slot -> Entry); they join
+        # _running when the engine's final chunk + insert land
+        self._prefilling: dict[int, Entry] = {}
         # entries killed by an engine failure mid-tick: tick() re-raises
         # the engine error, so the caller collects them here (pop_failed)
         self._failed: list[Entry] = []
+        self._chunked = getattr(engine, "prefill_chunk", None) is not None
 
     # -- admission -------------------------------------------------------
 
@@ -157,25 +162,59 @@ class Scheduler:
     def _admit_free_slots(self) -> int:
         """Pop queued entries into free slots, at most
         max_prefills_per_cycle — the ONE admission bookkeeping path for
-        both tick() passes."""
+        both tick() passes. On a chunked engine admission only RESERVES
+        the slot (start_prefill dispatches nothing); the prompt is fed
+        chunk by chunk by `_step_prefills`, one chunk per cycle, so a
+        long prompt never stalls the decode windows behind one
+        monolithic dispatch."""
         admitted = 0
         free = self.engine.free_slots()
         while (admitted < self.max_prefills_per_cycle and free
                and len(self.queue)):
             e = self.queue.pop()
             slot = free.pop(0)
-            self.engine.admit(slot, e.prompt, e.budget, rng=e.rng,
-                              eos_id=(e.eos_id if e.eos_id is not None
-                                      else -1))
+            eos = e.eos_id if e.eos_id is not None else -1
             e.slot, e.status, e.t_admit = slot, "running", self.clock()
-            self._running[slot] = e
+            # registered BEFORE the engine call: if the engine raises
+            # mid-admission, tick's failure handler finds this entry in
+            # the tracking dict and fails it with the others instead of
+            # silently dropping it
+            if self._chunked:
+                self._prefilling[slot] = e
+                self.engine.start_prefill(slot, e.prompt, e.budget,
+                                          rng=e.rng, eos_id=eos)
+            else:
+                self._running[slot] = e
+                self.engine.admit(slot, e.prompt, e.budget, rng=e.rng,
+                                  eos_id=eos)
+            # recorded only AFTER the engine accepted the request — an
+            # admit that raises must not leave a phantom queue-wait
+            # sample (and _wait_by_rid entry) behind
+            if self.metrics:
+                self.metrics.on_admit(e.rid, e.t_admit - e.t_submit)
             admitted += 1
         return admitted
+
+    def _step_prefills(self) -> int:
+        """Advance pending chunked prefills: at most
+        max_prefills_per_cycle chunk DISPATCHES per cycle, oldest
+        pending prefill first (FIFO completes a long prompt before
+        starting to chunk the next — TTFT order follows admission
+        order). Entries whose final chunk lands move to _running and
+        decode from the next window. Returns chunk dispatches spent."""
+        steps = 0
+        while steps < self.max_prefills_per_cycle and self._prefilling:
+            slot = next(iter(self._prefilling))
+            if self.engine.prefill_step(slot):
+                self._running[slot] = self._prefilling.pop(slot)
+            steps += 1
+        return steps
 
     # -- the cycle -------------------------------------------------------
 
     def idle(self) -> bool:
-        return (not self._running and not len(self.queue)
+        return (not self._running and not self._prefilling
+                and not len(self.queue)
                 and self.engine._pending is None)
 
     def tick(self) -> list[Entry]:
@@ -190,10 +229,28 @@ class Scheduler:
         for e in self.queue.expire(now):
             e.status, e.finish_reason, e.t_done = "timeout", "deadline", now
             self._finish(e, done)
-        # 2. interleave policy: refill known-free slots, at most
-        #    max_prefills_per_cycle prefills per cycle — the prefill
-        #    dispatches overlap the in-flight window's execution
-        self._admit_free_slots()
+        # 2. interleave policy: refill known-free slots and (chunked
+        #    engines) advance pending prefills by at most
+        #    max_prefills_per_cycle chunk dispatches — all of it
+        #    overlapping the in-flight window's execution. The host
+        #    time this section takes is the per-cycle decode STALL a
+        #    monolithic prefill inflates, so it is measured and
+        #    reported (serve_chunked_prefill_decode_stall_ms).
+        #    An engine failure DURING admission/chunking gets the same
+        #    cleanup contract as collect()/begin_window() below: every
+        #    in-flight entry is failed + released, then the error
+        #    propagates — without this, a chunk dispatch that raises
+        #    would leave _prefilling populated (with caches already
+        #    donated to the dead dispatch) and wedge every later tick
+        t_pf = self.clock()
+        try:
+            admitted = self._admit_free_slots()
+            chunk_steps = self._step_prefills() if self._chunked else 0
+        except Exception as e:
+            self._failed.extend(done)
+            self._abort_running(e)
+            raise
+        prefill_stall_s = self.clock() - t_pf
         # 3. collect the in-flight window; recycle on EOS / budget.
         #    Only the recycle decisions happen here — per-token
         #    bookkeeping is deferred past the next dispatch (step 6) so
@@ -224,18 +281,43 @@ class Scheduler:
                 del self._running[slot]
                 finished.append(e)
         # 4. running requests past deadline are cancelled mid-generation
-        #    (after collect, so the partial tokens reach the result)
+        #    (after collect, so the partial tokens reach the result);
+        #    prefilling requests past deadline drop their partial chunks
+        #    and free the reserved slot immediately
         cancelled: list[Entry] = []
         for slot, e in list(self._running.items()):
             if e.deadline is not None and now >= e.deadline:
                 self.engine.release(slot)
                 del self._running[slot]
                 cancelled.append(e)
+        for slot, e in list(self._prefilling.items()):
+            if e.deadline is not None and now >= e.deadline:
+                self.engine.cancel_prefill(slot)
+                del self._prefilling[slot]
+                cancelled.append(e)
         # 5. second admission pass: slots freed by the JUST-collected
         #    window refill before the next window dispatches, so a
-        #    recycle costs one window of idleness, not two
+        #    recycle costs one window of idleness, not two. This pass's
+        #    prefill dispatches sit squarely in the device-idle gap, so
+        #    its host time joins the measured decode stall (on a
+        #    monolithic engine THIS is where recycle-refill prefills
+        #    land — omitting it would understate the baseline stall the
+        #    chunked-vs-monolithic bench comparison reports)
         if self.admit_after_collect:
-            self._admit_free_slots()
+            t_pf2 = self.clock()
+            try:
+                admitted += self._admit_free_slots()
+            except Exception as e:
+                # same salvage as a begin_window failure: the entries
+                # the just-collected window completed are real results
+                # — finalize them (and the step-1 expiries) into the
+                # pop_failed channel before aborting the rest
+                self._finalize_window(got, finished, cancelled, t_now,
+                                      now, self._failed)
+                self._failed.extend(done)
+                self._abort_running(e)
+                raise
+            prefill_stall_s += self.clock() - t_pf2
         # 6. dispatch the next window over every occupied slot
         occupancy = len(self._running) / self.engine.n_slots
         if self._running:
@@ -252,12 +334,18 @@ class Scheduler:
                 self._failed.extend(done)
                 self._abort_running(e)
                 raise
-        # 7. deferred bookkeeping — runs WHILE the new window computes
+        # 7. deferred bookkeeping — runs WHILE the new window computes.
+        #    Cycles that only admitted/prefilled (nothing decoding yet —
+        #    e.g. a long prompt's chunk-by-chunk admission) STILL record:
+        #    those are exactly the cycles whose stall the
+        #    serve_prefill_stall_* metric exists to expose; only truly
+        #    empty drain ticks are skipped.
         emitted = self._finalize_window(got, finished, cancelled, t_now,
                                         now, done)
-        if self._running and self.metrics:
+        if (self._running or admitted or chunk_steps) and self.metrics:
             self.metrics.on_cycle(queue_depth=len(self.queue),
-                                  occupancy=occupancy, tokens=emitted)
+                                  occupancy=occupancy, tokens=emitted,
+                                  prefill_s=prefill_stall_s)
         return done
 
     def drain(self) -> list[Entry]:
@@ -321,6 +409,15 @@ class Scheduler:
             e.error, e.t_done = detail, now
             self._finish(e, self._failed)
         self._running.clear()
+        for slot, e in list(self._prefilling.items()):
+            try:
+                self.engine.cancel_prefill(slot)
+            except Exception:  # noqa: S110 — same: reach every slot
+                pass
+            e.status, e.finish_reason = "error", "error"
+            e.error, e.t_done = detail, now
+            self._finish(e, self._failed)
+        self._prefilling.clear()
         # a window the failed engine still considers in flight would
         # wedge idle()/collect(); the device work is lost either way
         self.engine.abort_window()

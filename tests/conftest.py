@@ -43,6 +43,8 @@ else:
         _os.environ.pop(_var, None)
     jax.config.update("jax_compilation_cache_dir", None)
 
+import os  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -51,3 +53,156 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+# ---------------------------------------------------------------------------
+# Per-test duration budget (ISSUE 4 CI satellite)
+#
+# The tier-1 window is 870 s for the whole suite; one silently slow new
+# test erodes it for everyone. Every test's call duration is recorded
+# and printed in the terminal summary (so the tier-1 log carries the
+# data), and a PASSED test that is not marked `slow` FAILS if its call
+# exceeds the budget — mark it `slow` (excluded from tier-1) or split
+# it. IDC_TEST_BUDGET_S overrides the 60 s default; 0 disables.
+#
+# Two defenses keep machine phase from turning into failures (the
+# container's CPU throughput swings 2-4x on a minutes timescale — see
+# tier1-timing-and-noise):
+#  - the budget scales by a slowdown factor measured at session start
+#    (a fixed numpy workload vs its fast-phase reference time), so 60 s
+#    means "60 s on a nominal machine";
+#  - pre-existing tests measured >= ~15 s on slow phases are
+#    grandfathered at their current cost. The ratchet applies to
+#    everything NEW.
+# ---------------------------------------------------------------------------
+
+TEST_BUDGET_S = float(os.environ.get("IDC_TEST_BUDGET_S", "60"))
+
+
+def _machine_slowdown() -> float:
+    """How much slower the machine is RIGHT NOW than the fast phase: a
+    fixed f32 matmul workload vs its reference wall time (~0.15 s on
+    this container's fast phases; ~0.3 s mid-phase, >0.5 s when slow).
+    Clamped to >= 1 so a fast machine enforces the nominal budget.
+    Measured once at session start AND re-measured when a test first
+    exceeds the budget — the phase swings on a minutes timescale, so a
+    session-start sample alone would mis-sentence a test that ran
+    during a later slow phase."""
+    import time as _time
+
+    import numpy as _np
+
+    a = _np.random.default_rng(0).normal(size=(512, 512))
+    a = a.astype(_np.float32)
+    t0 = _time.perf_counter()
+    for _ in range(8):
+        a = _np.tanh(a @ a.T * 1e-3)
+    return max(1.0, (_time.perf_counter() - t0) / 0.15)
+
+
+_SLOWDOWN = _machine_slowdown() if TEST_BUDGET_S > 0 else 1.0
+
+BUDGET_GRANDFATHERED = {
+    "tests/test_attention_model.py::test_attention_classifier_learns_zigzag",
+    "tests/test_attention_model.py::"
+    "test_attention_classifier_learns_on_2d_mesh",
+    "tests/test_attention_model.py::"
+    "test_remat_identical_values_and_grads[pallas]",
+    "tests/test_attention_model.py::"
+    "test_remat_identical_values_and_grads[jnp]",
+    "tests/test_attention_model.py::"
+    "test_residual_stream_stays_seq_sharded[contiguous]",
+    "tests/test_attention_model.py::"
+    "test_residual_stream_stays_seq_sharded[zigzag]",
+    "tests/test_cli_e2e.py::test_cli_dense_cifar",
+    "tests/test_cli_e2e.py::test_cli_fed_checkpoint_gate_and_resume",
+    "tests/test_cli_e2e.py::test_cli_mobile",
+    "tests/test_cli_e2e.py::test_cli_attention",
+    "tests/test_cli_e2e.py::test_cli_secure_fed_paillier",
+    "tests/test_cli_e2e.py::test_cli_vgg_two_phase",
+    "tests/test_cli_e2e.py::test_cli_vgg_streamed",
+    "tests/test_cli_e2e.py::test_cli_vgg_pretrained_weights",
+    "tests/test_examples.py::test_example_runs[01_two_phase_vgg.py]",
+    "tests/test_examples.py::test_example_runs[05_attention_classifier.py]",
+    "tests/test_examples.py::test_example_runs[07_lm_train_and_generate.py]",
+    "tests/test_examples.py::"
+    "test_example_runs[08_serve_continuous_batching.py]",
+    "tests/test_examples.py::test_example_runs[09_federated_faults.py]",
+    "tests/test_faults.py::test_fault_plan_replays_bit_identically",
+    "tests/test_feature_cache.py::"
+    "test_two_phase_cached_matches_uncached_densenet",
+    "tests/test_feature_cache.py::"
+    "test_two_phase_cached_matches_uncached_mobilenet",
+    "tests/test_feature_cache.py::test_two_phase_cached_matches_uncached",
+    "tests/test_feature_cache.py::"
+    "test_cached_phase2_resumes_and_survives_cache_toggle",
+    "tests/test_feature_cache.py::test_densenet_split_composes_to_full",
+    "tests/test_feature_cache.py::test_mobilenet_split_composes_to_full",
+    "tests/test_federated.py::test_padded_dummy_clients_are_inert",
+    "tests/test_federated.py::test_server_state_checkpoint_roundtrip",
+    "tests/test_golden_learning.py::test_densenet_two_phase_learns_task",
+    "tests/test_golden_learning.py::test_mobilenet_two_phase_learns_task",
+    "tests/test_golden_learning.py::"
+    "test_vgg16_two_phase_learns_task_from_pretrained",
+    "tests/test_golden_learning.py::test_fedavg_learns_task",
+    "tests/test_golden_learning.py::test_secure_fedavg_learns_task",
+    "tests/test_lm.py::test_lm_learns_and_generates",
+    "tests/test_loop.py::test_profile_trace_writes_tensorboard_artifact",
+    "tests/test_models.py::test_densenet_stem_symmetric_padding",
+    "tests/test_multihost.py::test_two_process_dp_step_agrees",
+    "tests/test_ring_decode.py::test_batched_decode_rowwise_bit_parity",
+    "tests/test_robust.py::test_byzantine_robustness_acceptance",
+    "tests/test_secure.py::test_paillier_clients_full_protocol",
+    "tests/test_zigzag.py::test_unrolled_ring_matches_full[zigzag-pallas]",
+}
+
+_durations: list[tuple[float, str]] = []
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call":
+        return
+    global _SLOWDOWN
+    _durations.append((report.duration, report.nodeid))
+    over_budget = (TEST_BUDGET_S > 0
+                   and report.duration > TEST_BUDGET_S * _SLOWDOWN)
+    if over_budget:
+        # before sentencing, re-probe: the machine may have entered a
+        # slower phase since the session-start calibration (probing
+        # only on violations keeps the per-test overhead at zero)
+        _SLOWDOWN = max(_SLOWDOWN, _machine_slowdown())
+    effective = TEST_BUDGET_S * _SLOWDOWN
+    if (over_budget and report.passed
+            and report.duration > effective
+            and "slow" not in item.keywords
+            and report.nodeid not in BUDGET_GRANDFATHERED):
+        report.outcome = "failed"
+        report.longrepr = (
+            f"{report.nodeid} exceeded the tier-1 per-test budget: "
+            f"{report.duration:.1f}s > {effective:.0f}s "
+            f"({TEST_BUDGET_S:.0f}s budget x {_SLOWDOWN:.2f} measured "
+            f"machine slowdown). The suite shares an 870s window — "
+            f"mark the test `slow` (excluded from tier-1), split it, "
+            f"or shrink its workload. IDC_TEST_BUDGET_S overrides; "
+            f"grandfathered legacy tests are listed in "
+            f"tests/conftest.py.")
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _durations:
+        return
+    tr = terminalreporter
+    tr.section("tier-1 per-test durations (conftest budget hook)")
+    for dur, nodeid in sorted(_durations, reverse=True)[:15]:
+        tr.write_line(f"{dur:8.2f}s  {nodeid}")
+    total = sum(d for d, _ in _durations)
+    effective = TEST_BUDGET_S * _SLOWDOWN
+    over = sum(1 for d, _ in _durations if d > effective)
+    tr.write_line(
+        f"total {total:.1f}s across {len(_durations)} tests; "
+        f"{over} over the effective {effective:.0f}s budget "
+        f"({TEST_BUDGET_S:.0f}s x {_SLOWDOWN:.2f} machine slowdown; "
+        f"IDC_TEST_BUDGET_S to override, slow marker to exempt)")

@@ -277,6 +277,38 @@ def test_cli_serve_synthetic_trace(tmp_path, capsys):
     assert "serving 2 requests" in out and "served: ok=2" in out
 
 
+def test_cli_serve_chunked_prefix_int8(tmp_path, capsys):
+    """The PR-4 admission knobs from the product surface: chunked
+    prefill + prefix cache + int8 KV together, the TTFT decomposition
+    epilogue, and the serve_prefix_* summary fields. Correctness of the
+    underlying machinery is owned by tests/test_serve.py and
+    tests/test_prefix_cache.py."""
+    import json
+
+    out = _run(["serve", "--host-devices", "8", "--requests", "6",
+                "--slots", "2", "--window", "4", "--t-max", "32",
+                "--vocab", "11", "--embed-dim", "16", "--num-heads", "2",
+                "--mlp-dim", "32", "--num-blocks", "1",
+                "--prefill-chunk", "8", "--prefix-cache-mb", "16",
+                "--kv-dtype", "int8", "--path", str(tmp_path)], capsys)
+    assert "served: ok=6" in out
+    assert "ttft p95" in out and "queue-wait" in out
+    assert "prefix cache: hit rate" in out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("serve summary:")][0]
+    summary = json.loads(line.split("serve summary:", 1)[1])
+    assert "serve_prefix_hit_rate" in summary
+    assert summary["serve_queue_wait_ms_p95"] is not None
+    assert summary["serve_prefill_ms_p95"] is not None
+    # invalid knob combinations die with a usage error, not a traceback
+    with pytest.raises(SystemExit):
+        cli.main(["serve", "--host-devices", "8", "--t-max", "32",
+                  "--prefill-chunk", "5"])
+    with pytest.raises(SystemExit):
+        cli.main(["serve", "--host-devices", "8", "--t-max", "32",
+                  "--prefix-cache-mb", "4"])
+
+
 def test_cli_lm(tmp_path, capsys):
     """The causal-LM workload from the product surface: the CLI wiring
     only (mesh line, metric line, generate line, jsonl artifact, ring

@@ -172,7 +172,18 @@ def test_nonfinite_client_dropped_automatically(devices):
 def test_client_count_independent_of_device_count(devices):
     """k clients per device: the same 8 clients aggregated on an
     8-device mesh (k=1) and a 4-device mesh (k=2) produce the same
-    round — client count is a workload property, not a hardware one."""
+    round — client count is a workload property, not a hardware one.
+
+    Skipped where the BACKEND itself is not layout-deterministic for
+    this program shape (see tests/_layout_probe.py for the full
+    root-cause): on such builds the assertion tests XLA's lowering, not
+    the framework's math."""
+    import pytest
+
+    from _layout_probe import LAYOUT_SKIP_REASON, layout_invariant
+
+    if not layout_invariant():
+        pytest.skip(LAYOUT_SKIP_REASON)
     model = small_cnn(10, 3, 1)
     imgs, labels = _client_data(seed=7)
     w = np.full((N_CLIENTS,), imgs.shape[1], np.float32)

@@ -310,7 +310,16 @@ def test_secure_round_layout_invariant(devices):
     """k clients per device: the same 8 clients on an 8-device mesh
     (k=1), a 4-device mesh (k=2), and a 1-device mesh (k=8) produce the
     same aggregate — the protected int32 path bit-for-bit (mod-2^32
-    addition is layout-independent), the f32 path to fp tolerance."""
+    addition is layout-independent), the f32 path to fp tolerance.
+
+    Skipped where the BACKEND itself is not layout-deterministic for
+    the local-training program shape (see tests/_layout_probe.py): the
+    divergence is in the clients' LOCAL training lowering, upstream of
+    everything the secure protocol adds."""
+    from _layout_probe import LAYOUT_SKIP_REASON, layout_invariant
+
+    if not layout_invariant():
+        pytest.skip(LAYOUT_SKIP_REASON)
     model = small_cnn(10, 3, 1)
     ci, cl = _client_data(seed=13)
     rng = jax.random.key(21)

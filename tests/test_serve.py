@@ -303,6 +303,167 @@ def test_trace_roundtrip_and_poisson(devices, tmp_path):
     assert load_trace(p) == trace
 
 
+def test_chunked_prefill_token_parity_and_no_recompile(devices, params):
+    """Chunked admission (prefill_chunk=8) at every boundary length —
+    1, chunk-1, chunk, chunk+1 — emits tokens bit-identical to the
+    serial MONOLITHIC Generator, and after the first wave admits of
+    every further length compile nothing (the chunk program is one
+    executable for all prompt lengths, including the ragged tail)."""
+    server = LMServer(params, n_slots=2, window=4, prefill_chunk=8,
+                      **_kw())
+    gen = Generator(params, **_kw())
+    rng = np.random.default_rng(11)
+    lens = [1, 7, 8, 9, 17]
+    reqs = [Request(id=f"c{p}",
+                    prompt=tuple(int(x) for x in
+                                 rng.integers(0, VOCAB, p)),
+                    max_new_tokens=5)
+            for p in lens]
+    server.run([(0.0, reqs[0])])
+    sizes = server.engine.cache_sizes()
+    assert "prefill_chunk" in sizes
+    server.run([(0.0, r) for r in reqs[1:]])
+    assert server.engine.cache_sizes() == sizes, (
+        server.engine.cache_sizes(), sizes)
+    for r in reqs:
+        want = _serial_tokens(gen, r.prompt, r.max_new_tokens)
+        assert server.poll(r.id).tokens == want, r.id
+    # NOTE: sizes["prefill"] is not asserted 0 — the monolithic program
+    # cache is process-wide per config and other tests share it; the
+    # stability assertion above is the admission-path contract
+
+
+def test_chunked_prefill_sampled_parity_with_prefix_hits(devices, params):
+    """Seeded top-k sampling through CHUNKED admission WITH prefix-cache
+    hits: per-request streams must still match the serial Generator with
+    the same key, bit for bit — the request's rng stream is independent
+    of how its prompt was prefilled (and on a 1-device serving mesh the
+    chunk path's prefill state is bit-identical to the monolithic
+    one)."""
+    sys_p = tuple(int(x) for x in
+                  np.random.default_rng(21).integers(0, VOCAB, 8))
+    server = LMServer(params, n_slots=2, window=4, temperature=1.3,
+                      top_k=4, prefill_chunk=8, prefix_cache_mb=64.0,
+                      **_kw())
+    reqs = [Request(id=f"t{i}", prompt=sys_p + (i,), max_new_tokens=6,
+                    seed=300 + i)
+            for i in range(4)]
+    server.run([(0.0, r) for r in reqs])
+    assert server.summary()["serve_prefix_hits"] >= 3
+    gen = Generator(params, temperature=1.3, top_k=4, **_kw())
+    for r in reqs:
+        want = _serial_tokens(gen, r.prompt, r.max_new_tokens,
+                              rng=jax.random.key(r.seed))
+        assert server.poll(r.id).tokens == want, r.id
+
+
+def test_chunked_prefill_full_cache_prompt(devices, params):
+    """Prompt length == t_max: the chunk path fills the entire cache
+    and its final logits/caches match the monolithic prefill (argmax-
+    equal logits, fp-close caches) — the upper boundary the chunk grid
+    must tile exactly."""
+    gen = Generator(params, **_kw())
+    genc = Generator(params, prefill_chunk=8, **_kw())
+    prompt = jnp.asarray(
+        [np.random.default_rng(3).integers(0, VOCAB, SEQ)], jnp.int32)
+    l0, c0 = gen.prefill(prompt)
+    l1, c1 = genc.prefill(prompt)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1),
+                               rtol=2e-5, atol=2e-5)
+    assert int(jnp.argmax(l0)) == int(jnp.argmax(l1))
+    for (k0, v0), (k1, v1) in zip(c0, c1):
+        np.testing.assert_allclose(np.asarray(k0), np.asarray(k1),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(v0), np.asarray(v1),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_chunked_prefill_interleaves_with_decode(devices, params):
+    """The point of chunking: while a long prompt is being prefilled
+    chunk by chunk, an already-running request KEEPS emitting tokens
+    every window — and the chunked request's own output still matches
+    its serial generation bit-for-bit."""
+    eng = SlotEngine(params, n_slots=2, prefill_chunk=4, **_kw())
+    eng.warmup(4)
+    rng = np.random.default_rng(13)
+    pa = rng.integers(0, VOCAB, 3)
+    pb = rng.integers(0, VOCAB, 17)          # 5 chunks of 4
+    eng.admit(0, pa, 16)                     # decoding from the start
+    eng.start_prefill(1, pb, 6)
+    assert 1 not in eng.free_slots()         # reserved while chunking
+    got_a, got_b, windows_during_prefill = [], [], 0
+    done = False
+    while not done:
+        done = eng.prefill_step(1)
+        out = eng.step_window(2)
+        if not done:
+            windows_during_prefill += 1
+            assert out.get(0), "running slot stalled behind a prefill"
+        got_a.extend(out.get(0, []))
+        got_b.extend(out.get(1, []))
+    while eng._occupied.any():
+        for s, t in eng.step_window(2).items():
+            (got_a if s == 0 else got_b).extend(t)
+        for s in (0, 1):
+            if eng.finished(s):
+                eng.release(s)
+    assert windows_during_prefill >= 4       # decode ran between chunks
+    gen = Generator(params, **_kw())
+    assert got_a == _serial_tokens(gen, tuple(pa), 16)
+    assert got_b == _serial_tokens(gen, tuple(pb), 6)
+
+
+def test_chunked_deadline_cancels_prefilling_request(devices, params):
+    """A deadline that lands while a request is still CHUNKING its
+    prompt cancels the prefill: the reserved slot frees immediately, no
+    tokens are attributed, and the queue keeps moving."""
+    now = [0.0]
+    server = LMServer(params, n_slots=1, window=4, prefill_chunk=4,
+                      clock=lambda: now[0], **_kw())
+    # prompt of 5 chunks, one chunk per tick: deadline hits mid-chunking
+    server.submit(Request(id="long", prompt=tuple(range(1, 18)),
+                          max_new_tokens=4, deadline_s=1.0))
+    server.step()                            # start + first chunk
+    now[0] = 1.5
+    server.step()                            # deadline: cancel_prefill
+    r = server.poll("long")
+    assert r is not None and r.status == "timeout"
+    assert r.tokens == []
+    server.submit(Request(id="next", prompt=(1, 2), max_new_tokens=3))
+    server.drain()
+    assert server.poll("next").status == "ok"
+
+
+def test_int8_kv_capacity_and_bounded_drift(devices, params):
+    """int8 KV: ring-cache bytes per slot drop >= 1.5x vs the same
+    engine at bf16 (the capacity headroom the quantization buys), and
+    the quantized engine's greedy decode still tracks the serial bf16
+    path exactly on this model (drift is bounded well inside the
+    greedy argmax margin at these scales; docs/LONG_CONTEXT.md owns the
+    caveat for when it is not)."""
+    kw = dict(embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+              t_max=SEQ, mesh=None, cache_dtype=jnp.bfloat16)
+    eng16 = SlotEngine(params, n_slots=2, **kw)
+    eng8 = SlotEngine(params, n_slots=2, kv_dtype="int8", **kw)
+    ratio = eng16.kv_bytes_per_slot() / eng8.kv_bytes_per_slot()
+    assert ratio >= 1.5, ratio
+    server = LMServer(params, n_slots=2, window=4, kv_dtype="int8",
+                      **_kw())
+    gen = Generator(params, **_kw())
+    rng = np.random.default_rng(17)
+    reqs = [Request(id=f"i{k}",
+                    prompt=tuple(int(x) for x in
+                                 rng.integers(0, VOCAB, 4 + 3 * k)),
+                    max_new_tokens=6)
+            for k in range(3)]
+    server.run([(0.0, r) for r in reqs])
+    for r in reqs:
+        got = server.poll(r.id)
+        assert got.status == "ok"
+        assert got.tokens == _serial_tokens(gen, r.prompt,
+                                            r.max_new_tokens), r.id
+
+
 def test_engine_failure_releases_slots_and_surfaces_error(devices, params):
     """Satellite contract: if the engine fails mid-tick, the in-flight
     requests become status="error" Results (with the failure detail),
@@ -346,6 +507,39 @@ def test_engine_failure_releases_slots_and_surfaces_error(devices, params):
     assert [r.id for r in out] == ["c"] and out[0].status == "ok"
     assert out[0].error is None
     assert out[0].tokens == _serial_tokens(gen, [1, 2, 3], 6)
+
+
+def test_chunked_prefill_failure_releases_and_recovers(devices, params):
+    """An engine failure raised from a CHUNK dispatch mid-admission
+    gets the same cleanup contract as collect/begin_window failures:
+    the prefilling entry becomes an error Result, its reserved slot
+    frees, and the server keeps serving."""
+    server = LMServer(params, n_slots=2, window=4, prefill_chunk=4,
+                      **_kw())
+    assert server.submit(Request(id="long", prompt=tuple(range(1, 14)),
+                                 max_new_tokens=4))
+    real_step = server.engine.prefill_step
+
+    def boom(slot):
+        raise RuntimeError("chunk dispatch died")
+
+    server.engine.prefill_step = boom
+    with pytest.raises(RuntimeError, match="chunk dispatch died"):
+        server.step()
+    server.engine.prefill_step = real_step
+
+    r = server.poll("long")
+    assert r is not None and r.status == "error"
+    assert "chunk dispatch died" in r.error
+    assert server.scheduler.idle()
+    assert sorted(server.engine.free_slots()) == [0, 1]
+    # still serviceable, and output still matches serial
+    gen = Generator(params, **_kw())
+    assert server.submit(Request(id="next", prompt=(1, 2, 3),
+                                 max_new_tokens=5))
+    server.drain()
+    assert server.poll("next").tokens == _serial_tokens(gen, [1, 2, 3],
+                                                        5)
 
 
 def test_engine_failure_preserves_completed_entries(devices, params):
