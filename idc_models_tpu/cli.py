@@ -35,9 +35,17 @@ def main(argv: list[str] | None = None) -> int:
     runner = {"vgg": _run_dist, "mobile": _run_dist, "dense": _run_dist,
               "fed": _run_fed, "secure_fed": _run_secure,
               "attention": _run_attention, "lm": _run_lm,
-              "serve": _run_serve,
+              "serve": _run_serve, "stats": _run_stats,
               "convert_weights": _run_convert}[ns.preset_key]
-    runner(ns)
+    # --trace-out: ONE wiring point arms the runtime tracer for every
+    # verb — the instrumented spans (serve scheduler cycles, federated
+    # round attempts, train epochs/steps, Generator prefill/decode,
+    # every legacy Timer) record only while this context is active and
+    # export as Chrome trace-event JSON (Perfetto-loadable) on exit
+    from idc_models_tpu.observe import tracing
+
+    with tracing(chrome_path=getattr(ns, "trace_out", None)):
+        runner(ns)
     return 0
 
 
@@ -63,6 +71,11 @@ def _parse(argv):
         sp.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the training "
                              "phase here (TensorBoard-viewable)")
+        sp.add_argument("--trace-out", default=None,
+                        help="write a Chrome trace-event JSON of the "
+                             "run's host-side spans here (load it in "
+                             "Perfetto / chrome://tracing; see "
+                             "docs/OBSERVABILITY.md)")
 
     def pretrained_flag(sp):
         sp.add_argument("--pretrained-weights", default=None,
@@ -261,6 +274,14 @@ def _parse(argv):
     sp.add_argument("--seed", type=int, default=0)
     sp.add_argument("--host-devices", type=int, default=0,
                     help="force N virtual CPU devices (TPU stand-in)")
+    sp.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler trace of the serve loop "
+                         "here (TensorBoard-viewable)")
+    sp.add_argument("--trace-out", default=None,
+                    help="write a Chrome trace-event JSON of the serve "
+                         "loop's spans (admission, prefill chunks, "
+                         "decode windows, collects) here — "
+                         "Perfetto-loadable")
     sp.add_argument("--vocab", type=int, default=16)
     sp.add_argument("--t-max", type=int, default=64,
                     help="cache capacity per slot (prompt + generation)")
@@ -317,6 +338,18 @@ def _parse(argv):
                          "budget) at the cost of bounded logit drift — "
                          "leave bf16 when exact parity matters")
 
+    sp = sub.add_parser("stats",
+                        help="offline summary of any run jsonl (train, "
+                             "fed, or serve): per-event counts, "
+                             "percentiles over every numeric field, "
+                             "timer/span timing tables, and the last "
+                             "metrics snapshot — no re-run needed")
+    sp.add_argument("jsonl", help="path to a run.jsonl / serve.jsonl / "
+                                  "exported span jsonl")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object instead "
+                         "of the human table")
+
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
                              "save_weights .h5 into the framework's .npz "
@@ -348,6 +381,18 @@ def _logger(ns):
     if ns.path is None:
         return None
     return JsonlLogger(Path(ns.path) / "logs" / "run.jsonl")
+
+
+def _finish_logger(logger) -> None:
+    """The shared tail of every logged run: append ONE metrics_snapshot
+    record (the process-wide registry's counters/gauges/histograms —
+    a NEW additive event type the `stats` verb renders) and close."""
+    if not logger:
+        return
+    from idc_models_tpu.observe import REGISTRY
+
+    REGISTRY.log_snapshot(logger)
+    logger.close()
 
 
 def _data_root(ns):
@@ -434,6 +479,24 @@ def _fetch_scalars(tree):
 
 
 _fetch_scalars._stack = None
+
+
+def _run_stats(ns):
+    """Offline run-log rollup (observe/stats.py): works on any jsonl
+    the framework writes — train/fed run.jsonl, serve.jsonl, or a
+    tracer's exported span jsonl."""
+    import json
+
+    from idc_models_tpu.observe import format_summary, summarize_jsonl
+
+    p = Path(ns.jsonl)
+    if not p.exists():
+        sys.exit(f"stats: no such file: {p}")
+    summary = summarize_jsonl(p)
+    if ns.json:
+        print(json.dumps(summary))
+    else:
+        print(format_summary(summary))
 
 
 def _run_convert(ns):
@@ -569,7 +632,7 @@ def _run_dist(ns):
     print("test:", " ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
     if logger:
         logger.log(event="test", **test_metrics)
-        logger.close()
+    _finish_logger(logger)
 
 
 def _loss_for(num_outputs):
@@ -703,7 +766,7 @@ def _run_attention(ns):
     print("val:", " ".join(f"{k}={v:.4f}" for k, v in vm.items()))
     if logger:
         logger.log(event="val", **vm)
-        logger.close()
+    _finish_logger(logger)
 
 
 def _run_lm(ns):
@@ -825,8 +888,7 @@ def _run_lm(ns):
             # as bench.py's decode_ms_per_token (pure decode window)
             logger.log(event="generate", tokens=toks, matches=ok,
                        generate_ms_per_token=dt * 1e3 / n_gen)
-    if logger:
-        logger.close()
+    _finish_logger(logger)
 
 
 def _run_serve(ns):
@@ -843,7 +905,7 @@ def _run_serve(ns):
 
     from idc_models_tpu import mesh as meshlib
     from idc_models_tpu.models.lm import attention_lm, next_token_loss
-    from idc_models_tpu.observe import JsonlLogger, Timer
+    from idc_models_tpu.observe import JsonlLogger, Timer, profile_trace
     from idc_models_tpu.serve import LMServer, load_trace, poisson_trace
 
     n_dev = len(jax.devices())
@@ -919,7 +981,8 @@ def _run_serve(ns):
     print(f"serving {len(trace)} requests on {ns.slots} slots "
           f"(window {ns.window}, t_max {ns.t_max}, ring "
           f"{ns.seq_parallel})")
-    with Timer("Serving trace", logger=logger):
+    with Timer("Serving trace", logger=logger), \
+            profile_trace(ns.profile_dir):
         results = server.run(trace, realtime=ns.realtime)
     n_ok = sum(r.status == "ok" for r in results)
     summary = server.summary()
@@ -943,7 +1006,7 @@ def _run_serve(ns):
     print("serve summary:", json.dumps(summary))
     if logger:
         logger.log(event="serve_summary", **summary)
-        logger.close()
+    _finish_logger(logger)
 
 
 def _run_fed(ns):
@@ -1152,8 +1215,7 @@ def _run_fed(ns):
         print(f"[idc_models_tpu] {len(retried)} round attempt(s) "
               f"failed and were healed (rollback/reseed); see "
               f"round_health events", file=sys.stderr)
-    if logger:
-        logger.close()
+    _finish_logger(logger)
 
 
 def _run_secure(ns):
@@ -1197,6 +1259,7 @@ def _run_secure(ns):
                   "--paillier (host-side Paillier path)", file=sys.stderr)
         _run_secure_paillier(preset, n_clients, client_ds, test_ds, model,
                              opt, loss_fn, logger, ns)
+        _finish_logger(logger)
         return
 
     # strided shard per client (secure_fed_model.py:206-210), stacked for
@@ -1257,8 +1320,7 @@ def _run_secure(ns):
                 logger.log(event="round", round=r, train_loss=tm["loss"],
                            clients_recovered=recovered,
                            **{f"test_{k}": v for k, v in em.items()})
-    if logger:
-        logger.close()
+    _finish_logger(logger)
 
 
 def _run_secure_paillier(preset, n_clients, client_ds, test_ds, model, opt,

@@ -49,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from idc_models_tpu.federated.fedavg import ServerState, copy_tree
+from idc_models_tpu.observe import metrics_registry as mreg
+from idc_models_tpu.observe import trace
 
 
 class RoundFailure(RuntimeError):
@@ -167,6 +169,17 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
         if logger is not None and record["round"] > log_from_round:
             logger.log(event="round_health", **record)
 
+    # process-wide registry instruments (idempotent — resumed runs and
+    # multiple drivers share them); the jsonl `round`/`round_health`
+    # record schemas above are the back-compat contract and unchanged
+    m_attempts = mreg.REGISTRY.counter(
+        "fed_round_attempts_total", "federated round attempts by "
+        "outcome", labels=("status",))
+    m_seconds = mreg.REGISTRY.histogram(
+        "fed_round_seconds", "wall seconds per round attempt")
+    m_loss = mreg.REGISTRY.gauge(
+        "fed_train_loss", "last healthy round's training loss")
+
     last_error: Exception | None = None
     for r in range(start, config.rounds):
         for attempt in range(config.max_attempts):
@@ -180,49 +193,64 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
             t0 = clock()
             status, tm_host = "ok", {}
             candidate = None
-            try:
-                kw = {"round_idx": r} if takes_round_idx else {}
-                candidate, tm = round_fn(anchor, images, labels, w, rng,
-                                         **kw)
-                # ONE blocking fetch: materializes the round's metrics
-                # AND fences the wall-clock window (the dispatch alone
-                # returns before the device finishes)
-                tm_host = {k: float(v)
-                           for k, v in jax.device_get(tm).items()}
-                params_ok = bool(finite_fn(candidate.params)) and bool(
-                    finite_fn(candidate.model_state))
-                if not params_ok or not np.isfinite(
-                        tm_host.get("loss", np.nan)):
-                    status = "diverged"
-                elif (config.loss_spike_ratio is not None
-                      and ref_loss is not None
-                      and tm_host["loss"]
-                      > config.loss_spike_ratio * ref_loss):
-                    status = "diverged"
-            except Exception as e:  # noqa: BLE001 — chained into RoundFailure
-                last_error = e
-                status = "error"
-                tm_host = {"error": f"{type(e).__name__}: {e}"}
-            elapsed = clock() - t0
-            timeout_exempt = (config.timeout_exempt_first
-                              and not first_attempt_done)
-            first_attempt_done = True
-            if (status == "ok" and config.timeout_s is not None
-                    and not timeout_exempt
-                    and elapsed > config.timeout_s):
-                status = "timeout"
-            record = {"round": r, "attempt": attempt, "status": status,
-                      "seconds": round(elapsed, 4),
-                      "participants": int(
-                          (np.asarray(jax.device_get(w)) > 0).sum()),
-                      **{k: v for k, v in tm_host.items()
-                         if k in ("loss", "accuracy", "clients_dropped",
-                                  "clients_clipped", "clients_trimmed",
-                                  "trim_degenerate", "error")}}
+            # the with-block (not paired __enter__/__exit__ calls)
+            # guarantees the span closes even on exits the except below
+            # does not catch (KeyboardInterrupt, an error materializing
+            # the record) — a leaked open span would corrupt the
+            # parenting of every later span on this thread
+            with trace.span("fed.round", round=r,
+                            attempt=attempt) as att_span:
+                try:
+                    kw = {"round_idx": r} if takes_round_idx else {}
+                    candidate, tm = round_fn(anchor, images, labels, w,
+                                             rng, **kw)
+                    # ONE blocking fetch: materializes the round's
+                    # metrics AND fences the wall-clock window (the
+                    # dispatch alone returns before the device finishes)
+                    tm_host = {k: float(v)
+                               for k, v in jax.device_get(tm).items()}
+                    params_ok = bool(finite_fn(candidate.params)) and bool(
+                        finite_fn(candidate.model_state))
+                    if not params_ok or not np.isfinite(
+                            tm_host.get("loss", np.nan)):
+                        status = "diverged"
+                    elif (config.loss_spike_ratio is not None
+                          and ref_loss is not None
+                          and tm_host["loss"]
+                          > config.loss_spike_ratio * ref_loss):
+                        status = "diverged"
+                except Exception as e:  # noqa: BLE001 — chained into RoundFailure
+                    last_error = e
+                    status = "error"
+                    tm_host = {"error": f"{type(e).__name__}: {e}"}
+                elapsed = clock() - t0
+                timeout_exempt = (config.timeout_exempt_first
+                                  and not first_attempt_done)
+                first_attempt_done = True
+                if (status == "ok" and config.timeout_s is not None
+                        and not timeout_exempt
+                        and elapsed > config.timeout_s):
+                    status = "timeout"
+                record = {"round": r, "attempt": attempt,
+                          "status": status,
+                          "seconds": round(elapsed, 4),
+                          "participants": int(
+                              (np.asarray(jax.device_get(w)) > 0).sum()),
+                          **{k: v for k, v in tm_host.items()
+                             if k in ("loss", "accuracy",
+                                      "clients_dropped",
+                                      "clients_clipped",
+                                      "clients_trimmed",
+                                      "trim_degenerate", "error")}}
+                att_span.set(status=status,
+                             participants=record["participants"])
+            m_attempts.inc(status=status)
+            m_seconds.observe(elapsed)
             health(record)
             if status == "ok":
                 good = candidate
                 ref_loss = tm_host["loss"]
+                m_loss.set(ref_loss)
                 entry = {"round": r, "attempts": attempt + 1, **{
                     k: v for k, v in tm_host.items()}}
                 if eval_fn is not None:

@@ -43,6 +43,7 @@ from jax.sharding import Mesh
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
 from idc_models_tpu.models.attention import _seq_pin, transformer_block
+from idc_models_tpu.observe import trace
 from idc_models_tpu.ring_decode import (
     cache_sharding, init_cache, make_chunk_ring_decode, make_ring_decode,
 )
@@ -655,11 +656,15 @@ class Generator:
             n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
             padded, p_len = _pad_prompt(_check_prompt(prompt, self.t_max),
                                         self.t_max, n_ring)
-            return self._fns.prefill(self._params, padded,
-                                     np.int32(p_len))
+            with trace.span("lm.prefill", p_len=p_len,
+                            bucket=padded.shape[1]):
+                return self._fns.prefill(self._params, padded,
+                                         np.int32(p_len))
         tokens = np.asarray(_check_prompt(prompt, self.t_max))
-        return chunked_prefill(self._fns, self._params,
-                               tokens, self.prefill_chunk)
+        with trace.span("lm.prefill", p_len=tokens.shape[1],
+                        chunk=self.prefill_chunk):
+            return chunked_prefill(self._fns, self._params,
+                                   tokens, self.prefill_chunk)
 
     def decode(self, caches, logits, pos0: int, steps: int, *, rng=None):
         """Emit `steps` tokens in ONE dispatch from (caches, logits) at
@@ -684,8 +689,11 @@ class Generator:
         if rng is None:
             rng = jax.random.key(0)      # greedy never consumes it
         offsets = jnp.arange(pos0, pos0 + steps, dtype=jnp.int32)
-        return self._fns.decode_loop(self._params, caches, logits, rng,
-                                     offsets)
+        # span covers the fused-scan DISPATCH (decode is async; the
+        # caller's token fetch is the execution fence)
+        with trace.span("lm.decode", pos0=pos0, steps=steps):
+            return self._fns.decode_loop(self._params, caches, logits,
+                                         rng, offsets)
 
     def __call__(self, prompt, steps: int, *, rng=None):
         prompt = jnp.asarray(prompt, jnp.int32)

@@ -19,9 +19,19 @@ def plot_history(path: str | os.PathLike, history: dict,
                  history_fine: dict | None, num_devices: int,
                  *, initial_epochs: int | None = None) -> str:
     """Save the 2-panel acc/loss figure; returns the written file path."""
+    # Force the headless backend BEFORE this function's pyplot import:
+    # on a display-less CI container an interactive default backend
+    # raises at pyplot import time. The env var (honored at matplotlib
+    # import) + use(force=True) (re-selects even if someone imported
+    # pyplot first) together make plotting display-independent —
+    # scoped HERE, not at module import, so merely importing the
+    # library never mutates the process environment for an embedding
+    # application's own matplotlib use. setdefault keeps an explicit
+    # user choice.
+    os.environ.setdefault("MPLBACKEND", "Agg")
     import matplotlib
 
-    matplotlib.use("Agg")
+    matplotlib.use("Agg", force=True)
     import matplotlib.pyplot as plt
 
     acc = list(history.get("accuracy", []))

@@ -1,0 +1,137 @@
+"""Offline run-log summarizer — the `stats` CLI subcommand's engine.
+
+Every loop in the framework writes the same append-only jsonl record
+shape (`observe.JsonlLogger`): train epochs, federated rounds and
+round_health attempts, serve_* request events, timer records, span
+exports, metrics snapshots. This module reads ANY of those files and
+rolls it up offline: per-event counts, percentiles over every numeric
+field, named timer/span timing tables, and the last metrics snapshot —
+so "what did this run spend its time on" is one command against the
+artifact, no re-run needed.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+# fields that are identifiers/timestamps, not measurements
+_SKIP_FIELDS = {"ts", "id", "round", "attempt", "epoch", "step", "seed",
+                "parent", "tid", "wall", "t_ms"}
+
+
+def _num_stats(values: list[float]) -> dict:
+    a = np.asarray(values, np.float64)
+    return {
+        "count": int(a.size),
+        "mean": round(float(a.mean()), 4),
+        "p50": round(float(np.percentile(a, 50)), 4),
+        "p95": round(float(np.percentile(a, 95)), 4),
+        "min": round(float(a.min()), 4),
+        "max": round(float(a.max()), 4),
+    }
+
+
+def summarize_jsonl(path) -> dict:
+    """Parse a run jsonl into the summary dict `format_summary` prints.
+    Unparseable lines are counted, never fatal (a crash mid-write can
+    truncate the final line of an append-only log)."""
+    path = Path(path)
+    records, bad = [], 0
+    for line in path.read_text().splitlines():
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            bad += 1
+    by_event: dict[str, dict] = {}
+    timers: dict[str, list[float]] = {}
+    spans: dict[str, list[float]] = {}
+    last_snapshot = None
+    ts = [r["ts"] for r in records
+          if isinstance(r.get("ts"), (int, float))]
+    for r in records:
+        event = str(r.get("event", r.get("kind", "<none>")))
+        slot = by_event.setdefault(event, {"count": 0, "fields": {}})
+        slot["count"] += 1
+        for k, v in r.items():
+            if (k in _SKIP_FIELDS or k == "event"
+                    or isinstance(v, bool)
+                    or not isinstance(v, (int, float))):
+                continue
+            slot["fields"].setdefault(k, []).append(float(v))
+        if event == "timer" and isinstance(r.get("seconds"),
+                                           (int, float)):
+            timers.setdefault(str(r.get("name")), []).append(
+                float(r["seconds"]))
+        if event == "span" and isinstance(r.get("dur_ms"),
+                                          (int, float)):
+            spans.setdefault(str(r.get("name")), []).append(
+                float(r["dur_ms"]))
+        if event == "metrics_snapshot":
+            last_snapshot = r.get("metrics")
+    events = {
+        ev: {"count": slot["count"],
+             "fields": {k: _num_stats(vs)
+                        for k, vs in sorted(slot["fields"].items())}}
+        for ev, slot in sorted(by_event.items())}
+    return {
+        "path": str(path),
+        "records": len(records),
+        "unparseable_lines": bad,
+        "wall_span_s": (round(max(ts) - min(ts), 3) if len(ts) >= 2
+                        else None),
+        "events": events,
+        "timers": {n: _num_stats(vs) for n, vs in sorted(timers.items())},
+        "spans": {n: {**_num_stats(vs),
+                      "total_ms": round(float(np.sum(vs)), 3)}
+                  for n, vs in sorted(spans.items())},
+        "metrics": last_snapshot,
+    }
+
+
+def format_summary(s: dict) -> str:
+    """Human terminal rendering of `summarize_jsonl`'s dict."""
+    out = [f"{s['path']}: {s['records']} records"
+           + (f" ({s['unparseable_lines']} unparseable)"
+              if s["unparseable_lines"] else "")
+           + (f", {s['wall_span_s']}s wall span"
+              if s["wall_span_s"] is not None else "")]
+    out.append("")
+    out.append("events:")
+    for ev, slot in s["events"].items():
+        out.append(f"  {ev:24s} x{slot['count']}")
+        for k, st in slot["fields"].items():
+            out.append(
+                f"    {k:24s} mean={st['mean']} p50={st['p50']} "
+                f"p95={st['p95']} min={st['min']} max={st['max']}")
+    if s["timers"]:
+        out.append("")
+        out.append("timers (seconds):")
+        for name, st in s["timers"].items():
+            out.append(f"  {name:40s} x{st['count']} mean={st['mean']} "
+                       f"p95={st['p95']}")
+    if s["spans"]:
+        out.append("")
+        out.append("spans (ms):")
+        for name, st in s["spans"].items():
+            out.append(f"  {name:28s} x{st['count']} "
+                       f"total={st['total_ms']} mean={st['mean']} "
+                       f"p50={st['p50']} p95={st['p95']}")
+    if s["metrics"]:
+        out.append("")
+        out.append("last metrics snapshot:")
+        for rec in s["metrics"]:
+            lbl = ("{" + ",".join(f"{k}={v}" for k, v in
+                                  sorted(rec["labels"].items())) + "}"
+                   if rec.get("labels") else "")
+            if rec["type"] == "histogram":
+                out.append(f"  {rec['name']}{lbl} count={rec['count']} "
+                           f"sum={rec['sum']} min={rec['min']} "
+                           f"max={rec['max']}")
+            else:
+                out.append(f"  {rec['name']}{lbl} = {rec['value']}")
+    return "\n".join(out)

@@ -13,10 +13,18 @@ from __future__ import annotations
 import contextlib
 import time
 
+from idc_models_tpu.observe import trace
+
 
 class Timer:
     """`with Timer("Pre-training for 10 epochs"):` — prints the reference's
-    exact line; `.seconds` holds the measurement afterwards."""
+    exact line; `.seconds` holds the measurement afterwards.
+
+    When a tracer is active (observe/trace.py) the Timer ALSO records a
+    span of the same name, so every legacy Timer call site shows up in
+    exported traces for free; with tracing disabled the span handle is
+    the shared no-op and the historical behavior (print + optional
+    jsonl record) is unchanged."""
 
     def __init__(self, name: str, *, logger=None, quiet: bool = False):
         self.name = name
@@ -25,11 +33,13 @@ class Timer:
         self.seconds: float | None = None
 
     def __enter__(self) -> "Timer":
+        self._span = trace.span(self.name, timer=True).__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.seconds = time.perf_counter() - self._t0
+        self._span.__exit__(exc_type, exc, tb)
         if not self.quiet:
             print(f"{self.name} took {self.seconds} seconds")
         if self.logger is not None:

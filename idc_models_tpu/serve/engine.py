@@ -66,6 +66,7 @@ from jax import lax
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
+from idc_models_tpu.observe import trace
 from idc_models_tpu.models.lm import (
     _make_pick, _place_params, _serve_config, _serving_fns,
     _token_forward, check_prefill_chunk, prefill_bucket, prefill_buckets,
@@ -534,12 +535,14 @@ class SlotEngine:
         # the whole serve loop's wall at smoke scale): numpy pad to the
         # prefill bucket, hand the jitted prefill the numpy array
         bucket = prefill_bucket(p_len, self.t_max, self._n_ring)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[:, :p_len] = prompt
-        logits1, caches1 = self._sfns.prefill(self._params, padded,
-                                              np.int32(p_len))
-        self._insert(slot, caches1, logits1, p_len, max_new_tokens,
-                     eos_id, rng)
+        with trace.span("serve.prefill", slot=slot, p_len=p_len,
+                        bucket=bucket):
+            padded = np.zeros((1, bucket), np.int32)
+            padded[:, :p_len] = prompt
+            logits1, caches1 = self._sfns.prefill(self._params, padded,
+                                                  np.int32(p_len))
+            self._insert(slot, caches1, logits1, p_len, max_new_tokens,
+                         eos_id, rng)
 
     # -- chunked prefill --------------------------------------------------
 
@@ -585,16 +588,19 @@ class SlotEngine:
             done = True
         else:
             end = min(pend.next_start + c, p_len)
-            padded = np.zeros((1, c), np.int32)
-            padded[:, :end - pend.next_start] = pend.prompt[
-                :, pend.next_start:end]
-            pend.logits, pend.caches = self._sfns.prefill_chunk(
-                self._params, pend.caches, padded,
-                np.int32(pend.next_start), np.int32(end))
-            pend.next_start = end
-            if (self.prefix_cache is not None and end % c == 0):
-                self.prefix_cache.insert(pend.prompt[0, :end],
-                                         pend.caches, pend.logits)
+            with trace.span("serve.prefill_chunk", slot=slot,
+                            start=pend.next_start, end=end,
+                            p_len=p_len):
+                padded = np.zeros((1, c), np.int32)
+                padded[:, :end - pend.next_start] = pend.prompt[
+                    :, pend.next_start:end]
+                pend.logits, pend.caches = self._sfns.prefill_chunk(
+                    self._params, pend.caches, padded,
+                    np.int32(pend.next_start), np.int32(end))
+                pend.next_start = end
+                if (self.prefix_cache is not None and end % c == 0):
+                    self.prefix_cache.insert(pend.prompt[0, :end],
+                                             pend.caches, pend.logits)
             done = pend.next_start >= p_len
         if done:
             del self._prefills[slot]

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from idc_models_tpu.observe import metrics_registry as mreg
+
 
 def _pct(values, q) -> float | None:
     if not values:
@@ -27,13 +29,43 @@ class ServingMetrics:
     (TTFT), `on_finish` with the whole request's timing, and `on_cycle`
     once per engine cycle with queue depth / slot occupancy / tokens
     emitted. All times are seconds on the caller's clock.
+
+    Every hook ALSO updates the process-wide metrics registry
+    (observe/metrics_registry.py: serve_* counters/gauges/histograms)
+    — additive instrumentation only; the jsonl records this class has
+    always written keep their exact keys (gated by test).
     """
 
-    def __init__(self, logger=None, prefix_cache=None):
+    def __init__(self, logger=None, prefix_cache=None, registry=None):
         self.logger = logger
         # when a PrefixCache is attached its serve_prefix_* counters
         # roll into summary() next to the serving fields
         self.prefix_cache = prefix_cache
+        reg = registry if registry is not None else mreg.REGISTRY
+        # submissions and terminal outcomes are SEPARATE counters: a
+        # single status-labeled counter would count every completed
+        # request twice (once as "submitted", once at finish), doubling
+        # any sum(rate(...)) a Prometheus consumer runs over the labels
+        self._m_submitted = reg.counter(
+            "serve_requests_submitted_total", "requests submitted")
+        self._m_requests = reg.counter(
+            "serve_requests_total",
+            "requests by terminal outcome", labels=("status",))
+        self._m_tokens = reg.counter(
+            "serve_tokens_emitted_total", "decode tokens emitted")
+        self._m_ttft = reg.histogram(
+            "serve_ttft_seconds", "submit -> first token")
+        self._m_queue = reg.gauge(
+            "serve_queue_depth", "admission queue depth (last cycle)")
+        self._m_occ = reg.gauge(
+            "serve_slot_occupancy",
+            "fraction of decode slots running (last cycle)")
+        self._m_compiles = reg.counter(
+            "serve_compiles_total",
+            "XLA compiles observed as jit cache-size growth after the "
+            "first cycle")
+        self._jit_cache_seen: int | None = None
+        self.compiles_observed = 0
         self.submitted = 0
         self.rejected = 0
         self.timed_out = 0
@@ -58,10 +90,12 @@ class ServingMetrics:
         self.submitted += 1
         if self._t_first is None:
             self._t_first = t
+        self._m_submitted.inc()
         self._log(event="serve_submit", id=rid)
 
     def on_reject(self, rid, t: float) -> None:
         self.rejected += 1
+        self._m_requests.inc(status="rejected")
         self._log(event="serve_reject", id=rid)
 
     def on_admit(self, rid, wait_s: float) -> None:
@@ -75,6 +109,7 @@ class ServingMetrics:
         self._log(event="serve_admit", id=rid, queue_wait_ms=wait_s * 1e3)
 
     def on_first_token(self, rid, ttft_s: float) -> None:
+        self._m_ttft.observe(ttft_s)
         self.ttft_s.append(ttft_s)
         wait = self._wait_by_rid.pop(rid, None)
         prefill = None if wait is None else max(ttft_s - wait, 0.0)
@@ -93,6 +128,9 @@ class ServingMetrics:
         self.finished += 1
         if reason in ("timeout", "deadline"):
             self.timed_out += 1
+        self._m_requests.inc(status=str(reason))
+        if n_tokens:
+            self._m_tokens.inc(n_tokens)
         self.tokens_out += n_tokens
         self._t_last = t
         if n_tokens > 1 and decode_s > 0:
@@ -106,10 +144,24 @@ class ServingMetrics:
     def on_cycle(self, *, queue_depth: int, occupancy: float,
                  tokens: int = 0, prefill_s: float = 0.0) -> None:
         self.cycles += 1
+        self._m_queue.set(queue_depth)
+        self._m_occ.set(occupancy)
         self.queue_depths.append(int(queue_depth))
         self.occupancies.append(float(occupancy))
         self.cycle_tokens.append(int(tokens))
         self.cycle_prefill_s.append(float(prefill_s))
+
+    def on_jit_cache(self, total_entries: int) -> None:
+        """Called once per cycle with the summed jit-cache entry count
+        of the engine's compiled programs; any growth AFTER the first
+        observation is a compile the serve loop paid for mid-traffic
+        (the no-recompile contract says zero after warmup)."""
+        if self._jit_cache_seen is not None:
+            delta = total_entries - self._jit_cache_seen
+            if delta > 0:
+                self._m_compiles.inc(delta)
+                self.compiles_observed += delta
+        self._jit_cache_seen = total_entries
 
     # -- rollup -----------------------------------------------------------
 
@@ -160,6 +212,10 @@ class ServingMetrics:
             "serve_prefill_stall_ms_max": (
                 _r(float(np.max(self.cycle_prefill_s)), 1e3)
                 if self.cycle_prefill_s else None),
+            # NEW key (additive — existing consumers unaffected): jit
+            # cache-size growth seen after the first cycle; nonzero
+            # means admission traffic compiled something mid-serve
+            "serve_compiles_observed": self.compiles_observed,
         }
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.summary())

@@ -77,7 +77,7 @@ class PrefixCache:
     snapshots (0 disables storage entirely — lookups always miss)."""
 
     def __init__(self, chunk: int, max_bytes: int, *,
-                 host: bool = False, logger=None):
+                 host: bool = False, logger=None, registry=None):
         if chunk < 1:
             raise ValueError(f"need chunk >= 1, got {chunk}")
         if max_bytes < 0:
@@ -86,6 +86,20 @@ class PrefixCache:
         self.max_bytes = int(max_bytes)
         self.host = bool(host)
         self.logger = logger
+        # registry mirrors of the instance counters below — additive
+        # (the jsonl events and summary() fields are unchanged);
+        # registry=None uses the process-wide default, same knob as
+        # ServingMetrics so tests can isolate instruments
+        from idc_models_tpu.observe import metrics_registry as mreg
+
+        reg = registry if registry is not None else mreg.REGISTRY
+        self._m_lookups = reg.counter(
+            "serve_prefix_lookups_total",
+            "prefix-cache lookups by outcome", labels=("result",))
+        self._m_evictions = reg.counter(
+            "serve_prefix_evictions_total", "LRU snapshot evictions")
+        self._m_bytes = reg.gauge(
+            "serve_prefix_cache_bytes", "bytes of stored snapshots")
         self._pack = None             # (caches, n_tokens) -> stored tree
         self._unpack = None           # stored tree -> caller tree
         self._root = _Node()
@@ -141,6 +155,7 @@ class PrefixCache:
         self.lookup_tokens += int(np.asarray(tokens).size)
         if best is None:
             self.misses += 1
+            self._m_lookups.inc(result="miss")
             self._log(event="serve_prefix_miss",
                       prompt_tokens=int(np.asarray(tokens).size))
             return 0, None, None
@@ -148,6 +163,7 @@ class PrefixCache:
         best.stamp = self._clock
         best.hit_count += 1
         self.hits += 1
+        self._m_lookups.inc(result="hit")
         start = best_depth * self.chunk
         self.hit_tokens += start
         self._log(event="serve_prefix_hit", prefix_tokens=start,
@@ -194,6 +210,7 @@ class PrefixCache:
         self.n_snapshots += 1
         while self.nbytes > self.max_bytes and self.n_snapshots > 1:
             self._evict_lru(protect=node)
+        self._m_bytes.set(self.nbytes)
         return True
 
     # -- eviction ---------------------------------------------------------
@@ -220,6 +237,8 @@ class PrefixCache:
         self.nbytes -= v.nbytes
         self.n_snapshots -= 1
         self.evictions += 1
+        self._m_evictions.inc()
+        self._m_bytes.set(self.nbytes)
         self._log(event="serve_prefix_evict", freed_bytes=v.nbytes)
         v.snapshot, v.nbytes = None, 0
         self._prune(v)

@@ -31,6 +31,8 @@ import dataclasses
 import time
 from collections import deque
 
+from idc_models_tpu.observe import trace
+
 
 @dataclasses.dataclass(eq=False)     # identity eq: prompts are arrays
 class Entry:
@@ -222,7 +224,17 @@ class Scheduler:
         bookkeeping) runs WHILE the previously begun window executes on
         device; the tick ends by dispatching the next window. Slot
         availability seen by admissions is one window stale — a row
-        freed by the in-flight window refills next tick."""
+        freed by the in-flight window refills next tick.
+
+        Traced (observe/trace.py, no-op unless a tracer is active):
+        one `serve.tick` span per cycle with `serve.admit`,
+        `serve.collect` and `serve.window` nested under it, and the
+        engine's `serve.prefill`/`serve.prefill_chunk` spans nested
+        under the admit."""
+        with trace.span("serve.tick"):
+            return self._tick()
+
+    def _tick(self) -> list[Entry]:
         now = self.clock()
         done: list[Entry] = []
         # 1. queued requests past deadline never occupy a slot
@@ -243,13 +255,16 @@ class Scheduler:
         #    would leave _prefilling populated (with caches already
         #    donated to the dead dispatch) and wedge every later tick
         t_pf = self.clock()
-        try:
-            admitted = self._admit_free_slots()
-            chunk_steps = self._step_prefills() if self._chunked else 0
-        except Exception as e:
-            self._failed.extend(done)
-            self._abort_running(e)
-            raise
+        with trace.span("serve.admit") as _sp:
+            try:
+                admitted = self._admit_free_slots()
+                chunk_steps = (self._step_prefills() if self._chunked
+                               else 0)
+            except Exception as e:
+                self._failed.extend(done)
+                self._abort_running(e)
+                raise
+            _sp.set(admitted=admitted, chunk_steps=chunk_steps)
         prefill_stall_s = self.clock() - t_pf
         # 3. collect the in-flight window; recycle on EOS / budget.
         #    Only the recycle decisions happen here — per-token
@@ -259,15 +274,18 @@ class Scheduler:
         #    loss) must not leak the in-flight slots: every running
         #    entry is failed + released, THEN the error propagates —
         #    the queue stays serviceable for a caller that recovers
-        try:
-            out = self.engine.collect()
-        except Exception as e:
-            # step-1 expiries were already finalized into `done`, which
-            # this raise would otherwise discard — surface them through
-            # pop_failed alongside the aborted entries
-            self._failed.extend(done)
-            self._abort_running(e)
-            raise
+        with trace.span("serve.collect") as _sp:
+            try:
+                out = self.engine.collect()
+            except Exception as e:
+                # step-1 expiries were already finalized into `done`,
+                # which this raise would otherwise discard — surface
+                # them through pop_failed alongside the aborted entries
+                self._failed.extend(done)
+                self._abort_running(e)
+                raise
+            _sp.set(slots=len(out),
+                    tokens=sum(len(t) for t in out.values()))
         t_now = self.clock()
         got: list[tuple[Entry, list]] = []
         finished: list[Entry] = []
@@ -306,7 +324,10 @@ class Scheduler:
         if self.admit_after_collect:
             t_pf2 = self.clock()
             try:
-                admitted += self._admit_free_slots()
+                with trace.span("serve.admit", refill=True) as _sp:
+                    n2 = self._admit_free_slots()
+                    _sp.set(admitted=n2)
+                admitted += n2
             except Exception as e:
                 # same salvage as a begin_window failure: the entries
                 # the just-collected window completed are real results
@@ -322,7 +343,12 @@ class Scheduler:
         occupancy = len(self._running) / self.engine.n_slots
         if self._running:
             try:
-                self.engine.begin_window(self.window)
+                # the span covers the (async) window DISPATCH — device
+                # execution overlaps the deferred bookkeeping below and
+                # is paid for inside the NEXT tick's serve.collect
+                with trace.span("serve.window", window=self.window,
+                                slots=len(self._running)):
+                    self.engine.begin_window(self.window)
             except Exception as e:
                 # entries the just-collected window COMPLETED (EOS/
                 # budget/deadline) are real results, not casualties:
@@ -346,6 +372,13 @@ class Scheduler:
             self.metrics.on_cycle(queue_depth=len(self.queue),
                                   occupancy=occupancy, tokens=emitted,
                                   prefill_s=prefill_stall_s)
+            # compiles observed via jit cache-size deltas: after warmup
+            # this total must never move (the no-recompile contract);
+            # when it does, the registry counter says exactly when
+            on_jit = getattr(self.metrics, "on_jit_cache", None)
+            sizes = getattr(self.engine, "cache_sizes", None)
+            if on_jit is not None and sizes is not None:
+                on_jit(sum(sizes().values()))
         return done
 
     def drain(self) -> list[Entry]:

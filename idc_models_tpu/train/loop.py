@@ -36,6 +36,8 @@ from idc_models_tpu.data.pipeline import (
 )
 from idc_models_tpu.models import core, registry
 from idc_models_tpu.observe import Timer, plot_history
+from idc_models_tpu.observe import metrics_registry as mreg
+from idc_models_tpu.observe import trace
 from idc_models_tpu.train import metrics as metrics_lib
 from idc_models_tpu.train.state import TrainState, create_train_state, rmsprop
 from idc_models_tpu.train.step import (
@@ -234,20 +236,36 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
             start_epoch = max(start_epoch, initial_epoch)
             if verbose and start_epoch > initial_epoch:
                 print(f"resuming fit from epoch {start_epoch + 1}")
+    # process-wide instruments (idempotent; observe/metrics_registry.py)
+    # — the history dict / jsonl epoch records above stay the schema
+    # contract, the registry adds the operational rollup
+    m_steps = mreg.REGISTRY.counter("train_steps_total",
+                                    "optimizer steps taken")
+    m_epochs = mreg.REGISTRY.counter("train_epochs_total",
+                                     "epochs completed")
+    m_loss = mreg.REGISTRY.gauge("train_loss",
+                                 "last completed epoch's train loss")
     for epoch in range(start_epoch, epochs):
         # epoch folded into the seed (not a running split) so a resumed
         # run reproduces the straight-through rng stream
         key = jax.random.fold_in(jax.random.key(seed), epoch)
         losses, accs = [], []
-        for x, y in prefetch_to_mesh(loader.epoch(epoch), mesh):
-            key, sub = jax.random.split(key)
-            state, m = step_fn(state, x, y, sub)
-            losses.append(m["loss"])
-            accs.append(m["accuracy"])
-        ep = {
-            "loss": float(jnp.mean(jnp.stack(losses))),
-            "accuracy": float(jnp.mean(jnp.stack(accs))),
-        }
+        with trace.span("train.epoch", epoch=epoch) as ep_span:
+            for x, y in prefetch_to_mesh(loader.epoch(epoch), mesh):
+                key, sub = jax.random.split(key)
+                # the span covers host wait + async step DISPATCH; the
+                # device time it hides is fenced by the epoch-mean
+                # fetch below, inside train.epoch
+                with trace.span("train.step"):
+                    state, m = step_fn(state, x, y, sub)
+                losses.append(m["loss"])
+                accs.append(m["accuracy"])
+            m_steps.inc(len(losses))
+            ep = {
+                "loss": float(jnp.mean(jnp.stack(losses))),
+                "accuracy": float(jnp.mean(jnp.stack(accs))),
+            }
+            ep_span.set(steps=len(losses), loss=ep["loss"])
         if not np.isfinite(ep["loss"]):
             # fail FAST and loudly: a NaN here would silently poison
             # every remaining epoch AND the saved checkpoint (the
@@ -264,11 +282,14 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
                 f"only persist garbage — lower the lr, check the input "
                 f"data for NaN/Inf, or enable loss scaling")
         if evaluator is not None:
-            vm = evaluator(state, val_ds)
+            with trace.span("train.eval", epoch=epoch):
+                vm = evaluator(state, val_ds)
             ep["val_loss"] = vm["loss"]
             ep["val_accuracy"] = vm["accuracy"]
         for k, v in ep.items():
             history[k].append(v)
+        m_epochs.inc()
+        m_loss.set(ep["loss"])
         if verbose:
             msg = " ".join(f"{k}={v:.4f}" for k, v in ep.items())
             print(f"epoch {epoch + 1}/{epochs} {msg}")

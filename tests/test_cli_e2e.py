@@ -309,6 +309,62 @@ def test_cli_serve_chunked_prefix_int8(tmp_path, capsys):
                   "--prefix-cache-mb", "4"])
 
 
+def test_cli_serve_trace_out_and_stats(tmp_path, capsys):
+    """ISSUE-5 observability from the product surface: a tiny chunked
+    serve run with --trace-out produces a Perfetto-loadable Chrome
+    trace-event JSON whose admission -> prefill-chunk and tick ->
+    decode-window spans nest correctly, and the offline `stats`
+    subcommand rolls the run's jsonl up into the percentile/counter
+    summary — no re-run needed."""
+    import json
+
+    trace_path = tmp_path / "trace.json"
+    out = _run(["serve", "--host-devices", "8", "--requests", "5",
+                "--slots", "2", "--window", "4", "--t-max", "32",
+                "--vocab", "11", "--embed-dim", "16", "--num-heads", "2",
+                "--mlp-dim", "32", "--num-blocks", "1",
+                "--prefill-chunk", "8", "--path", str(tmp_path),
+                "--trace-out", str(trace_path)], capsys)
+    assert "served: ok=5" in out
+    doc = json.loads(trace_path.read_text())
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert {"serve.tick", "serve.admit", "serve.collect",
+            "serve.window", "serve.prefill_chunk",
+            "Serving trace"} <= names
+    by_id = {e["args"]["span_id"]: e for e in spans}
+    # Perfetto's expectations: numeric microsecond ts/dur, and children
+    # contained in their parent's interval
+    for e in spans:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        parent = e["args"]["parent_id"]
+        if parent is not None:
+            p = by_id[parent]
+            assert p["ts"] <= e["ts"] + 1e-3
+            assert e["ts"] + e["dur"] <= p["ts"] + p["dur"] + 1e-3
+    chunk_parents = {by_id[e["args"]["parent_id"]]["name"]
+                     for e in spans
+                     if e["name"] == "serve.prefill_chunk"}
+    assert chunk_parents == {"serve.admit"}
+    window_parents = {by_id[e["args"]["parent_id"]]["name"]
+                      for e in spans if e["name"] == "serve.window"}
+    assert window_parents == {"serve.tick"}
+
+    # offline stats over the run's serve.jsonl
+    out = _run(["stats", str(tmp_path / "logs" / "serve.jsonl")], capsys)
+    assert "serve_submit" in out and "serve_finish" in out
+    assert "p95=" in out and "mean=" in out
+    assert "last metrics snapshot:" in out
+    assert "serve_requests_total" in out
+    out = _run(["stats", str(tmp_path / "logs" / "serve.jsonl"),
+                "--json"], capsys)
+    summary = json.loads(out)
+    assert summary["events"]["serve_finish"]["count"] == 5
+    # usage error, not a traceback, for a missing file
+    with pytest.raises(SystemExit):
+        cli.main(["stats", str(tmp_path / "nope.jsonl")])
+
+
 def test_cli_lm(tmp_path, capsys):
     """The causal-LM workload from the product surface: the CLI wiring
     only (mesh line, metric line, generate line, jsonl artifact, ring

@@ -1,0 +1,365 @@
+"""ISSUE 5 observability layer: span tracer export formats, registry
+export formats, Timer routing, the offline stats rollup — and the
+back-compat gate that every PRE-EXISTING jsonl key/event still emits
+unchanged now that the loops also feed the tracer/registry.
+"""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from idc_models_tpu.observe import (
+    JsonlLogger, MetricsRegistry, Timer, Tracer, summarize_jsonl, trace,
+)
+
+
+@pytest.fixture()
+def tracer():
+    tr = Tracer()
+    prev = trace.set_tracer(tr)
+    yield tr
+    trace.set_tracer(prev)
+
+
+def _nested_work(tracer):
+    with trace.span("outer", kind="test"):
+        with trace.span("inner.a", i=0):
+            pass
+        with trace.span("inner.a", i=1):
+            with trace.span("leaf"):
+                pass
+    with trace.span("sibling"):
+        pass
+
+
+# -- tracer ----------------------------------------------------------------
+
+
+def test_span_ids_and_nesting_roundtrip_jsonl(tracer, tmp_path):
+    _nested_work(tracer)
+    path = tracer.export_jsonl(tmp_path / "spans.jsonl")
+    recs = [json.loads(l) for l in open(path)]
+    assert len(recs) == 5
+    ids = [r["id"] for r in recs]
+    assert len(set(ids)) == 5                       # process-unique ids
+    by_name = {}
+    for r in recs:
+        by_name.setdefault(r["name"], []).append(r)
+    outer = by_name["outer"][0]
+    assert outer["parent"] is None
+    assert outer["attrs"] == {"kind": "test"}
+    assert by_name["sibling"][0]["parent"] is None
+    for r in by_name["inner.a"]:
+        assert r["parent"] == outer["id"]           # nesting via parent
+    leaf = by_name["leaf"][0]
+    inner1 = [r for r in by_name["inner.a"] if r["attrs"]["i"] == 1][0]
+    assert leaf["parent"] == inner1["id"]
+    # children fit inside their parent's interval; both clocks present
+    for r in recs:
+        assert r["dur_ms"] >= 0 and r["t_ms"] >= 0 and r["wall"] > 0
+        if r["parent"] is not None:
+            p = [x for x in recs if x["id"] == r["parent"]][0]
+            assert p["t_ms"] <= r["t_ms"] + 1e-6
+            assert (r["t_ms"] + r["dur_ms"]
+                    <= p["t_ms"] + p["dur_ms"] + 1e-6)
+
+
+def test_chrome_trace_export_is_perfetto_valid(tracer, tmp_path):
+    """The exported file meets the trace-event format's expectations:
+    `ph:"X"` complete events with numeric microsecond ts/dur, pid/tid
+    ints, and the same containment the jsonl carries."""
+    _nested_work(tracer)
+    path = tracer.export_chrome(tmp_path / "trace.json")
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 5
+    assert any(e["ph"] == "M" for e in evs)         # process metadata
+    by_id = {}
+    for e in xs:
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["ts"] >= 0 and e["dur"] >= 0       # microseconds
+        by_id[e["args"]["span_id"]] = e
+    for e in xs:
+        parent = e["args"]["parent_id"]
+        if parent is not None:
+            p = by_id[parent]
+            assert p["ts"] <= e["ts"] + 1e-3
+            assert e["ts"] + e["dur"] <= p["ts"] + p["dur"] + 1e-3
+
+
+def test_disabled_tracer_is_noop():
+    assert trace.get_tracer() is None
+    h1 = trace.span("x", a=1)
+    h2 = trace.span("y")
+    assert h1 is h2                      # the shared no-op handle
+    with h1 as s:
+        s.set(b=2)                       # every op accepted, no state
+
+
+def test_spans_are_per_thread(tracer):
+    """Concurrent threads each get their own open-span stack: a span
+    opened on thread B must not parent under thread A's open span."""
+    ready = threading.Barrier(2)
+
+    def work(tag):
+        ready.wait()
+        with trace.span(f"t.{tag}"):
+            with trace.span(f"t.{tag}.child"):
+                pass
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    recs = tracer.records()
+    by_name = {r["name"]: r for r in recs}
+    for i in range(2):
+        child = by_name[f"t.{i}.child"]
+        assert child["parent"] == by_name[f"t.{i}"]["id"]
+        assert child["tid"] == by_name[f"t.{i}"]["tid"]
+
+
+def test_timer_routes_through_tracer(tracer, capsys):
+    """Satellite: a legacy Timer shows up in the exported trace while
+    its print line stays byte-identical to the reference format."""
+    with Timer("Pre-training for 10 epochs") as t:
+        pass
+    out = capsys.readouterr().out
+    assert out == f"Pre-training for 10 epochs took {t.seconds} seconds\n"
+    spans = tracer.records()
+    assert [s["name"] for s in spans] == ["Pre-training for 10 epochs"]
+    assert spans[0]["attrs"] == {"timer": True}
+
+
+def test_tracing_context_installs_and_exports(tmp_path):
+    chrome = tmp_path / "t.json"
+    with trace.tracing(chrome_path=chrome) as tr:
+        assert trace.get_tracer() is tr
+        with trace.span("inside"):
+            pass
+    assert trace.get_tracer() is None
+    assert json.load(open(chrome))["traceEvents"]
+    # no paths -> true no-op, nothing installed
+    with trace.tracing() as tr2:
+        assert tr2 is None and trace.get_tracer() is None
+
+
+# -- registry --------------------------------------------------------------
+
+
+def test_registry_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "requests", labels=("status",))
+    c.inc(status="ok")
+    c.inc(2, status="ok")
+    c.inc(status="err")
+    assert c.value(status="ok") == 3 and c.value(status="err") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, status="ok")           # counters only go up
+    with pytest.raises(ValueError):
+        c.inc(status="ok", extra="x")    # undeclared label
+    g = reg.gauge("depth", "queue depth")
+    g.set(4)
+    g.dec()
+    assert g.value() == 3
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    # idempotent re-registration returns the SAME instrument
+    assert reg.counter("reqs_total", labels=("status",)) is c
+    # type / label conflicts are loud
+    with pytest.raises(ValueError):
+        reg.gauge("reqs_total")
+    with pytest.raises(ValueError):
+        reg.counter("reqs_total", labels=("other",))
+    # bucket conflicts are as loud as type/label conflicts — a silent
+    # first-wins would file the second caller's observations into +Inf
+    with pytest.raises(ValueError):
+        reg.histogram("lat_seconds", buckets=(10.0, 20.0))
+    assert reg.histogram("lat_seconds", buckets=(0.1, 1.0)) is h
+    snap = {(r["name"], tuple(sorted(r["labels"].items()))): r
+            for r in reg.snapshot()}
+    assert snap[("reqs_total", (("status", "ok"),))]["value"] == 3
+    hrec = snap[("lat_seconds", ())]
+    assert hrec["count"] == 3 and hrec["min"] == 0.05 and hrec["max"] == 5.0
+    assert hrec["buckets"] == {"0.1": 1, "1.0": 2, "+Inf": 3}
+
+
+def test_prometheus_text_exposition():
+    reg = MetricsRegistry()
+    reg.counter("jobs_total", "jobs run", labels=("kind",)).inc(
+        3, kind="a b")
+    reg.gauge("temp", "gauge").set(1.5)
+    h = reg.histogram("dur_seconds", "d", buckets=(0.5, 2.0))
+    h.observe(0.1)
+    h.observe(3.0)
+    text = reg.prometheus_text()
+    lines = text.splitlines()
+    assert "# TYPE jobs_total counter" in lines
+    assert "# HELP jobs_total jobs run" in lines
+    assert 'jobs_total{kind="a b"} 3' in lines
+    assert "# TYPE temp gauge" in lines and "temp 1.5" in lines
+    assert "# TYPE dur_seconds histogram" in lines
+    assert 'dur_seconds_bucket{le="0.5"} 1' in lines
+    assert 'dur_seconds_bucket{le="2"} 1' in lines     # cumulative
+    assert 'dur_seconds_bucket{le="+Inf"} 2' in lines  # == _count
+    assert "dur_seconds_count 2" in lines
+    assert any(l.startswith("dur_seconds_sum ") for l in lines)
+    # every sample line parses as <name>[{labels}] <number>
+    sample = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+                        r"-?[0-9.e+-]+$")
+    for l in lines:
+        if not l.startswith("#"):
+            assert sample.match(l), l
+    # non-finite values render as Prometheus's legal spellings instead
+    # of crashing the whole exposition on int() overflow
+    reg.gauge("hot").set(float("inf"))
+    reg.gauge("cold").set(float("-inf"))
+    reg.gauge("broken").set(float("nan"))
+    text2 = reg.prometheus_text()
+    assert "hot +Inf" in text2 and "cold -Inf" in text2
+    assert "broken NaN" in text2
+
+
+def test_registry_jsonl_snapshot_and_stats(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("widgets_total").inc(7)
+    log = tmp_path / "run.jsonl"
+    with JsonlLogger(log) as logger:
+        logger.log(event="epoch", epoch=0, loss=1.0, accuracy=0.5)
+        reg.log_snapshot(logger)
+    recs = [json.loads(l) for l in open(log)]
+    snaps = [r for r in recs if r["event"] == "metrics_snapshot"]
+    assert len(snaps) == 1
+    assert snaps[0]["metrics"][0] == {
+        "name": "widgets_total", "type": "counter", "labels": {},
+        "value": 7}
+    # the offline stats rollup reads the same file
+    s = summarize_jsonl(log)
+    assert s["records"] == 2
+    assert s["events"]["epoch"]["fields"]["loss"]["mean"] == 1.0
+    assert s["metrics"][0]["name"] == "widgets_total"
+    assert reg.write_snapshot(tmp_path / "snap.jsonl")
+
+
+# -- jsonl back-compat gates ----------------------------------------------
+#
+# The acceptance bar: every jsonl key/event the pre-ISSUE-5 loops wrote
+# still emits with the same names now that the tracer/registry ride
+# along. These freeze the schemas at the metrics-hook level (cheap, no
+# engine compile); the CLI e2e tests cover the full wiring.
+
+
+def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
+    from idc_models_tpu.serve.metrics import ServingMetrics
+
+    log = tmp_path / "serve.jsonl"
+    with JsonlLogger(log) as logger:
+        m = ServingMetrics(logger, registry=MetricsRegistry())
+        m.on_submit("r0", 10.0)
+        m.on_reject("r1", 10.1)
+        m.on_admit("r0", 0.02)
+        m.on_first_token("r0", 0.05)
+        m.on_cycle(queue_depth=1, occupancy=0.5, tokens=3,
+                   prefill_s=0.01)
+        m.on_finish("r0", n_tokens=3, ttft_s=0.05, decode_s=0.1,
+                    reason="budget", t=10.3)
+    recs = [json.loads(l) for l in open(log)]
+    by_event = {r["event"]: r for r in recs}
+    # the historical event set + per-event keys, byte-for-byte names
+    assert set(by_event) == {"serve_submit", "serve_reject",
+                             "serve_admit", "serve_first_token",
+                             "serve_finish"}
+    assert set(by_event["serve_submit"]) == {"ts", "event", "id"}
+    assert set(by_event["serve_admit"]) == {"ts", "event", "id",
+                                            "queue_wait_ms"}
+    assert set(by_event["serve_first_token"]) == {
+        "ts", "event", "id", "ttft_ms", "prefill_ms"}
+    assert set(by_event["serve_finish"]) == {"ts", "event", "id",
+                                             "tokens", "reason",
+                                             "ttft_ms"}
+    # the historical summary keys all still present
+    s = m.summary()
+    for k in ("serve_requests", "serve_rejected", "serve_timed_out",
+              "serve_tokens", "serve_tokens_per_sec",
+              "serve_ttft_ms_p50", "serve_ttft_ms_p95",
+              "serve_queue_wait_ms_p50", "serve_queue_wait_ms_p95",
+              "serve_prefill_ms_p50", "serve_prefill_ms_p95",
+              "serve_token_ms_p50", "serve_slot_occupancy",
+              "serve_queue_depth_mean", "serve_queue_depth_max",
+              "serve_window_tokens_mean",
+              "serve_prefill_stall_ms_mean",
+              "serve_prefill_stall_ms_max"):
+        assert k in s, k
+
+
+def test_fed_driver_round_health_schema_unchanged(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu.federated.driver import DriverConfig, run_rounds
+    from idc_models_tpu.federated.fedavg import ServerState
+
+    def round_fn(server, images, labels, weights, rng):
+        new = ServerState(round=server.round + 1, params=server.params,
+                          model_state=server.model_state)
+        return new, {"loss": jnp.float32(0.5),
+                     "accuracy": jnp.float32(0.9),
+                     "clients_dropped": jnp.int32(0)}
+
+    server = ServerState(round=jnp.zeros((), jnp.int32),
+                         params={"w": jnp.ones((2,))}, model_state={})
+    log = tmp_path / "run.jsonl"
+    with JsonlLogger(log) as logger:
+        res = run_rounds(round_fn, server, None, None,
+                         np.ones(3, np.float32),
+                         config=DriverConfig(rounds=2), logger=logger)
+    assert len(res.history) == 2
+    recs = [json.loads(l) for l in open(log)]
+    health = [r for r in recs if r["event"] == "round_health"]
+    rounds = [r for r in recs if r["event"] == "round"]
+    assert len(health) == 2 and len(rounds) == 2
+    assert {"ts", "event", "round", "attempt", "status", "seconds",
+            "participants", "loss", "accuracy",
+            "clients_dropped"} <= set(health[0])
+    assert health[0]["status"] == "ok"
+    assert {"round", "attempts", "loss", "accuracy"} <= set(rounds[0])
+
+
+def test_fit_epoch_jsonl_schema_unchanged(tmp_path, devices):
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.models import small_cnn
+    from idc_models_tpu.train import TrainState, fit, rmsprop
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    rng = np.random.default_rng(0)
+    ds = ArrayDataset(rng.random((16, 10, 10, 3)).astype(np.float32),
+                      (rng.random(16) > 0.5).astype(np.int32))
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    import jax
+
+    variables = model.init(jax.random.key(0))
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    log = tmp_path / "run.jsonl"
+    with JsonlLogger(log) as logger:
+        fit(model, opt, binary_cross_entropy, state, ds, ds,
+            meshlib.data_mesh(), epochs=1, batch_size=8, logger=logger,
+            verbose=False)
+    recs = [json.loads(l) for l in open(log)]
+    eps = [r for r in recs if r["event"] == "epoch"]
+    assert len(eps) == 1
+    assert set(eps[0]) == {"ts", "event", "epoch", "loss", "accuracy",
+                           "val_loss", "val_accuracy"}
