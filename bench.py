@@ -1123,7 +1123,123 @@ def bench_tracer_overhead(on_accelerator: bool):
     }
 
 
+# ---------------------------------------------------------------------------
+# bench_compare: regression triage over the recorded BENCH_rNN.json trail
+# ---------------------------------------------------------------------------
+
+# headline keys and their good direction — every key here is documented
+# in docs/BENCHMARKS.md; keys absent from either run are skipped (the
+# bench set grows over time)
+HIGHER_IS_BETTER = (
+    "value", "median_value", "mfu",
+    "cached_fine_tune_patches_per_sec_per_chip",
+    "mobile_patches_per_sec_per_chip", "mobile_mfu",
+    "dense_patches_per_sec_per_chip", "dense_mfu",
+    "decode_tokens_per_sec", "serve_tokens_per_sec",
+    "serve_speedup_vs_serial", "serve_slot_occupancy",
+    "serve_prefix_hit_rate", "serve_int8_kv_slot_capacity_ratio",
+    "ring_fwd_speedup_vs_jnp", "ring_fwd_speedup_median",
+    "zigzag_schedule_speedup", "fed_byz_robust_advantage",
+)
+LOWER_IS_BETTER = (
+    "fed_round_s", "fed_round_32_s", "secure_round_s",
+    "prefill_ms", "decode_ms_per_token",
+    "serve_ttft_ms_p50", "serve_ttft_ms_p95",
+    "serve_ttft_ms_p95_shared_prefix",
+    "serve_chunked_prefill_decode_stall_ms",
+    "serve_trace_disabled_overhead_pct",
+    "flash_fwd_bwd_ms", "model_step_ms",
+    "zigzag_zigzag_ms", "ring_fwd_pallas_ms",
+)
+
+
+def _load_bench_record(path: Path) -> dict | None:
+    """The bench JSON line out of a BENCH_rNN.json driver record (its
+    `tail` holds the run's stdout) or a raw one-line bench output."""
+    try:
+        doc = json.loads(Path(path).read_text())
+    except ValueError:
+        return None
+    if isinstance(doc, dict) and "metric" in doc:
+        return doc
+    tail = doc.get("tail", "") if isinstance(doc, dict) else ""
+    for line in reversed(tail.splitlines()):
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec
+    return None
+
+
+def bench_compare(bench_dir=".", *, tolerance: float = 0.10) -> dict:
+    """Diff the NEWEST BENCH_rNN.json against the previous one and flag
+    headline-key regressions beyond `tolerance` (default 10%).
+
+    Returns {"old": path, "new": path, "keys": {key: {old, new, ratio,
+    regressed}}, "regressions": [key, ...]} — `ratio` is new/old, and
+    `regressed` respects each key's direction (a 15% TTFT p95 INCREASE
+    regresses; a 15% throughput increase does not). Keys missing from
+    either record (the bench set grows over time) are skipped. Prints a
+    human table; the caller decides what a regression is worth (the
+    recorded windows drift ±10% on the shared chip — see BASELINE.md —
+    so treat a single flagged key as a re-measure prompt, not a
+    verdict)."""
+    # order by the integer run index — lexicographic order misplaces
+    # r100 between r10 and r11 once the trail passes two digits
+    files = sorted(
+        (p for p in Path(bench_dir).glob("BENCH_r[0-9]*.json")
+         if p.stem[len("BENCH_r"):].isdigit()),
+        key=lambda p: int(p.stem[len("BENCH_r"):]))
+    pairs = [(f, _load_bench_record(f)) for f in files]
+    pairs = [(f, rec) for f, rec in pairs if rec is not None]
+    if len(pairs) < 2:
+        raise ValueError(
+            f"need at least two parseable BENCH_rNN.json files under "
+            f"{bench_dir!r}, found {len(pairs)}")
+    (old_path, old), (new_path, new) = pairs[-2], pairs[-1]
+    out: dict = {"old": str(old_path), "new": str(new_path), "keys": {},
+                 "regressions": []}
+    rows = []
+    for key in HIGHER_IS_BETTER + LOWER_IS_BETTER:
+        a, b = old.get(key), new.get(key)
+        if (not isinstance(a, (int, float)) or isinstance(a, bool)
+                or not isinstance(b, (int, float)) or a == 0):
+            continue
+        ratio = b / a
+        higher_better = key in HIGHER_IS_BETTER
+        regressed = (ratio < 1.0 - tolerance if higher_better
+                     else ratio > 1.0 + tolerance)
+        out["keys"][key] = {"old": a, "new": b,
+                            "ratio": round(ratio, 4),
+                            "regressed": regressed}
+        if regressed:
+            out["regressions"].append(key)
+        rows.append((key, a, b, ratio, regressed, higher_better))
+    print(f"bench compare: {old_path.name} -> {new_path.name} "
+          f"(flagging >{tolerance:.0%} moves against each key's "
+          f"direction)")
+    for key, a, b, ratio, regressed, hb in rows:
+        mark = " REGRESSED" if regressed else ""
+        print(f"  {key:44s} {a:>12.4g} -> {b:>12.4g}  "
+              f"x{ratio:.3f} ({'^' if hb else 'v'} better){mark}")
+    if out["regressions"]:
+        print(f"{len(out['regressions'])} regression(s): "
+              f"{', '.join(out['regressions'])}")
+    else:
+        print("no headline regressions")
+    return out
+
+
 def main() -> None:
+    if "--compare" in sys.argv:
+        i = sys.argv.index("--compare")
+        bench_dir = (sys.argv[i + 1] if len(sys.argv) > i + 1
+                     else str(Path(__file__).parent))
+        result = bench_compare(bench_dir)
+        sys.exit(1 if result["regressions"] else 0)
     import jax
 
     dev = jax.devices()[0]

@@ -337,6 +337,25 @@ def _parse(argv):
                          "slot (per-(slot,head) scales, ~2x slots per "
                          "budget) at the cost of bounded logit drift — "
                          "leave bf16 when exact parity matters")
+    sp.add_argument("--metrics-port", type=int, default=None,
+                    help="serve GET /metrics (Prometheus text "
+                         "exposition of the live registry) and GET "
+                         "/healthz (last-tick age, queue depth, slot "
+                         "occupancy) on 127.0.0.1:PORT for the run's "
+                         "duration (0 = OS-assigned port, printed; "
+                         "observe/exporter.py)")
+    sp.add_argument("--slo-ttft-p95-ms", type=float, default=None,
+                    help="declare a TTFT SLO: p95 of submit->first-"
+                         "token <= this many ms, burn-rate-alerted "
+                         "over sliding windows (observe/slo.py; "
+                         "slo_alert events go to the run jsonl)")
+    sp.add_argument("--slo-error-rate", type=float, default=None,
+                    help="declare an error-rate SLO: at most this "
+                         "fraction of requests may fail (rejected, "
+                         "error, or deadline/timeout)")
+    sp.add_argument("--slo-window-s", type=float, default=60.0,
+                    help="the SLO engine's SHORT evaluation window in "
+                         "seconds (the long window is 5x this)")
 
     sp = sub.add_parser("stats",
                         help="offline summary of any run jsonl (train, "
@@ -348,7 +367,13 @@ def _parse(argv):
                                   "exported span jsonl")
     sp.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead "
-                         "of the human table")
+                         "of the human table (includes the per-request "
+                         "timeline table under 'requests')")
+    sp.add_argument("--request", default=None, metavar="RID",
+                    help="render ONE request's timeline (every serve_* "
+                         "event and rid-stamped span for that id, "
+                         "time-ordered) instead of the whole-run "
+                         "summary")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -487,13 +512,27 @@ def _run_stats(ns):
     tracer's exported span jsonl."""
     import json
 
-    from idc_models_tpu.observe import format_summary, summarize_jsonl
+    from idc_models_tpu.observe import (
+        format_request_timeline, format_summary, summarize_jsonl,
+    )
 
     p = Path(ns.jsonl)
     if not p.exists():
         sys.exit(f"stats: no such file: {p}")
     summary = summarize_jsonl(p)
-    if ns.json:
+    if ns.request is not None:
+        # format_request_timeline owns the unknown-rid message (KeyError)
+        # — rendering even on the --json path keeps one validation site
+        try:
+            text = format_request_timeline(summary, ns.request)
+        except KeyError as e:
+            sys.exit(f"stats: {e.args[0]}")
+        if ns.json:
+            print(json.dumps(
+                {ns.request: summary["requests"][ns.request]}))
+        else:
+            print(text)
+    elif ns.json:
         print(json.dumps(summary))
     else:
         print(format_summary(summary))
@@ -925,6 +964,17 @@ def _run_serve(ns):
     if ns.prefix_cache_mb > 0 and not ns.prefill_chunk:
         sys.exit("--prefix-cache-mb needs --prefill-chunk (snapshots "
                  "live on chunk boundaries)")
+    if ns.slo_ttft_p95_ms is not None and ns.slo_ttft_p95_ms <= 0:
+        sys.exit(f"--slo-ttft-p95-ms {ns.slo_ttft_p95_ms} must be > 0")
+    if (ns.slo_error_rate is not None
+            and not 0.0 < ns.slo_error_rate < 1.0):
+        sys.exit(f"--slo-error-rate {ns.slo_error_rate} must be a "
+                 f"fraction in (0, 1)")
+    if ns.slo_window_s <= 0:
+        sys.exit(f"--slo-window-s {ns.slo_window_s} must be > 0")
+    if ns.metrics_port is not None and not 0 <= ns.metrics_port <= 65535:
+        sys.exit(f"--metrics-port {ns.metrics_port} must be in "
+                 f"[0, 65535] (0 = OS-assigned)")
     mesh = meshlib.seq_mesh(ns.seq_parallel)
     # the model trains through the SAME ring the serving mesh uses —
     # omitting mesh here would silently train single-device full
@@ -959,6 +1009,55 @@ def _run_serve(ns):
 
     logger = (JsonlLogger(Path(ns.path) / "logs" / "serve.jsonl")
               if ns.path else None)
+    # live exposition (observe/exporter.py): armed BEFORE the server's
+    # warmup compiles so a scraper sees the process from startup, torn
+    # down with the run (the finally below)
+    exporter = None
+    if ns.metrics_port is not None:
+        from idc_models_tpu.observe import MetricsExporter
+
+        try:
+            exporter = MetricsExporter(port=ns.metrics_port).start()
+        except OSError as e:
+            sys.exit(f"serve: cannot bind --metrics-port "
+                     f"{ns.metrics_port}: {e}")
+        print(f"metrics: {exporter.url}/metrics  healthz: "
+              f"{exporter.url}/healthz")
+    try:
+        _serve_body(ns, mesh, params, logger)
+    finally:
+        if exporter is not None:
+            exporter.close()
+
+
+def _serve_body(ns, mesh, params, logger) -> None:
+    import json
+
+    import jax.numpy as jnp
+
+    from idc_models_tpu.observe import Timer, profile_trace
+    from idc_models_tpu.serve import LMServer, load_trace, poisson_trace
+
+    # declared SLOs (observe/slo.py): the serving metrics hooks feed
+    # them and evaluate burn rates once per scheduler cycle; slo_alert
+    # records stream to the same serve.jsonl
+    slo = None
+    slos = []
+    if ns.slo_ttft_p95_ms is not None:
+        from idc_models_tpu.observe import SLO
+
+        slos.append(SLO.latency("ttft",
+                                threshold_s=ns.slo_ttft_p95_ms / 1e3))
+    if ns.slo_error_rate is not None:
+        from idc_models_tpu.observe import SLO
+
+        slos.append(SLO.rate("error_rate", budget=ns.slo_error_rate))
+    if slos:
+        from idc_models_tpu.observe import SLOEngine
+
+        slo = SLOEngine(slos, short_window_s=ns.slo_window_s,
+                        long_window_s=5.0 * ns.slo_window_s,
+                        logger=logger)
     server = LMServer(
         params, embed_dim=ns.embed_dim, num_heads=ns.num_heads,
         num_blocks=ns.num_blocks, t_max=ns.t_max, n_slots=ns.slots,
@@ -968,7 +1067,7 @@ def _run_serve(ns):
         max_prefills_per_cycle=ns.max_prefills_per_cycle, logger=logger,
         prefill_chunk=ns.prefill_chunk or None,
         prefix_cache_mb=ns.prefix_cache_mb,
-        kv_dtype=("int8" if ns.kv_dtype == "int8" else None))
+        kv_dtype=("int8" if ns.kv_dtype == "int8" else None), slo=slo)
     if ns.trace:
         trace = load_trace(ns.trace)
     else:
@@ -1003,6 +1102,10 @@ def _run_serve(ns):
               f"({summary['serve_prefix_hits']} hits, "
               f"{summary['serve_prefix_evictions']} evictions, "
               f"{summary['serve_prefix_bytes']} bytes)")
+    if slo is not None:
+        names = sorted({a["slo"] for a in slo.alerts})
+        print(f"slo: {len(slo.alerts)} alert(s)"
+              + (f" ({', '.join(names)})" if names else ""))
     print("serve summary:", json.dumps(summary))
     if logger:
         logger.log(event="serve_summary", **summary)
@@ -1200,7 +1303,8 @@ def _run_fed(ns):
                 round_fn, server, imgs, labels, w_train, config=config,
                 seed=ns.seed + 1, eval_fn=eval_round,
                 on_round=print_round, logger=logger, verbose=True,
-                log_from_round=logged_through, log_round_records=False)
+                log_from_round=logged_through, log_round_records=False,
+                fault_plan=plan)
     except RoundFailure as e:
         sys.exit(f"[idc_models_tpu] federated training aborted: {e}")
     server = result.server

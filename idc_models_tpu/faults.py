@@ -54,6 +54,14 @@ SIGN_FLIP = 6
 KINDS = ("crash", "straggler", "nan", "inf", "scale", "sign_flip")
 _CODE = {"crash": CRASH, "straggler": STRAGGLER, "nan": NAN, "inf": INF,
          "scale": SCALE, "sign_flip": SIGN_FLIP}
+_KIND_OF = {v: k for k, v in _CODE.items()}
+
+
+def kind_of(code: int) -> str:
+    """The human name of a fault code ("ok" for OK) — observability
+    surfaces (the driver's per-client fed.client spans) stamp this
+    instead of the raw integer the jitted program branches on."""
+    return _KIND_OF.get(int(code), "ok")
 
 
 @dataclasses.dataclass(frozen=True)

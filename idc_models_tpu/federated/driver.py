@@ -120,7 +120,8 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
                config: DriverConfig, seed: int = 0, eval_fn=None,
                on_round=None, logger=None, clock=time.monotonic,
                verbose: bool = False, log_from_round: int = -1,
-               log_round_records: bool = True) -> DriverResult:
+               log_round_records: bool = True, fault_plan=None,
+               slo=None) -> DriverResult:
     """Run `config.rounds` federated rounds with self-healing.
 
     `round_fn` is a `make_fedavg_round` product (or anything with the
@@ -134,6 +135,20 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
     `log_round_records=False` leaves the per-round ``round`` records to
     the caller (e.g. a CLI preserving its historical field names) while
     the driver still emits ``round_health``.
+
+    `fault_plan` (faults.FaultPlan, usually the same plan the round_fn
+    injects) labels the per-client ``fed.client`` trace spans with each
+    participant's fault outcome for the round. When a tracer is armed,
+    every attempt's ``fed.round`` span gains one nested ``fed.client``
+    marker per participating client (attrs: client, weight, fault —
+    markers, not timings: clients run fused inside one jitted dispatch,
+    so no per-client host interval exists to measure).
+
+    `slo` (observe.slo.SLOEngine) receives ``round_seconds`` (latency,
+    wall seconds per attempt) and ``round_failure_rate`` (rate, bad =
+    attempt status != ok) for whichever of the two it declares, with a
+    burn-rate evaluation after every attempt — `slo_alert` jsonl events
+    go through the engine's own logger.
     Returns the last good server state + per-round history + per-attempt
     health events; raises `RoundFailure` when a round exhausts its
     attempts (the last good state is the exception's `.server`).
@@ -231,11 +246,11 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
                         and not timeout_exempt
                         and elapsed > config.timeout_s):
                     status = "timeout"
+                w_host = np.asarray(jax.device_get(w))
                 record = {"round": r, "attempt": attempt,
                           "status": status,
                           "seconds": round(elapsed, 4),
-                          "participants": int(
-                              (np.asarray(jax.device_get(w)) > 0).sum()),
+                          "participants": int((w_host > 0).sum()),
                           **{k: v for k, v in tm_host.items()
                              if k in ("loss", "accuracy",
                                       "clients_dropped",
@@ -244,9 +259,18 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
                                       "trim_degenerate", "error")}}
                 att_span.set(status=status,
                              participants=record["participants"])
+                if trace.get_tracer() is not None:
+                    _client_spans(att_span, w_host, r, attempt,
+                                  fault_plan)
             m_attempts.inc(status=status)
             m_seconds.observe(elapsed)
             health(record)
+            if slo is not None:
+                if slo.has("round_seconds"):
+                    slo.observe("round_seconds", elapsed)
+                if slo.has("round_failure_rate"):
+                    slo.record("round_failure_rate", ok=status == "ok")
+                slo.evaluate()
             if status == "ok":
                 good = candidate
                 ref_loss = tm_host["loss"]
@@ -284,6 +308,33 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
             and int(good.round) % max(config.checkpoint_every, 1) != 0):
         _save(config.checkpoint_path, good)
     return DriverResult(server=good, history=history, events=events)
+
+
+def _client_spans(att_span, weights, round_idx: int, attempt: int,
+                  fault_plan) -> None:
+    """One `fed.client` marker span per participating client, nested
+    under the attempt's fed.round span, carrying the client's fault
+    outcome for the round (from the plan's pure (plan, round) function
+    — the same codes the jitted round program branched on). Markers,
+    not timings: the clients execute fused inside one dispatch.
+    `weights` is the attempt's already host-fetched array."""
+    from idc_models_tpu import faults as faults_lib
+
+    w = np.asarray(weights)
+    codes = scales = None
+    if fault_plan is not None:
+        codes, scales = fault_plan.codes(round_idx)
+    for cid in np.flatnonzero(w > 0):
+        attrs = {"round": round_idx, "attempt": attempt,
+                 "client": int(cid), "weight": float(w[cid])}
+        if codes is not None and cid < len(codes):
+            code = int(codes[cid])
+            attrs["fault"] = faults_lib.kind_of(code)
+            if code in (faults_lib.SCALE, faults_lib.SIGN_FLIP):
+                attrs["fault_scale"] = float(scales[cid])
+            elif code == faults_lib.STRAGGLER:
+                attrs["staleness"] = fault_plan.staleness(round_idx)
+        trace.point("fed.client", parent=att_span.span_id, **attrs)
 
 
 def _save(path, server: ServerState) -> None:

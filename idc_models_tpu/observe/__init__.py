@@ -1,12 +1,14 @@
 from idc_models_tpu.observe import trace  # noqa: F401
+from idc_models_tpu.observe.exporter import MetricsExporter  # noqa: F401
 from idc_models_tpu.observe.logging import JsonlLogger  # noqa: F401
 from idc_models_tpu.observe.metrics_registry import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
     default_registry,
 )
 from idc_models_tpu.observe.plots import plot_history  # noqa: F401
+from idc_models_tpu.observe.slo import SLO, SLOEngine  # noqa: F401
 from idc_models_tpu.observe.stats import (  # noqa: F401
-    format_summary, summarize_jsonl,
+    format_request_timeline, format_summary, summarize_jsonl,
 )
 from idc_models_tpu.observe.timer import Timer, profile_trace  # noqa: F401
 from idc_models_tpu.observe.trace import (  # noqa: F401

@@ -123,10 +123,15 @@ class Gauge(_Instrument):
     def dec(self, amount: float = 1.0, **labels) -> None:
         self.inc(-amount, **labels)
 
-    def value(self, **labels) -> float:
+    def value(self, default: float | None = 0.0, **labels):
+        """Current value for the label set; `default` when the gauge
+        was never set — pass default=None to distinguish unset from 0
+        (e.g. a health surface reporting null before the first tick)."""
         key = _label_key(self.label_names, labels)
         with self._lock:
-            return float(self._values.get(key, 0.0))
+            if key not in self._values:
+                return default
+            return float(self._values[key])
 
 
 class Histogram(_Instrument):
@@ -211,6 +216,13 @@ class MetricsRegistry:
                   buckets=DEFAULT_BUCKETS) -> Histogram:
         return self._get_or_make(Histogram, name, help, labels,
                                  buckets=buckets)
+
+    def get(self, name: str) -> _Instrument | None:
+        """The registered instrument, or None — the read-only lookup
+        surfaces like the /healthz endpoint use (they must not CREATE
+        a metric whose owner simply has not registered yet)."""
+        with self._lock:
+            return self._instruments.get(name)
 
     def instruments(self) -> list[_Instrument]:
         with self._lock:

@@ -5,9 +5,11 @@ shape (`observe.JsonlLogger`): train epochs, federated rounds and
 round_health attempts, serve_* request events, timer records, span
 exports, metrics snapshots. This module reads ANY of those files and
 rolls it up offline: per-event counts, percentiles over every numeric
-field, named timer/span timing tables, and the last metrics snapshot —
-so "what did this run spend its time on" is one command against the
-artifact, no re-run needed.
+field, named timer/span timing tables, the last metrics snapshot, and
+PER-REQUEST timelines (every serve_* event and every rid-stamped span
+grouped by request id, time-ordered — the `stats --request RID` view)
+— so "what did this run spend its time on" and "what happened to
+request X" are one command against the artifact, no re-run needed.
 """
 
 from __future__ import annotations
@@ -90,7 +92,46 @@ def summarize_jsonl(path) -> dict:
                       "total_ms": round(float(np.sum(vs)), 3)}
                   for n, vs in sorted(spans.items())},
         "metrics": last_snapshot,
+        "requests": _request_timelines(records),
     }
+
+
+def _request_timelines(records: list[dict]) -> dict:
+    """rid -> time-ordered timeline entries, collected from BOTH record
+    shapes a run can produce: the serve_* jsonl events (`id` field) and
+    rid-stamped span records from a tracer's jsonl export. Each entry:
+    {"t_s": seconds since the request's first record, "what": event or
+    span name, "dur_ms": span duration (events: None), "detail": the
+    record's other fields}."""
+    reqs: dict[str, list] = {}
+    for r in records:
+        ev = r.get("event")
+        if (isinstance(ev, str) and ev.startswith("serve_")
+                and "id" in r):
+            reqs.setdefault(str(r["id"]), []).append({
+                "_wall": r.get("ts"), "what": ev, "dur_ms": None,
+                "detail": {k: v for k, v in r.items()
+                           if k not in ("ts", "event", "id")}})
+        elif ev == "span":
+            attrs = r.get("attrs") or {}
+            rid = attrs.get("rid")
+            if rid is None:
+                continue
+            reqs.setdefault(str(rid), []).append({
+                "_wall": r.get("wall"), "what": str(r.get("name")),
+                "dur_ms": r.get("dur_ms"),
+                "detail": {k: v for k, v in attrs.items()
+                           if k != "rid"}})
+    for rid, entries in reqs.items():
+        entries.sort(key=lambda e: (e["_wall"] is None,
+                                    e["_wall"] or 0.0))
+        t0 = next((e["_wall"] for e in entries
+                   if e["_wall"] is not None), None)
+        for e in entries:
+            wall = e.pop("_wall")
+            e["t_s"] = (round(wall - t0, 6)
+                        if wall is not None and t0 is not None else None)
+    return reqs
 
 
 def format_summary(s: dict) -> str:
@@ -121,6 +162,10 @@ def format_summary(s: dict) -> str:
             out.append(f"  {name:28s} x{st['count']} "
                        f"total={st['total_ms']} mean={st['mean']} "
                        f"p50={st['p50']} p95={st['p95']}")
+    if s.get("requests"):
+        out.append("")
+        out.append(f"requests: {len(s['requests'])} with per-request "
+                   f"timelines (render one with --request RID)")
     if s["metrics"]:
         out.append("")
         out.append("last metrics snapshot:")
@@ -134,4 +179,30 @@ def format_summary(s: dict) -> str:
                            f"max={rec['max']}")
             else:
                 out.append(f"  {rec['name']}{lbl} = {rec['value']}")
+    return "\n".join(out)
+
+
+def format_request_timeline(summary: dict, rid: str) -> str:
+    """Human rendering of ONE request's timeline from a
+    `summarize_jsonl` summary — submit through finish, every jsonl
+    event and rid-stamped span in time order."""
+    entries = summary.get("requests", {}).get(rid)
+    if entries is None:
+        known = sorted(summary.get("requests", {}))
+        preview = ", ".join(known[:8]) + ("..." if len(known) > 8 else "")
+        raise KeyError(f"no records for request id {rid!r} "
+                       f"({len(known)} request ids in {summary['path']}"
+                       f"{': ' + preview if known else ''})")
+    out = [f"request {rid} — {len(entries)} records "
+           f"({summary['path']}):"]
+    for e in entries:
+        t = ("t+?     " if e["t_s"] is None
+             else f"t+{e['t_s'] * 1e3:9.3f}ms")
+        dur = (f" [{e['dur_ms']:.3f} ms]"
+               if isinstance(e.get("dur_ms"), (int, float)) else "")
+        detail = " ".join(
+            f"{k}={v}" for k, v in sorted(e["detail"].items())
+            if v is not None)
+        out.append(f"  {t}  {e['what']:22s}{dur}"
+                   + (f"  {detail}" if detail else ""))
     return "\n".join(out)

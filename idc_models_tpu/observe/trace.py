@@ -51,10 +51,18 @@ class _NullSpan:
 
     __slots__ = ()
 
+    # detached-span callers hand `handle.span_id` straight back as a
+    # `parent=`; None is the "no parent" value on both sides, so the
+    # disabled path needs no branches at the call sites
+    span_id = None
+
     def __enter__(self) -> "_NullSpan":
         return self
 
     def __exit__(self, *exc) -> None:
+        return None
+
+    def close(self, **attrs) -> None:
         return None
 
     def set(self, **attrs) -> "_NullSpan":
@@ -70,7 +78,7 @@ class Span:
     time before exit."""
 
     __slots__ = ("name", "span_id", "parent_id", "tid", "attrs",
-                 "_tracer", "_t0", "_stack", "dur_s")
+                 "_tracer", "_t0", "_stack", "_detached", "dur_s")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: dict):
         self.name = name
@@ -81,6 +89,7 @@ class Span:
         self.tid = 0
         self._t0 = 0.0
         self._stack = None
+        self._detached = False
         self.dur_s = 0.0
 
     def set(self, **attrs) -> "Span":
@@ -118,6 +127,19 @@ class Span:
         with tr._lock:
             tr._spans.append(self)
 
+    def close(self, **attrs) -> None:
+        """Finalize a DETACHED span (see `Tracer.start_span`). Safe to
+        call more than once — only the first close records — and a
+        no-op on any non-detached span: one a with-block manages (it
+        already records) or one created but never entered (closing it
+        would record a garbage interval timed from t0=0)."""
+        if not self._detached or self._stack is not None:
+            return
+        if attrs:
+            self.attrs.update(attrs)
+        self.__exit__(None, None, None)
+        self._stack = ()
+
 
 class Tracer:
     """Collects finished spans; thread-safe (each thread keeps its own
@@ -142,6 +164,28 @@ class Tracer:
 
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
+
+    def start_span(self, name: str, parent=None, **attrs) -> Span:
+        """A DETACHED span: opened now, finalized by `close()`, never on
+        any thread's open-span stack. Parenting is explicit (`parent` is
+        another span's id, or None for top-level) — the handle for
+        logical intervals that outlive any one call frame, e.g. a serve
+        request's whole submit→finish lifetime spanning many scheduler
+        ticks (a stack-entered span held open that long would corrupt
+        the parenting of every tick span under it)."""
+        s = Span(self, name, attrs)
+        s.parent_id = parent
+        s.tid = threading.get_ident()
+        s._detached = True
+        s._t0 = self._clock()
+        return s
+
+    def point(self, name: str, parent=None, **attrs) -> Span:
+        """A zero-duration marker span recorded immediately — lifecycle
+        events (first token, a finish) inside a detached span chain."""
+        s = self.start_span(name, parent, **attrs)
+        s.close()
+        return s
 
     def finished(self) -> list[Span]:
         """Snapshot of the finished spans (open spans are excluded —
@@ -230,6 +274,27 @@ def span(name: str, **attrs):
     if tr is None:
         return _NULL_SPAN
     return Span(tr, name, attrs)
+
+
+def start_span(name: str, parent=None, **attrs):
+    """A DETACHED span on the active tracer (see `Tracer.start_span`) —
+    or the shared no-op handle when tracing is disabled. The entry
+    point for request-lifecycle spans that outlive any call frame; the
+    no-op handle's `span_id` is None, which is also the "no parent"
+    value, so chained call sites need no enabled/disabled branches."""
+    tr = _ACTIVE
+    if tr is None:
+        return _NULL_SPAN
+    return tr.start_span(name, parent, **attrs)
+
+
+def point(name: str, parent=None, **attrs):
+    """A zero-duration marker on the active tracer — or the shared
+    no-op handle when tracing is disabled."""
+    tr = _ACTIVE
+    if tr is None:
+        return _NULL_SPAN
+    return tr.point(name, parent, **attrs)
 
 
 @contextlib.contextmanager

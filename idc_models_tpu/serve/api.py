@@ -39,13 +39,16 @@ class Request:
     per-batch); `deadline_s` is seconds from submit after which the
     request is dropped (queued) or cancelled mid-generation (running);
     `eos_id` overrides the server default stop token (None = server's,
-    -1 = never stop early)."""
+    -1 = never stop early); `trace_id` labels the request's lifecycle
+    spans in exported traces (None = the scheduler assigns a
+    process-unique one at submit — it comes back on the Result)."""
     id: str
     prompt: tuple
     max_new_tokens: int
     eos_id: int | None = None
     seed: int | None = None
     deadline_s: float | None = None
+    trace_id: str | None = None
 
 
 @dataclasses.dataclass
@@ -64,6 +67,9 @@ class Result:
     ttft_ms: float | None = None
     latency_ms: float | None = None
     error: str | None = None
+    # the id stamped on every span of this request's lifecycle chain in
+    # an exported trace (serve.request/queued/first_token + rid attrs)
+    trace_id: str | None = None
 
 
 class LMServer:
@@ -84,7 +90,7 @@ class LMServer:
                  warmup: bool = True, clock=time.monotonic,
                  prefill_chunk: int | None = None,
                  prefix_cache_mb: float = 0.0,
-                 kv_dtype: str | None = None):
+                 kv_dtype: str | None = None, slo=None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
@@ -111,7 +117,11 @@ class LMServer:
             block_impl=block_impl, temperature=temperature, top_k=top_k,
             pad_id=pad_id, eos_id=eos_id, prefill_chunk=prefill_chunk,
             prefix_cache=prefix_cache, kv_dtype=kv_dtype)
-        self.metrics = ServingMetrics(logger, prefix_cache=prefix_cache)
+        # slo: an optional observe.slo.SLOEngine — the metrics hooks
+        # feed its declared objectives (ttft/queue_wait/error_rate) and
+        # evaluate burn rates once per scheduler cycle
+        self.metrics = ServingMetrics(logger, prefix_cache=prefix_cache,
+                                      slo=slo)
         self.scheduler = Scheduler(
             self.engine, window=window, max_queue_depth=max_queue_depth,
             max_prefills_per_cycle=max_prefills_per_cycle,
@@ -141,7 +151,8 @@ class LMServer:
             # integer seeds ride through as-is: the engine derives the
             # key data on the host (identical to jax.random.key(seed))
             rng=request.seed,
-            deadline=request.deadline_s)
+            deadline=request.deadline_s,
+            trace_id=request.trace_id)
         ok = self.scheduler.submit(entry)
         if not ok:
             # leave no Result: the caller may retry the same id later
@@ -241,6 +252,7 @@ def _to_result(e) -> Result:
     return Result(
         id=e.rid, tokens=list(e.tokens), status=e.status,
         finish_reason=e.finish_reason, error=e.error,
+        trace_id=e.trace_id,
         ttft_ms=(None if e.t_first is None
                  else (e.t_first - e.t_submit) * 1e3),
         latency_ms=(None if e.t_done is None

@@ -107,10 +107,10 @@ class _PendingPrefill:
     the next chunk starts (past any prefix-cache hit)."""
 
     __slots__ = ("prompt", "budget", "rng", "eos_id", "caches", "logits",
-                 "next_start")
+                 "next_start", "tag")
 
     def __init__(self, *, prompt, budget, rng, eos_id, caches, logits,
-                 next_start):
+                 next_start, tag=None):
         self.prompt = prompt
         self.budget = budget
         self.rng = rng
@@ -118,6 +118,7 @@ class _PendingPrefill:
         self.caches = caches
         self.logits = logits
         self.next_start = next_start
+        self.tag = tag
 
 
 class _EngineFns(NamedTuple):
@@ -508,7 +509,7 @@ class SlotEngine:
         self._occupied[slot] = True
 
     def admit(self, slot: int, prompt, max_new_tokens: int, *,
-              rng=None, eos_id: int | None = None) -> None:
+              rng=None, eos_id: int | None = None, tag=None) -> None:
         """Prefill `prompt` ([P] or [1, P]) and scatter it into `slot`,
         while every other slot's state stays put. `rng` seeds this
         REQUEST's sampling stream — an integer seed or the exact key a
@@ -521,10 +522,15 @@ class SlotEngine:
         ceil(P/C) chunk dispatches driven to completion here — which is
         the convenience path; a scheduler that wants to interleave
         chunks with decode windows drives `start_prefill`/`prefill_step`
-        itself."""
+        itself.
+
+        `tag` is an opaque request label (the scheduler passes the rid)
+        stamped onto the prefill spans, tying them into the request's
+        lifecycle chain; the span TREE parenting (under serve.admit)
+        is unchanged."""
         if self.prefill_chunk is not None:
             self.start_prefill(slot, prompt, max_new_tokens, rng=rng,
-                               eos_id=eos_id)
+                               eos_id=eos_id, tag=tag)
             while not self.prefill_step(slot):
                 pass
             return
@@ -536,7 +542,7 @@ class SlotEngine:
         # prefill bucket, hand the jitted prefill the numpy array
         bucket = prefill_bucket(p_len, self.t_max, self._n_ring)
         with trace.span("serve.prefill", slot=slot, p_len=p_len,
-                        bucket=bucket):
+                        bucket=bucket, rid=tag):
             padded = np.zeros((1, bucket), np.int32)
             padded[:, :p_len] = prompt
             logits1, caches1 = self._sfns.prefill(self._params, padded,
@@ -547,7 +553,8 @@ class SlotEngine:
     # -- chunked prefill --------------------------------------------------
 
     def start_prefill(self, slot: int, prompt, max_new_tokens: int, *,
-                      rng=None, eos_id: int | None = None) -> None:
+                      rng=None, eos_id: int | None = None,
+                      tag=None) -> None:
         """Reserve `slot` and register a chunked prefill for `prompt`
         WITHOUT dispatching anything: each later `prefill_step(slot)`
         runs exactly one chunk (the scheduler interleaves one per decode
@@ -568,7 +575,7 @@ class SlotEngine:
         self._prefills[slot] = _PendingPrefill(
             prompt=prompt, budget=int(max_new_tokens), rng=rng,
             eos_id=eos_id, caches=caches, logits=logits,
-            next_start=start)
+            next_start=start, tag=tag)
 
     def prefill_step(self, slot: int) -> bool:
         """Advance `slot`'s pending prefill by ONE chunk dispatch;
@@ -590,7 +597,7 @@ class SlotEngine:
             end = min(pend.next_start + c, p_len)
             with trace.span("serve.prefill_chunk", slot=slot,
                             start=pend.next_start, end=end,
-                            p_len=p_len):
+                            p_len=p_len, rid=pend.tag):
                 padded = np.zeros((1, c), np.int32)
                 padded[:, :end - pend.next_start] = pend.prompt[
                     :, pend.next_start:end]

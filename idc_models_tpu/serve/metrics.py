@@ -10,6 +10,8 @@ record `bench.py` embeds in the official JSON line (`serve_*` fields).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from idc_models_tpu.observe import metrics_registry as mreg
@@ -34,10 +36,18 @@ class ServingMetrics:
     (observe/metrics_registry.py: serve_* counters/gauges/histograms)
     — additive instrumentation only; the jsonl records this class has
     always written keep their exact keys (gated by test).
+
+    `slo` is an optional `observe.slo.SLOEngine`: the hooks feed it the
+    declared subset of ``ttft`` / ``queue_wait`` (latency samples,
+    seconds) and ``error_rate`` (bad = rejected, or a finish reason of
+    error/timeout/deadline), and `on_cycle` runs one burn-rate
+    evaluation per scheduler cycle.
     """
 
-    def __init__(self, logger=None, prefix_cache=None, registry=None):
+    def __init__(self, logger=None, prefix_cache=None, registry=None,
+                 slo=None):
         self.logger = logger
+        self.slo = slo
         # when a PrefixCache is attached its serve_prefix_* counters
         # roll into summary() next to the serving fields
         self.prefix_cache = prefix_cache
@@ -64,6 +74,13 @@ class ServingMetrics:
             "serve_compiles_total",
             "XLA compiles observed as jit cache-size growth after the "
             "first cycle")
+        # the /healthz freshness anchor (observe/exporter.py): stamped
+        # with time.monotonic() once per scheduler cycle so a scrape
+        # can tell a healthy-but-idle server from a wedged one
+        self._m_last_tick = reg.gauge(
+            "serve_last_tick_monotonic_seconds",
+            "time.monotonic() stamp of the last scheduler cycle — "
+            "/healthz reports now minus this as last_tick_age_s")
         self._jit_cache_seen: int | None = None
         self.compiles_observed = 0
         self.submitted = 0
@@ -96,6 +113,8 @@ class ServingMetrics:
     def on_reject(self, rid, t: float) -> None:
         self.rejected += 1
         self._m_requests.inc(status="rejected")
+        if self.slo is not None and self.slo.has("error_rate"):
+            self.slo.record("error_rate", ok=False)
         self._log(event="serve_reject", id=rid)
 
     def on_admit(self, rid, wait_s: float) -> None:
@@ -106,10 +125,14 @@ class ServingMetrics:
         an unchanged record schema for the events they already parse."""
         self.queue_wait_s.append(wait_s)
         self._wait_by_rid[rid] = wait_s
+        if self.slo is not None and self.slo.has("queue_wait"):
+            self.slo.observe("queue_wait", wait_s)
         self._log(event="serve_admit", id=rid, queue_wait_ms=wait_s * 1e3)
 
     def on_first_token(self, rid, ttft_s: float) -> None:
         self._m_ttft.observe(ttft_s)
+        if self.slo is not None and self.slo.has("ttft"):
+            self.slo.observe("ttft", ttft_s)
         self.ttft_s.append(ttft_s)
         wait = self._wait_by_rid.pop(rid, None)
         prefill = None if wait is None else max(ttft_s - wait, 0.0)
@@ -129,6 +152,9 @@ class ServingMetrics:
         if reason in ("timeout", "deadline"):
             self.timed_out += 1
         self._m_requests.inc(status=str(reason))
+        if self.slo is not None and self.slo.has("error_rate"):
+            self.slo.record("error_rate", ok=reason not in (
+                "error", "timeout", "deadline"))
         if n_tokens:
             self._m_tokens.inc(n_tokens)
         self.tokens_out += n_tokens
@@ -146,6 +172,9 @@ class ServingMetrics:
         self.cycles += 1
         self._m_queue.set(queue_depth)
         self._m_occ.set(occupancy)
+        self._m_last_tick.set(time.monotonic())
+        if self.slo is not None:
+            self.slo.evaluate()
         self.queue_depths.append(int(queue_depth))
         self.occupancies.append(float(occupancy))
         self.cycle_tokens.append(int(tokens))

@@ -28,10 +28,22 @@ about WHICH request runs where or when; this module is the policy:
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import os
 import time
 from collections import deque
 
 from idc_models_tpu.observe import trace
+
+# process-unique request trace ids (pid + monotone counter): cheap
+# enough to stamp on EVERY request whether or not a tracer is armed, so
+# a rid's identity is stable across the jsonl log, the span export, and
+# the user-facing Result
+_TRACE_IDS = itertools.count(1)
+
+
+def _next_trace_id() -> str:
+    return f"{os.getpid():x}-{next(_TRACE_IDS):x}"
 
 
 @dataclasses.dataclass(eq=False)     # identity eq: prompts are arrays
@@ -44,6 +56,14 @@ class Entry:
     budget: int
     eos_id: int | None = None
     rng: object = None               # per-request sampling key
+    trace_id: str | None = None      # assigned at submit if not given
+    # request-lifecycle span handles (observe/trace.py DETACHED spans —
+    # they outlive any one tick, so they never sit on a thread's
+    # open-span stack): the whole submit->finish interval, and the
+    # queued segment inside it. The shared no-op handle when tracing
+    # is disabled.
+    span: object = None
+    queue_span: object = None
     # RELATIVE seconds-from-submit when handed to submit(); rewritten to
     # the absolute clock time there
     deadline: float | None = None
@@ -157,6 +177,19 @@ class Scheduler:
             if self.metrics:
                 self.metrics.on_reject(entry.rid, entry.t_submit)
             return False
+        if entry.trace_id is None:
+            entry.trace_id = _next_trace_id()
+        # the request-lifecycle chain: a detached serve.request span
+        # covering submit->finish (it spans many ticks, so it must not
+        # enter any thread's open-span stack), with the queued segment
+        # as a detached child closed at admission. Every span in the
+        # chain carries rid, so one grep over the export reconstructs
+        # the request's full timeline.
+        entry.span = trace.start_span("serve.request", rid=entry.rid,
+                                      trace_id=entry.trace_id)
+        entry.queue_span = trace.start_span(
+            "serve.queued", parent=entry.span.span_id, rid=entry.rid,
+            trace_id=entry.trace_id)
         if self.metrics:
             self.metrics.on_submit(entry.rid, entry.t_submit)
         return True
@@ -184,14 +217,19 @@ class Scheduler:
             if self._chunked:
                 self._prefilling[slot] = e
                 self.engine.start_prefill(slot, e.prompt, e.budget,
-                                          rng=e.rng, eos_id=eos)
+                                          rng=e.rng, eos_id=eos,
+                                          tag=e.rid)
             else:
                 self._running[slot] = e
                 self.engine.admit(slot, e.prompt, e.budget, rng=e.rng,
-                                  eos_id=eos)
+                                  eos_id=eos, tag=e.rid)
             # recorded only AFTER the engine accepted the request — an
             # admit that raises must not leave a phantom queue-wait
             # sample (and _wait_by_rid entry) behind
+            if e.queue_span is not None:
+                e.queue_span.close(
+                    queue_wait_ms=round((e.t_admit - e.t_submit) * 1e3,
+                                        3))
             if self.metrics:
                 self.metrics.on_admit(e.rid, e.t_admit - e.t_submit)
             admitted += 1
@@ -230,7 +268,9 @@ class Scheduler:
         one `serve.tick` span per cycle with `serve.admit`,
         `serve.collect` and `serve.window` nested under it, and the
         engine's `serve.prefill`/`serve.prefill_chunk` spans nested
-        under the admit."""
+        under the admit. ACROSS ticks, each request's detached
+        `serve.request` span (opened at submit) accumulates its
+        lifecycle chain — see the Entry fields above."""
         with trace.span("serve.tick"):
             return self._tick()
 
@@ -347,7 +387,14 @@ class Scheduler:
                 # execution overlaps the deferred bookkeeping below and
                 # is paid for inside the NEXT tick's serve.collect
                 with trace.span("serve.window", window=self.window,
-                                slots=len(self._running)):
+                                slots=len(self._running)) as _wsp:
+                    if trace.get_tracer() is not None:
+                        # the decode-window leg of each rid's lifecycle
+                        # chain — the list is built only when a tracer
+                        # is armed (disabled-path cost stays one global
+                        # read, gated by bench_tracer_overhead)
+                        _wsp.set(rids=[e.rid
+                                       for e in self._running.values()])
                     self.engine.begin_window(self.window)
             except Exception as e:
                 # entries the just-collected window COMPLETED (EOS/
@@ -408,6 +455,12 @@ class Scheduler:
         for e, toks in got:
             if toks and e.t_first is None:
                 e.t_first = t_now
+                trace.point(
+                    "serve.first_token",
+                    parent=(e.span.span_id if e.span is not None
+                            else None),
+                    rid=e.rid,
+                    ttft_ms=round((t_now - e.t_submit) * 1e3, 3))
                 if self.metrics:
                     self.metrics.on_first_token(e.rid, t_now - e.t_submit)
             e.tokens.extend(toks)
@@ -457,6 +510,16 @@ class Scheduler:
 
     def _finish(self, e: Entry, done: list[Entry]) -> None:
         done.append(e)
+        # close the lifecycle chain: the queued child first (a no-op if
+        # admission already closed it — `expired` only lands on entries
+        # that died IN the queue; Span.close applies attrs on the first
+        # close only), then the whole serve.request span with the
+        # terminal state
+        if e.queue_span is not None:
+            e.queue_span.close(expired=True)
+        if e.span is not None:
+            e.span.close(status=e.status, reason=e.finish_reason,
+                         tokens=len(e.tokens))
         if self.metrics:
             ttft = (e.t_first - e.t_submit
                     if e.t_first is not None else None)

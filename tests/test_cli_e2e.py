@@ -310,22 +310,77 @@ def test_cli_serve_chunked_prefix_int8(tmp_path, capsys):
 
 
 def test_cli_serve_trace_out_and_stats(tmp_path, capsys):
-    """ISSUE-5 observability from the product surface: a tiny chunked
-    serve run with --trace-out produces a Perfetto-loadable Chrome
-    trace-event JSON whose admission -> prefill-chunk and tick ->
-    decode-window spans nest correctly, and the offline `stats`
-    subcommand rolls the run's jsonl up into the percentile/counter
-    summary — no re-run needed."""
+    """ISSUE-5/7 observability from the product surface, one chunked
+    serve run covering the whole stack: --trace-out produces a
+    Perfetto-loadable Chrome trace whose admission -> prefill-chunk
+    and tick -> decode-window spans nest correctly AND whose
+    request-lifecycle chain (serve.request > serve.queued /
+    serve.first_token, rid-stamped prefill chunks and windows)
+    reconstructs every finished rid's timeline; --metrics-port serves
+    a live /metrics + /healthz a scraper hits DURING the run; the SLO
+    flags stay silent on this clean run; and the offline `stats`
+    subcommand rolls the run's jsonl up, including the per-request
+    timeline (--request RID)."""
     import json
+    import socket
+    import threading
+    import urllib.request
 
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    scraped = {}
+
+    def scrape():
+        # poll until the exporter binds (it arms before the engine's
+        # warmup compiles, so the window is wide), then scrape both
+        # endpoints while the run is LIVE
+        import time as _time
+
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=2) as r:
+                    scraped["metrics"] = r.read().decode()
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=2) as r:
+                    scraped["healthz"] = r.read().decode()
+                return
+            except OSError:
+                _time.sleep(0.02)
+
+    scraper = threading.Thread(target=scrape, daemon=True)
+    scraper.start()
     trace_path = tmp_path / "trace.json"
+    # --realtime at ~2 req/s stretches the run over a couple of wall
+    # seconds even with every program warm in the jit cache, so the
+    # scraper thread deterministically lands inside the live window
     out = _run(["serve", "--host-devices", "8", "--requests", "5",
                 "--slots", "2", "--window", "4", "--t-max", "32",
                 "--vocab", "11", "--embed-dim", "16", "--num-heads", "2",
                 "--mlp-dim", "32", "--num-blocks", "1",
                 "--prefill-chunk", "8", "--path", str(tmp_path),
-                "--trace-out", str(trace_path)], capsys)
+                "--trace-out", str(trace_path),
+                "--rate", "2.0", "--realtime",
+                "--metrics-port", str(port),
+                "--slo-ttft-p95-ms", "60000",
+                "--slo-error-rate", "0.5"], capsys)
+    scraper.join(timeout=10)
     assert "served: ok=5" in out
+    # the live exposition was really scraped mid-run, in the exact
+    # Prometheus text shape, and /healthz parsed
+    assert f"metrics: http://127.0.0.1:{port}/metrics" in out
+    assert "metrics" in scraped, "scraper never reached /metrics"
+    assert "# TYPE serve_requests_submitted_total counter" \
+        in scraped["metrics"]
+    health = json.loads(scraped["healthz"])
+    assert health["status"] == "ok"
+    # the clean run trips no SLO alert (the faulty side is gated in
+    # tests/test_slo.py)
+    assert "slo: 0 alert(s)" in out
     doc = json.loads(trace_path.read_text())
     spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
     names = {e["name"] for e in spans}
@@ -350,19 +405,118 @@ def test_cli_serve_trace_out_and_stats(tmp_path, capsys):
                       for e in spans if e["name"] == "serve.window"}
     assert window_parents == {"serve.tick"}
 
+    # ISSUE-7 acceptance: for EVERY finished rid, the submit->finish
+    # chain reconstructs from the exported file with correct nesting
+    finished = {json.loads(l)["id"] for l in
+                (tmp_path / "logs" / "serve.jsonl").read_text()
+                .splitlines()
+                if json.loads(l).get("event") == "serve_finish"}
+    assert len(finished) == 5
+    req_by_rid = {e["args"]["rid"]: e for e in spans
+                  if e["name"] == "serve.request"}
+    for rid in finished:
+        req = req_by_rid[rid]
+        assert req["args"]["status"] == "ok"
+        assert req["args"]["parent_id"] is None
+        mine = [e for e in spans if e["args"].get("rid") == rid]
+        names = {e["name"] for e in mine}
+        assert {"serve.request", "serve.queued", "serve.first_token",
+                "serve.prefill_chunk"} <= names, (rid, names)
+        for e in mine:
+            # the whole chain shares the request's trace_id (where
+            # stamped) and sits inside the request span's interval
+            if "trace_id" in e["args"]:
+                assert e["args"]["trace_id"] == req["args"]["trace_id"]
+            assert req["ts"] <= e["ts"] + 1e-3
+            assert (e["ts"] + e["dur"]
+                    <= req["ts"] + req["dur"] + 1e-3)
+            if e["name"] in ("serve.queued", "serve.first_token"):
+                assert (e["args"]["parent_id"]
+                        == req["args"]["span_id"])
+        # the decode windows that carried this rid name it
+        assert any(rid in (e["args"].get("rids") or [])
+                   for e in spans if e["name"] == "serve.window")
+
     # offline stats over the run's serve.jsonl
     out = _run(["stats", str(tmp_path / "logs" / "serve.jsonl")], capsys)
     assert "serve_submit" in out and "serve_finish" in out
     assert "p95=" in out and "mean=" in out
     assert "last metrics snapshot:" in out
     assert "serve_requests_total" in out
+    assert "requests: 5 with per-request timelines" in out
     out = _run(["stats", str(tmp_path / "logs" / "serve.jsonl"),
                 "--json"], capsys)
     summary = json.loads(out)
     assert summary["events"]["serve_finish"]["count"] == 5
-    # usage error, not a traceback, for a missing file
+    # the per-request timeline rides the --json output too
+    rid = sorted(summary["requests"])[0]
+    whats = [e["what"] for e in summary["requests"][rid]]
+    assert whats[0] == "serve_submit" and "serve_finish" in whats
+    # ...and --request renders ONE request's timeline
+    out = _run(["stats", str(tmp_path / "logs" / "serve.jsonl"),
+                "--request", rid], capsys)
+    assert f"request {rid}" in out
+    assert "serve_submit" in out and "serve_finish" in out
+    # usage error, not a traceback, for a missing file / unknown rid /
+    # bad SLO or port flags
     with pytest.raises(SystemExit):
         cli.main(["stats", str(tmp_path / "nope.jsonl")])
+    with pytest.raises(SystemExit):
+        cli.main(["stats", str(tmp_path / "logs" / "serve.jsonl"),
+                  "--request", "no-such-rid"])
+    with pytest.raises(SystemExit):
+        cli.main(["serve", "--host-devices", "8",
+                  "--slo-error-rate", "2.0"])
+    with pytest.raises(SystemExit):
+        cli.main(["serve", "--host-devices", "8",
+                  "--metrics-port", "-1"])
+
+
+def test_cli_stats_covers_train_and_fed_jsonl(tmp_path, capsys):
+    """ISSUE-7 satellite: the `stats` verb end-to-end over a train/fed-
+    SHAPED run.jsonl (epoch records + the driver's real round/
+    round_health stream + a metrics snapshot) — the serve path is
+    covered by test_cli_serve_trace_out_and_stats; this closes the gap
+    for the other two run-log families."""
+    import json
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idc_models_tpu.federated.driver import DriverConfig, run_rounds
+    from idc_models_tpu.federated.fedavg import ServerState
+    from idc_models_tpu.observe import REGISTRY, JsonlLogger
+
+    def round_fn(server, images, labels, weights, rng):
+        new = ServerState(round=server.round + 1, params=server.params,
+                          model_state=server.model_state)
+        return new, {"loss": jnp.float32(0.4),
+                     "accuracy": jnp.float32(0.9),
+                     "clients_dropped": jnp.int32(0)}
+
+    server = ServerState(round=jnp.zeros((), jnp.int32),
+                         params={"w": jnp.ones((2,))}, model_state={})
+    log = tmp_path / "run.jsonl"
+    with JsonlLogger(log) as logger:
+        for e in range(2):
+            logger.log(event="epoch", epoch=e, loss=1.0 - 0.3 * e,
+                       accuracy=0.5 + 0.2 * e, val_loss=1.0,
+                       val_accuracy=0.5)
+        run_rounds(round_fn, server, None, None,
+                   np.ones(3, np.float32),
+                   config=DriverConfig(rounds=3), logger=logger)
+        REGISTRY.log_snapshot(logger)
+
+    out = _run(["stats", str(log)], capsys)
+    assert "epoch" in out and "round_health" in out
+    assert "fed_round_attempts_total" in out    # the snapshot rendered
+    out = _run(["stats", str(log), "--json"], capsys)
+    s = json.loads(out)
+    assert s["events"]["epoch"]["count"] == 2
+    assert s["events"]["round"]["count"] == 3
+    assert s["events"]["round_health"]["fields"]["seconds"]["count"] == 3
+    assert s["events"]["epoch"]["fields"]["loss"]["min"] == 0.7
+    assert s["requests"] == {}      # nothing serve-shaped in this log
 
 
 def test_cli_lm(tmp_path, capsys):

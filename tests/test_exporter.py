@@ -1,0 +1,147 @@
+"""ISSUE 7 tentpole (a): the live /metrics endpoint — scrape output
+byte-identical to `prometheus_text()`, the /healthz document, error
+paths, and clean lifecycle."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from idc_models_tpu.observe import MetricsExporter, MetricsRegistry
+from idc_models_tpu.observe.exporter import LAST_TICK_GAUGE
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+def test_metrics_scrape_byte_identical_to_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("reqs_total", "requests", labels=("status",)).inc(
+        3, status="ok")
+    reg.gauge("depth", "queue depth").set(2)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    with MetricsExporter(reg, port=0) as exp:
+        status, ctype, body = _get(exp.url + "/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+        # the acceptance bar: the scrape IS the exposition — no
+        # translation layer to drift
+        assert body == reg.prometheus_text()
+        # a second scrape after a mutation reflects it
+        reg.gauge("depth").set(7)
+        _, _, body2 = _get(exp.url + "/metrics")
+        assert body2 == reg.prometheus_text()
+        assert "depth 7" in body2
+
+
+def test_healthz_reports_tick_age_queue_and_occupancy():
+    reg = MetricsRegistry()
+    with MetricsExporter(reg, port=0) as exp:
+        # nothing registered yet: every field null, status still ok
+        # (a trainer exposing /metrics has no serve gauges)
+        _, ctype, body = _get(exp.url + "/healthz")
+        doc = json.loads(body)
+        assert ctype.startswith("application/json")
+        assert doc == {"status": "ok", "last_tick_age_s": None,
+                       "queue_depth": None, "slot_occupancy": None}
+        # the serve gauges appear -> the document fills in
+        reg.gauge(LAST_TICK_GAUGE, "tick stamp").set(time.monotonic())
+        reg.gauge("serve_queue_depth", "depth").set(3)
+        reg.gauge("serve_slot_occupancy", "occ").set(0.5)
+        doc = json.loads(_get(exp.url + "/healthz")[2])
+        assert doc["queue_depth"] == 3.0
+        assert doc["slot_occupancy"] == 0.5
+        assert 0.0 <= doc["last_tick_age_s"] < 5.0
+
+
+def test_healthz_ignores_wrong_kind_and_labeled_series():
+    """A COUNTER named like the gauge, or a gauge with only labeled
+    series, must not be misread into the health document."""
+    reg = MetricsRegistry()
+    reg.counter("serve_queue_depth", "wrong kind").inc(9)
+    reg.gauge("serve_slot_occupancy", "labeled only",
+              labels=("tenant",)).set(0.9, tenant="a")
+    with MetricsExporter(reg, port=0) as exp:
+        doc = json.loads(_get(exp.url + "/healthz")[2])
+        assert doc["queue_depth"] is None
+        assert doc["slot_occupancy"] is None
+
+
+def test_unknown_path_404_and_server_survives():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "c").inc()
+    with MetricsExporter(reg, port=0) as exp:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exp.url + "/nope")
+        assert ei.value.code == 404
+        # the 404 did not kill the server
+        assert _get(exp.url + "/metrics")[0] == 200
+
+
+def test_concurrent_scrapes_do_not_interleave():
+    """ThreadingHTTPServer + per-instrument locks: parallel scrapers
+    each get a complete, parseable exposition."""
+    reg = MetricsRegistry()
+    c = reg.counter("spins_total", "spins")
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            c.inc()
+
+    bodies = []
+    with MetricsExporter(reg, port=0) as exp:
+        t = threading.Thread(target=spin, daemon=True)
+        t.start()
+        try:
+            threads = [threading.Thread(
+                target=lambda: bodies.append(
+                    _get(exp.url + "/metrics")[2]))
+                for _ in range(4)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        finally:
+            stop.set()
+            t.join()
+    assert len(bodies) == 4
+    for b in bodies:
+        assert "# TYPE spins_total counter" in b
+        val = [l for l in b.splitlines()
+               if l.startswith("spins_total ")][0]
+        assert float(val.split()[1]) >= 0
+
+
+def test_lifecycle_close_idempotent_and_port_errors():
+    reg = MetricsRegistry()
+    exp = MetricsExporter(reg, port=0)
+    with pytest.raises(RuntimeError):
+        _ = exp.port                 # not started yet
+    exp.start()
+    port = exp.port
+    with pytest.raises(RuntimeError):
+        exp.start()                  # double start is loud
+    exp.close()
+    exp.close()                      # idempotent
+    # the socket really was released: a new exporter can take the port
+    exp2 = MetricsExporter(reg, port=port).start()
+    try:
+        assert exp2.port == port
+    finally:
+        exp2.close()
+
+
+def test_default_registry_is_process_registry():
+    from idc_models_tpu.observe import REGISTRY
+
+    exp = MetricsExporter(port=0)
+    assert exp.registry is REGISTRY

@@ -14,6 +14,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 WORKER = Path(__file__).parent / "_multihost_worker.py"
 
 
@@ -25,6 +27,15 @@ def _free_port() -> int:
 
 def test_two_process_dp_step_agrees(tmp_path):
     import os
+
+    # environmental gate (ISSUE 7 satellite): this container's XLA:CPU
+    # cannot run multiprocess computations AT ALL — probed once per
+    # session with a minimal 2-process psum; the full story lives on
+    # the reason string. Runs for real wherever the capability exists.
+    from _env_probes import MULTIPROC_SKIP_REASON, multiprocess_cpu_ok
+
+    if not multiprocess_cpu_ok():
+        pytest.skip(MULTIPROC_SKIP_REASON)
 
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, GRAFT_TEST_CKPT_DIR=str(tmp_path / "ck"))

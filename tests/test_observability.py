@@ -137,6 +137,44 @@ def test_timer_routes_through_tracer(tracer, capsys):
     assert spans[0]["attrs"] == {"timer": True}
 
 
+def test_detached_spans_explicit_parenting_and_close(tracer):
+    """ISSUE-7 request-lifecycle primitives: a detached span never
+    touches the thread's open-span stack, parents explicitly, closes
+    idempotently with late attrs, and `point` records a marker."""
+    with trace.span("tick"):
+        req = trace.start_span("request", rid="r0")
+        child = trace.start_span("queued", parent=req.span_id, rid="r0")
+        # stack parenting is unaffected: a normal span opened while the
+        # detached ones are live still parents under "tick"
+        with trace.span("inner") as inner:
+            pass
+        inner.close(bogus=True)   # stray close on a stack span: no-op
+        child.close(queue_wait_ms=1.5)
+        child.close(queue_wait_ms=999.0)       # second close: no-op
+        trace.point("first_token", parent=req.span_id, rid="r0")
+        req.close(status="ok")
+    recs = {r["name"]: r for r in tracer.records()}
+    assert recs["request"]["parent"] is None
+    assert recs["queued"]["parent"] == recs["request"]["id"]
+    assert recs["queued"]["attrs"]["queue_wait_ms"] == 1.5
+    assert recs["first_token"]["parent"] == recs["request"]["id"]
+    assert recs["inner"]["parent"] == recs["tick"]["id"]
+    assert "bogus" not in recs["inner"]["attrs"]
+    assert recs["request"]["attrs"]["status"] == "ok"
+    # exactly one record per span despite the double close
+    assert len(tracer.records()) == 5
+
+
+def test_detached_spans_disabled_are_the_noop_handle():
+    assert trace.get_tracer() is None
+    h = trace.start_span("request", rid="r0")
+    assert h is trace.point("x") is trace.span("y")
+    # the chained-call-site contract: the no-op handle's span_id is the
+    # "no parent" value, so rid chains need no enabled/disabled branch
+    assert h.span_id is None
+    h.close(status="ok")                       # accepted, no state
+
+
 def test_tracing_context_installs_and_exports(tmp_path):
     chrome = tmp_path / "t.json"
     with trace.tracing(chrome_path=chrome) as tr:
@@ -330,6 +368,121 @@ def test_fed_driver_round_health_schema_unchanged(tmp_path):
             "clients_dropped"} <= set(health[0])
     assert health[0]["status"] == "ok"
     assert {"round", "attempts", "loss", "accuracy"} <= set(rounds[0])
+
+
+def test_stats_request_timeline_from_events_and_spans(tmp_path):
+    """ISSUE-7 satellite: `summarize_jsonl` groups serve_* events AND
+    rid-stamped span records into per-request timelines; the --request
+    renderer orders them and a missing rid is loud."""
+    from idc_models_tpu.observe import format_request_timeline
+
+    log = tmp_path / "mixed.jsonl"
+    recs = [
+        {"ts": 100.0, "event": "serve_submit", "id": "r0"},
+        {"ts": 100.1, "event": "serve_admit", "id": "r0",
+         "queue_wait_ms": 100.0},
+        {"event": "span", "name": "serve.prefill_chunk", "id": 7,
+         "parent": 3, "tid": 1, "t_ms": 150.0, "dur_ms": 30.0,
+         "wall": 100.15, "attrs": {"rid": "r0", "slot": 1}},
+        {"ts": 100.3, "event": "serve_first_token", "id": "r0",
+         "ttft_ms": 300.0, "prefill_ms": 200.0},
+        {"ts": 100.5, "event": "serve_finish", "id": "r0", "tokens": 4,
+         "reason": "budget", "ttft_ms": 300.0},
+        {"ts": 100.2, "event": "serve_submit", "id": "r1"},
+        # rid-less span: belongs to no request
+        {"event": "span", "name": "serve.tick", "id": 9, "parent": None,
+         "tid": 1, "t_ms": 0.0, "dur_ms": 1.0, "wall": 100.0,
+         "attrs": {}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    s = summarize_jsonl(log)
+    assert set(s["requests"]) == {"r0", "r1"}
+    r0 = s["requests"]["r0"]
+    assert [e["what"] for e in r0] == [
+        "serve_submit", "serve_admit", "serve.prefill_chunk",
+        "serve_first_token", "serve_finish"]
+    assert r0[0]["t_s"] == 0.0
+    assert r0[2]["dur_ms"] == 30.0 and r0[2]["detail"]["slot"] == 1
+    assert r0[4]["t_s"] == pytest.approx(0.5)
+    text = format_request_timeline(s, "r0")
+    assert "request r0" in text and "serve.prefill_chunk" in text
+    assert "serve_finish" in text and "reason=budget" in text
+    with pytest.raises(KeyError):
+        format_request_timeline(s, "nope")
+
+
+def test_stats_covers_train_and_fed_jsonl(tmp_path):
+    """ISSUE-7 satellite: the stats rollup over train/fed-SHAPED run
+    logs (epoch + round + round_health + timer records), not just the
+    serve path — field percentiles, timer table, and no spurious
+    request table."""
+    log = tmp_path / "run.jsonl"
+    with JsonlLogger(log) as logger:
+        for e in range(3):
+            logger.log(event="epoch", epoch=e, loss=1.0 - 0.2 * e,
+                       accuracy=0.5 + 0.1 * e, val_loss=1.1 - 0.2 * e,
+                       val_accuracy=0.45 + 0.1 * e)
+        for r in range(4):
+            logger.log(event="round", round=r, train_loss=0.9 - 0.1 * r,
+                       train_acc=0.6 + 0.05 * r, test_loss=1.0,
+                       test_acc=0.55)
+            logger.log(event="round_health", round=r, attempt=0,
+                       status="ok", seconds=0.05, participants=8,
+                       loss=0.9 - 0.1 * r)
+        logger.log(event="timer", name="Federated training",
+                   seconds=1.25)
+    s = summarize_jsonl(log)
+    assert s["events"]["epoch"]["count"] == 3
+    assert s["events"]["epoch"]["fields"]["loss"]["min"] == 0.6
+    assert s["events"]["round"]["count"] == 4
+    assert s["events"]["round"]["fields"]["train_loss"]["max"] == 0.9
+    assert s["events"]["round_health"]["fields"]["seconds"]["mean"] \
+        == 0.05
+    assert s["timers"]["Federated training"]["count"] == 1
+    assert s["requests"] == {}        # nothing serve-shaped in the log
+
+
+def test_bench_compare_flags_directional_regressions(tmp_path):
+    """ISSUE-7 satellite: bench_compare diffs the two newest
+    BENCH_rNN.json records, honoring each key's good direction and the
+    10% tolerance; under two files is loud."""
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    _sys.path.insert(0, str(_Path(__file__).parent.parent))
+    try:
+        import bench
+    finally:
+        _sys.path.pop(0)
+
+    def rec(**kw):
+        return {"metric": "x", **kw}
+
+    old = rec(value=100.0, serve_ttft_ms_p95=100.0, fed_round_s=1.0,
+              mfu=0.6)
+    # throughput -20% (regression), ttft +50% (regression), round -30%
+    # (improvement), mfu +5% (inside tolerance)
+    new = rec(value=80.0, serve_ttft_ms_p95=150.0, fed_round_s=0.7,
+              mfu=0.63)
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+    # the driver-record shape (bench line inside `tail`) parses too
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "rc": 0, "tail": "noise\n" + json.dumps(new) + "\n"}))
+    out = bench.bench_compare(tmp_path)
+    assert out["new"].endswith("BENCH_r02.json")
+    assert set(out["regressions"]) == {"value", "serve_ttft_ms_p95"}
+    assert out["keys"]["fed_round_s"]["regressed"] is False
+    assert out["keys"]["mfu"]["regressed"] is False
+    assert out["keys"]["value"]["ratio"] == pytest.approx(0.8)
+    with pytest.raises(ValueError):
+        bench.bench_compare(tmp_path / "empty")
+    # every documented headline key really is documented
+    docs = (_Path(__file__).parent.parent / "docs"
+            / "BENCHMARKS.md").read_text()
+    for key in bench.HIGHER_IS_BETTER + bench.LOWER_IS_BETTER:
+        assert f"`{key}`" in docs, (
+            f"bench_compare headline key {key!r} missing from "
+            f"docs/BENCHMARKS.md")
 
 
 def test_fit_epoch_jsonl_schema_unchanged(tmp_path, devices):

@@ -1,0 +1,306 @@
+"""ISSUE 7 tentpole (c): the SLO engine — burn-rate math over sliding
+windows, multi-window alert/resolve transitions, the jsonl + registry
+surfaces, and the serve/federated integrations (alert under an
+injected fault plan, silence on the clean baseline — the acceptance
+gate)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu.observe import (
+    SLO, SLOEngine, JsonlLogger, MetricsRegistry, trace,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _engine(slos, clock, **kw):
+    kw.setdefault("short_window_s", 10.0)
+    kw.setdefault("long_window_s", 50.0)
+    kw.setdefault("min_samples", 5)
+    return SLOEngine(slos, clock=clock,
+                     registry=kw.pop("registry", MetricsRegistry()),
+                     **kw)
+
+
+# -- declaration -----------------------------------------------------------
+
+
+def test_slo_declarations_validate():
+    s = SLO.latency("ttft", threshold_s=0.2, percentile=95.0)
+    assert s.budget == pytest.approx(0.05)
+    assert SLO.rate("err", budget=0.01).budget == 0.01
+    with pytest.raises(ValueError):
+        SLO.latency("x", threshold_s=0.0)
+    with pytest.raises(ValueError):
+        SLO.latency("x", threshold_s=0.1, percentile=100.0)
+    with pytest.raises(ValueError):
+        SLO.rate("x", budget=1.5)
+    with pytest.raises(ValueError):
+        SLO(name="x", kind="weird", budget=0.5)
+    clock = FakeClock()
+    with pytest.raises(ValueError):
+        _engine([], clock)
+    with pytest.raises(ValueError):
+        _engine([SLO.rate("a", budget=0.1), SLO.rate("a", budget=0.2)],
+                clock)
+    with pytest.raises(ValueError):
+        SLOEngine([SLO.rate("a", budget=0.1)], short_window_s=60,
+                  long_window_s=30)
+
+
+def test_kind_mismatch_and_unknown_names_are_loud():
+    eng = _engine([SLO.latency("ttft", threshold_s=0.1)], FakeClock())
+    assert eng.has("ttft") and not eng.has("nope")
+    with pytest.raises(ValueError):
+        eng.record("ttft", ok=True)       # latency kind wants observe
+    with pytest.raises(ValueError):
+        eng.observe("nope", 0.1)
+    with pytest.raises(ValueError):
+        eng.breached("nope")
+
+
+# -- burn-rate math --------------------------------------------------------
+
+
+def test_burn_rate_is_bad_fraction_over_budget():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    eng = _engine([SLO.latency("ttft", threshold_s=0.1,
+                               percentile=90.0)],   # budget 0.10
+                  clock, registry=reg)
+    # 20 samples, 4 bad -> bad fraction 0.2 -> burn 2.0
+    for i in range(20):
+        clock.t += 0.1
+        eng.observe("ttft", 0.5 if i % 5 == 0 else 0.01)
+    eng.evaluate()
+    g = reg.gauge("slo_burn_rate", labels=("slo", "window"))
+    assert g.value(slo="ttft", window="short") == pytest.approx(2.0)
+    assert g.value(slo="ttft", window="long") == pytest.approx(2.0)
+
+
+def test_samples_age_out_of_the_windows():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    eng = _engine([SLO.rate("err", budget=0.5)], clock, registry=reg)
+    for _ in range(10):
+        clock.t += 0.1
+        eng.record("err", ok=False)
+    eng.evaluate()
+    g = reg.gauge("slo_burn_rate", labels=("slo", "window"))
+    assert g.value(slo="err", window="short") == pytest.approx(2.0)
+    # jump past the short window: the short burn empties, the long
+    # window still holds the history
+    clock.t += 20.0
+    eng.evaluate()
+    assert g.value(slo="err", window="short") == 0.0
+    assert g.value(slo="err", window="long") == pytest.approx(2.0)
+    # past the long window too: everything pruned
+    clock.t += 100.0
+    eng.evaluate()
+    assert g.value(slo="err", window="long") == 0.0
+
+
+def test_alert_needs_both_windows_and_min_samples():
+    clock = FakeClock()
+    eng = _engine([SLO.rate("err", budget=0.05)], clock, min_samples=8)
+    # 4 bad samples: over-budget but under min_samples -> no alert
+    for _ in range(4):
+        clock.t += 0.5
+        eng.record("err", ok=False)
+    assert eng.evaluate() == [] and not eng.breached("err")
+    # enough samples now -> alert fires exactly once
+    for _ in range(6):
+        clock.t += 0.5
+        eng.record("err", ok=False)
+    fired = eng.evaluate()
+    assert [a["slo"] for a in fired] == ["err"]
+    assert eng.breached("err")
+    assert eng.evaluate() == []          # hysteresis: no re-fire
+    assert len(eng.alerts) == 1
+
+
+def test_alert_resolves_and_can_refire(tmp_path):
+    clock = FakeClock()
+    log = tmp_path / "run.jsonl"
+    reg = MetricsRegistry()
+    with JsonlLogger(log) as logger:
+        eng = _engine([SLO.rate("err", budget=0.05)], clock,
+                      logger=logger, registry=reg)
+        for _ in range(10):
+            clock.t += 0.1
+            eng.record("err", ok=False)
+        eng.evaluate()
+        assert eng.breached("err")
+        # a healthy stretch dilutes both windows below the threshold
+        for _ in range(400):
+            clock.t += 0.1
+            eng.record("err", ok=True)
+        eng.evaluate()
+        assert not eng.breached("err")
+        # breach again -> a SECOND alert fires
+        for _ in range(60):
+            clock.t += 0.1
+            eng.record("err", ok=False)
+        eng.evaluate()
+        assert eng.breached("err") and len(eng.alerts) == 2
+    events = [json.loads(l)["event"] for l in open(log)]
+    assert events.count("slo_alert") == 2
+    assert events.count("slo_resolved") == 1
+    assert reg.counter("slo_alerts_total",
+                       labels=("slo",)).value(slo="err") == 2
+    assert reg.gauge("slo_breached",
+                     labels=("slo",)).value(slo="err") == 1
+
+
+# -- serving integration ---------------------------------------------------
+
+
+def _drive_serving(slo_engine, clock, *, ttft_s):
+    """Replay a synthetic request stream through the REAL metrics-hook
+    wiring (no engine compile needed): submit -> admit -> first token
+    -> finish, one request per 0.2s, with the given TTFT."""
+    from idc_models_tpu.serve.metrics import ServingMetrics
+
+    m = ServingMetrics(registry=MetricsRegistry(), slo=slo_engine)
+    for i in range(40):
+        clock.t += 0.2
+        rid = f"r{i}"
+        m.on_submit(rid, clock.t)
+        m.on_admit(rid, 0.01)
+        m.on_first_token(rid, ttft_s)
+        m.on_finish(rid, n_tokens=4, ttft_s=ttft_s, decode_s=0.05,
+                    reason="budget", t=clock.t)
+        m.on_cycle(queue_depth=0, occupancy=0.5, tokens=4)
+    return m
+
+
+def test_serving_slo_alerts_under_injected_latency_and_not_clean():
+    """The acceptance gate, serve side: the same wiring fires under
+    injected TTFT latency and stays silent on the clean baseline."""
+    clock = FakeClock()
+    eng = _engine([SLO.latency("ttft", threshold_s=0.2),
+                   SLO.rate("error_rate", budget=0.05)], clock)
+    _drive_serving(eng, clock, ttft_s=0.5)      # every TTFT breaches
+    assert [a["slo"] for a in eng.alerts] == ["ttft"]
+    assert eng.breached("ttft") and not eng.breached("error_rate")
+
+    clock2 = FakeClock()
+    eng2 = _engine([SLO.latency("ttft", threshold_s=0.2),
+                    SLO.rate("error_rate", budget=0.05)], clock2)
+    _drive_serving(eng2, clock2, ttft_s=0.05)   # clean baseline
+    assert eng2.alerts == []
+    assert not eng2.breached("ttft")
+
+
+def test_serving_error_rate_counts_rejects_and_deadline():
+    from idc_models_tpu.serve.metrics import ServingMetrics
+
+    clock = FakeClock()
+    eng = _engine([SLO.rate("error_rate", budget=0.05)], clock,
+                  min_samples=5)
+    m = ServingMetrics(registry=MetricsRegistry(), slo=eng)
+    for i in range(10):
+        clock.t += 0.5
+        if i % 2:
+            m.on_reject(f"r{i}", clock.t)
+        else:
+            m.on_finish(f"r{i}", n_tokens=0, ttft_s=None, decode_s=0.0,
+                        reason="deadline", t=clock.t)
+        m.on_cycle(queue_depth=1, occupancy=0.0)
+    assert eng.breached("error_rate")
+
+
+# -- federated integration -------------------------------------------------
+
+
+def _fed_run(fail_round_fn, slo_engine, *, fault_plan=None, rounds=4,
+             tracer=None):
+    from idc_models_tpu.federated.driver import DriverConfig, run_rounds
+    from idc_models_tpu.federated.fedavg import ServerState
+
+    server = ServerState(round=jnp.zeros((), jnp.int32),
+                         params={"w": jnp.ones((2,))}, model_state={})
+    prev = trace.set_tracer(tracer)
+    try:
+        return run_rounds(
+            fail_round_fn, server, None, None, np.ones(4, np.float32),
+            config=DriverConfig(rounds=rounds, max_attempts=3),
+            slo=slo_engine, fault_plan=fault_plan)
+    finally:
+        trace.set_tracer(prev)
+
+
+def _round_fn(diverge_every):
+    from idc_models_tpu.federated.fedavg import ServerState
+
+    calls = {"n": 0}
+
+    def round_fn(server, images, labels, weights, rng):
+        calls["n"] += 1
+        bad = diverge_every and calls["n"] % diverge_every == 1
+        return (ServerState(round=server.round + 1,
+                            params=server.params,
+                            model_state=server.model_state),
+                {"loss": jnp.float32(float("nan") if bad else 0.5),
+                 "accuracy": jnp.float32(0.9),
+                 "clients_dropped": jnp.int32(0)})
+
+    return round_fn
+
+
+def test_fed_driver_slo_alerts_under_fault_plan_and_not_clean():
+    """The acceptance gate, federated side: a fault-plan run whose
+    attempts keep diverging trips the round-failure-rate SLO; the
+    clean baseline run stays silent."""
+    from idc_models_tpu import faults as faults_lib
+
+    plan = faults_lib.parse_fault_spec("nan:0-2", 4)
+    eng = _engine([SLO.rate("round_failure_rate", budget=0.05)],
+                  FakeClock(), min_samples=3)
+    # every odd call diverges -> one failed attempt per round
+    _fed_run(_round_fn(diverge_every=2), eng, fault_plan=plan)
+    assert [a["slo"] for a in eng.alerts] == ["round_failure_rate"]
+
+    eng2 = _engine([SLO.rate("round_failure_rate", budget=0.05)],
+                   FakeClock(), min_samples=3)
+    _fed_run(_round_fn(diverge_every=0), eng2)
+    assert eng2.alerts == []
+
+
+def test_fed_client_spans_carry_fault_outcomes():
+    """Tentpole (b), federated half: every attempt's fed.round span
+    gains one nested fed.client marker per participant, stamped with
+    the plan's fault outcome for that (client, round)."""
+    from idc_models_tpu import faults as faults_lib
+
+    plan = faults_lib.parse_fault_spec("sign_flip:0-1:x1000,crash:2", 4)
+    tr = trace.Tracer()
+    _fed_run(_round_fn(diverge_every=0), None, fault_plan=plan,
+             rounds=2, tracer=tr)
+    recs = tr.records()
+    by_id = {r["id"]: r for r in recs}
+    rounds = [r for r in recs if r["name"] == "fed.round"]
+    clients = [r for r in recs if r["name"] == "fed.client"]
+    assert len(rounds) == 2
+    assert len(clients) == 2 * 4          # 4 participants x 2 rounds
+    for c in clients:
+        parent = by_id[c["parent"]]
+        assert parent["name"] == "fed.round"
+        assert c["attrs"]["round"] == parent["attrs"]["round"]
+    outcome = {c["attrs"]["client"]: c["attrs"]["fault"]
+               for c in clients if c["attrs"]["round"] == 0}
+    assert outcome == {0: "sign_flip", 1: "sign_flip", 2: "crash",
+                       3: "ok"}
+    flipped = [c for c in clients if c["attrs"]["fault"] == "sign_flip"]
+    assert all(c["attrs"]["fault_scale"] == 1000.0 for c in flipped)

@@ -183,6 +183,118 @@ def test_print_allowlist_entries_still_exist():
     assert not stale, f"print allowlist entries match no code: {stale}"
 
 
+# -- metrics hygiene (ISSUE 7 satellites) -----------------------------------
+#
+# 1. Every metrics-registry registration must carry non-empty help text:
+#    the /metrics exposition renders `# HELP` from it, and a bare metric
+#    name is exactly the kind of operational surface that rots into
+#    "nobody knows what this counts".
+# 2. `time.time()` is banned in serve/ + observe/ outside a documented
+#    wall-clock-anchor allowlist: hot-path intervals must come from
+#    time.monotonic()/perf_counter (wall time jumps under NTP slew and
+#    breaks durations); wall clocks are for ANCHORING records to epoch
+#    time, which each allowlisted site documents.
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+# (relative path, enclosing function) -> why wall-clock is correct there
+TIME_TIME_ALLOWLIST = {
+    ("observe/logging.py", "log"):
+        "every jsonl record's `ts` anchor — the cross-run comparison "
+        "axis; never used for durations",
+    ("observe/trace.py", "__init__"):
+        "the tracer's one wall anchor (wall_t0) mapping monotonic span "
+        "offsets to epoch time; durations stay on the injected "
+        "monotonic clock",
+    ("observe/metrics_registry.py", "write_snapshot"):
+        "the standalone snapshot file's header timestamp",
+}
+
+
+def _scan_metric_help(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(PACKAGE)).replace("\\", "/")
+    violations = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _METRIC_FACTORIES
+                    and child.args
+                    and isinstance(child.args[0], ast.Constant)
+                    and isinstance(child.args[0].value, str)):
+                help_node = None
+                if len(child.args) > 1:
+                    help_node = child.args[1]
+                else:
+                    for kw in child.keywords:
+                        if kw.arg == "help":
+                            help_node = kw.value
+                ok = (isinstance(help_node, ast.Constant)
+                      and isinstance(help_node.value, str)
+                      and help_node.value.strip())
+                if not ok:
+                    violations.append(
+                        (rel, child.lineno, child.args[0].value))
+            walk(child)
+
+    walk(tree)
+    return violations
+
+
+def test_metric_registrations_carry_help_text():
+    violations = []
+    for f in sorted(PACKAGE.rglob("*.py")):
+        if f.name == "metrics_registry.py":
+            continue      # the factory definitions, not registrations
+        violations.extend(_scan_metric_help(f))
+    assert not violations, (
+        "metrics registered without help text (the /metrics exposition "
+        "renders '# HELP' from it — every instrument must say what it "
+        f"counts): {violations}")
+
+
+def _scan_time_time(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(PACKAGE)).replace("\\", "/")
+    violations, live = [], set()
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "time"
+                    and isinstance(child.func.value, ast.Name)
+                    and child.func.value.id == "time"):
+                key = (rel, _enclosing_function(stack))
+                live.add(key)
+                if key not in TIME_TIME_ALLOWLIST:
+                    violations.append((rel, child.lineno, key[1]))
+            walk(child, stack + [child])
+
+    walk(tree, [])
+    return violations, live
+
+
+def test_no_wall_clock_in_serve_observe_hot_paths():
+    violations, live = [], set()
+    for sub in ("serve", "observe"):
+        for f in sorted((PACKAGE / sub).rglob("*.py")):
+            v, l = _scan_time_time(f)
+            violations.extend(v)
+            live.update(l)
+    assert not violations, (
+        "time.time() in serve/ or observe/ outside the documented "
+        "wall-clock-anchor allowlist (durations and deadlines use "
+        "time.monotonic()/perf_counter — wall time jumps under NTP "
+        f"slew; extend TIME_TIME_ALLOWLIST only for record anchors): "
+        f"{violations}")
+    stale = set(TIME_TIME_ALLOWLIST) - live
+    assert not stale, (
+        f"time.time allowlist entries match no code: {stale}")
+
+
 def test_allowlist_entries_still_exist():
     """A stale allowlist entry means the site was fixed or moved —
     prune it so the list stays an honest inventory."""
