@@ -32,6 +32,7 @@ any MFU outside (0, 1] is a hard failure, not a result.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -1002,6 +1003,202 @@ def bench_serving_shared_prefix(on_accelerator: bool):
     }
 
 
+def bench_serving_resilience(on_accelerator: bool):
+    """The ISSUE-8 resilience layer under load, two scenarios:
+
+    1. OVERLOAD BURST — the same synthetic burst wave (declarative
+       `burst` faults, deterministic arrivals) against a brownout-
+       protected server vs an unprotected one. The protected server
+       escalates pause-writes -> clamp -> shed as the queue passes its
+       watermark and TTFT p95 of the requests it DOES serve stays
+       bounded (documented bound, asserted here: strictly below the
+       unprotected run's p95 — which grows with the unshed queue);
+       the unprotected server serves everything late.
+    2. CLEAN-PATH TAX — what arming EVERY resilience feature (per-cycle
+       slot health checks, request journal, brownout controller, TTFT
+       SLO evaluation) adds to one steady-state decode cycle, with no
+       faults firing. Measured the same way as bench_tracer_overhead
+       (whose <2% bar this shares): each component's per-cycle cost is
+       timed in isolation over many iterations against the measured
+       decode-window wall — an A/B of full serve runs cannot resolve a
+       <2% effect under this machine's ±50% run-to-run noise, while
+       the component arithmetic is noise-immune. The gated figure
+       charges the work that sits on the DEVICE-IDLE critical path
+       (the slot-health reduce + fetch, between collect and the next
+       dispatch); the journal write and the brownout/SLO evaluation
+       run in the tick's deferred-bookkeeping section WHILE the next
+       window executes on device, so they are reported separately
+       (`serve_resilience_deferred_us_per_cycle`) and measured
+       pessimistically (every slot emitting every cycle).
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import attention_lm
+    from idc_models_tpu.serve import (
+        BrownoutController, LMServer, RetryPolicy, Request, ServeFault,
+        ServeFaultPlan,
+    )
+    from idc_models_tpu.observe import SLO, SLOEngine
+    from idc_models_tpu.observe.metrics_registry import MetricsRegistry
+
+    if on_accelerator:
+        vocab, e, heads, blocks, mlp = 1024, 512, 8, 2, 2048
+        t_max, n_slots, window = 2048, 8, 32
+        n_base, budgets = 8, (200, 260)
+        burst_ticks, burst_n, burst_budget = range(4, 10), 8, 200
+    else:
+        vocab, e, heads, blocks, mlp = 32, 32, 2, 2, 64
+        t_max, n_slots, window = 128, 4, 8
+        n_base, budgets = 8, (24, 32)
+        burst_ticks, burst_n, burst_budget = range(3, 9), 6, 24
+    mesh = meshlib.seq_mesh(1)
+    model = attention_lm(vocab, t_max, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks, mesh=mesh)
+    params = model.init(jax.random.key(0)).params
+    kw = dict(embed_dim=e, num_heads=heads, num_blocks=blocks,
+              t_max=t_max, mesh=mesh, cache_dtype=jnp.bfloat16,
+              n_slots=n_slots, window=window, max_queue_depth=256)
+
+    rng = np.random.default_rng(11)
+
+    def mk_trace(tag, n, lo, hi):
+        return [(0.0, Request(
+            id=f"{tag}{i}",
+            prompt=tuple(int(x) for x in rng.integers(0, vocab, 6)),
+            max_new_tokens=int(rng.integers(lo, hi))))
+            for i in range(n)]
+
+    # ---- scenario 1: burst vs brownout --------------------------------
+    burst_plan = ServeFaultPlan(
+        [ServeFault("burst", t, n=burst_n, prompt_len=6,
+                    budget=burst_budget) for t in burst_ticks])
+
+    def burst_pass(protected: bool):
+        ctrl = None
+        if protected:
+            ctrl = BrownoutController(
+                queue_high=2 * n_slots, queue_low=1, clamp_tokens=8,
+                escalate_dwell_s=0.0, clear_after_s=0.05)
+        server = LMServer(params, fault_plan=burst_plan, brownout=ctrl,
+                          **kw)
+        server.run(mk_trace("p" if protected else "u", n_base,
+                            *budgets))
+        s = server.summary()
+        return s, (ctrl.max_stage_seen if ctrl else 0)
+
+    burst_pass(True)                                 # compile both paths
+    burst_pass(False)
+    best_p = best_u = None
+    max_stage = 0
+    for _ in range(2):                               # interleaved pairs
+        s_p, stage = burst_pass(True)
+        s_u, _ = burst_pass(False)
+        max_stage = max(max_stage, stage)
+        if (best_p is None
+                or s_p["serve_ttft_ms_p95"] < best_p["serve_ttft_ms_p95"]):
+            best_p = s_p
+        if (best_u is None
+                or s_u["serve_ttft_ms_p95"] < best_u["serve_ttft_ms_p95"]):
+            best_u = s_u
+    assert best_p["serve_shed"] > 0, "brownout never shed under burst"
+    # the documented bound: while shedding, served-request TTFT p95
+    # stays strictly below the unprotected run's (which absorbs the
+    # whole unshed queue as tail latency)
+    assert (best_p["serve_ttft_ms_p95"]
+            < best_u["serve_ttft_ms_p95"]), (best_p, best_u)
+
+    # ---- scenario 2: clean-path tax -----------------------------------
+    # One full armed run first — parity/status sanity, not timing: every
+    # feature on, no fault fires, everything finishes ok with zero
+    # quarantines. (Token parity vs the serial Generator is gated in
+    # tests/test_serve_resilience.py.)
+    tmp = tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False)
+    slo = SLOEngine([SLO.latency("ttft", threshold_s=60.0)],
+                    registry=MetricsRegistry())
+    armed = LMServer(
+        params, retry=RetryPolicy(max_retries=2),
+        fault_plan=ServeFaultPlan([]),          # health checks on
+        journal=tmp.name,
+        brownout=BrownoutController(queue_high=10_000), slo=slo, **kw)
+    results = armed.run(mk_trace("c", 3 * n_slots, *budgets))
+    assert results and all(r.status == "ok" for r in results)
+    assert armed.summary()["serve_slot_faults"] == 0
+
+    # The tax itself is measured per COMPONENT, bench_tracer_overhead
+    # style: the armed loop adds exactly (a) one slot_health reduce +
+    # fetch + the host invariant checks on the device-idle critical
+    # path, and — in the deferred-bookkeeping section overlapping the
+    # dispatched window — (b) journal progress writes, (c) one empty
+    # fault-plan probe, (d) one brownout evaluate, and (e) the SLO
+    # evaluate (PR 7 machinery). Each is timed in isolation over many
+    # iterations; the denominator is the measured steady-state decode
+    # window wall on the SAME armed server.
+    for i in range(n_slots):
+        armed.submit(Request(id=f"w{i}", prompt=(1, 2, 3, 4),
+                             max_new_tokens=t_max - 8))
+    armed.step()                                # admissions + window
+    armed.step()                                # warm steady state
+
+    def timed_windows(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            armed.step()    # collect (host token fetch = fence) + next
+        return (time.perf_counter() - t0) / k
+    k = max(4, (t_max - 8) // window - 4)
+    window_s = min(timed_windows(k // 2), timed_windows(k - k // 2))
+
+    eng, sched = armed.engine, armed.scheduler
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        codes = eng.slot_health()
+        for s in range(n_slots):
+            if codes[s] or not eng.slot_invariants_ok(s):
+                raise AssertionError("clean engine reported a fault")
+    health_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        # pessimistic: every slot emits every cycle; the journal
+        # batches the cycle into one record and strides the writes
+        armed.journal.record_progress(
+            {f"w{s}": window for s in range(n_slots)})
+    journal_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sched.brownout.evaluate(queue_depth=0)
+        sched.fault_plan.at(sched._cycle)
+        sched.fault_plan.bursts_at(sched._cycle)
+    control_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        slo.evaluate()
+    slo_s = (time.perf_counter() - t0) / reps
+    armed.close()
+    os.unlink(tmp.name)
+
+    deferred_s = journal_s + control_s + slo_s
+    overhead_pct = health_s / window_s * 100.0
+    return {
+        "serve_resilience_requests": n_base,
+        "serve_resilience_burst_requests": burst_n * len(burst_ticks),
+        "serve_resilience_shed": best_p["serve_shed"],
+        "serve_brownout_max_stage": max_stage,
+        "serve_resilience_ttft_ms_p95_brownout":
+            best_p["serve_ttft_ms_p95"],
+        "serve_resilience_ttft_ms_p95_unprotected":
+            best_u["serve_ttft_ms_p95"],
+        "serve_resilience_window_ms": round(window_s * 1e3, 3),
+        "serve_resilience_health_us_per_cycle": round(health_s * 1e6, 2),
+        "serve_resilience_deferred_us_per_cycle":
+            round(deferred_s * 1e6, 2),
+        "serve_resilience_overhead_pct": round(overhead_pct, 4),
+    }
+
+
 def bench_tracer_overhead(on_accelerator: bool):
     """The observability tax on the serve decode hot loop — gated by
     the ISSUE-5 acceptance bar (< 2% with tracing disabled).
@@ -1147,6 +1344,8 @@ LOWER_IS_BETTER = (
     "serve_ttft_ms_p50", "serve_ttft_ms_p95",
     "serve_ttft_ms_p95_shared_prefix",
     "serve_chunked_prefill_decode_stall_ms",
+    "serve_resilience_ttft_ms_p95_brownout",
+    "serve_resilience_overhead_pct",
     "serve_trace_disabled_overhead_pct",
     "flash_fwd_bwd_ms", "model_step_ms",
     "zigzag_zigzag_ms", "ring_fwd_pallas_ms",
@@ -1262,6 +1461,7 @@ def main() -> None:
     ring.update(bench_lm_decode(on_accelerator))
     ring.update(bench_serving(on_accelerator))
     ring.update(bench_serving_shared_prefix(on_accelerator))
+    ring.update(bench_serving_resilience(on_accelerator))
     ring.update(bench_tracer_overhead(on_accelerator))
     ring.update(bench_federated_robustness(on_accelerator))
     if on_accelerator:

@@ -344,6 +344,48 @@ def _parse(argv):
                          "occupancy) on 127.0.0.1:PORT for the run's "
                          "duration (0 = OS-assigned port, printed; "
                          "observe/exporter.py)")
+    sp.add_argument("--serve-faults", default=None,
+                    help="deterministic serve fault drill "
+                         "(serve/faults.py), tick-indexed: e.g. "
+                         "'nan_logits:3:0,stall:5-8:0.02,burst:2:16,"
+                         "crash:40' — poisons/stalls/bursts/crashes "
+                         "replay bit-identically; pair with "
+                         "--max-retries and --journal to watch the "
+                         "recovery paths work")
+    sp.add_argument("--max-retries", type=int, default=0,
+                    help="bounded re-admission for requests recovered "
+                         "from a quarantined slot or a failed prefill "
+                         "dispatch (0 = off; arming this also turns "
+                         "on the per-cycle slot health checks)")
+    sp.add_argument("--retry-backoff-ms", type=float, default=50.0,
+                    help="base delay between retry attempts "
+                         "(exponential: doubles per retry)")
+    sp.add_argument("--journal", default=None,
+                    help="request-journal WAL path (serve/journal.py): "
+                         "accepted requests, per-tick progress, and "
+                         "finishes; at startup any in-flight requests "
+                         "a previous crashed run left in the file are "
+                         "re-admitted through the normal path")
+    sp.add_argument("--brownout", action="store_true",
+                    help="arm the staged degradation controller "
+                         "(serve/brownout.py): when a declared SLO "
+                         "burns or the queue passes the watermark, "
+                         "pause prefix-cache writes -> clamp "
+                         "max_new_tokens -> shed new submits (status "
+                         "'shed'), restoring with hysteresis")
+    sp.add_argument("--brownout-queue-high", type=int, default=None,
+                    help="queue-depth escalation watermark for "
+                         "--brownout (default: half --max-queue-depth)")
+    sp.add_argument("--brownout-clamp-tokens", type=int, default=8,
+                    help="the max_new_tokens bound brownout stage 2 "
+                         "applies to new admissions")
+    sp.add_argument("--brownout-dwell-ms", type=float, default=250.0,
+                    help="minimum time between brownout escalations "
+                         "(one stage per dwell while the signal "
+                         "fires; lower it for fast drills)")
+    sp.add_argument("--brownout-clear-ms", type=float, default=1000.0,
+                    help="how long the signal must stay clear before "
+                         "each one-stage restore (the hysteresis)")
     sp.add_argument("--slo-ttft-p95-ms", type=float, default=None,
                     help="declare a TTFT SLO: p95 of submit->first-"
                          "token <= this many ms, burn-rate-alerted "
@@ -975,6 +1017,31 @@ def _run_serve(ns):
     if ns.metrics_port is not None and not 0 <= ns.metrics_port <= 65535:
         sys.exit(f"--metrics-port {ns.metrics_port} must be in "
                  f"[0, 65535] (0 = OS-assigned)")
+    if ns.max_retries < 0:
+        sys.exit(f"--max-retries {ns.max_retries} must be >= 0")
+    if ns.retry_backoff_ms < 0:
+        sys.exit(f"--retry-backoff-ms {ns.retry_backoff_ms} must be "
+                 f">= 0")
+    if (ns.brownout_queue_high is not None
+            and ns.brownout_queue_high < 1):
+        sys.exit(f"--brownout-queue-high {ns.brownout_queue_high} "
+                 f"must be >= 1")
+    if ns.brownout_clamp_tokens < 1:
+        sys.exit(f"--brownout-clamp-tokens {ns.brownout_clamp_tokens} "
+                 f"must be >= 1")
+    if ns.brownout_dwell_ms < 0 or ns.brownout_clear_ms < 0:
+        sys.exit(f"--brownout-dwell-ms/--brownout-clear-ms must be "
+                 f">= 0, got {ns.brownout_dwell_ms}/"
+                 f"{ns.brownout_clear_ms}")
+    ns.serve_fault_plan = None
+    if ns.serve_faults:
+        from idc_models_tpu.serve import parse_serve_fault_spec
+
+        try:
+            ns.serve_fault_plan = parse_serve_fault_spec(
+                ns.serve_faults, seed=ns.seed)
+        except ValueError as e:
+            sys.exit(f"--serve-faults: {e}")
     mesh = meshlib.seq_mesh(ns.seq_parallel)
     # the model trains through the SAME ring the serving mesh uses —
     # omitting mesh here would silently train single-device full
@@ -1058,6 +1125,34 @@ def _serve_body(ns, mesh, params, logger) -> None:
         slo = SLOEngine(slos, short_window_s=ns.slo_window_s,
                         long_window_s=5.0 * ns.slo_window_s,
                         logger=logger)
+    # resilience wiring (serve/faults, scheduler RetryPolicy,
+    # serve/journal, serve/brownout — docs/ROBUSTNESS.md "Serving
+    # resilience"): all default-off, armed by their flags
+    retry = None
+    if ns.max_retries > 0:
+        from idc_models_tpu.serve import RetryPolicy
+
+        retry = RetryPolicy(max_retries=ns.max_retries,
+                            backoff_s=ns.retry_backoff_ms / 1e3)
+    brownout = None
+    if ns.brownout:
+        from idc_models_tpu.serve import BrownoutController
+
+        queue_high = (ns.brownout_queue_high
+                      or max(ns.max_queue_depth // 2, 2))
+        brownout = BrownoutController(
+            slo=slo, queue_high=queue_high,
+            clamp_tokens=ns.brownout_clamp_tokens,
+            escalate_dwell_s=ns.brownout_dwell_ms / 1e3,
+            clear_after_s=ns.brownout_clear_ms / 1e3, logger=logger)
+    # count the journal's in-flight leftovers BEFORE the server opens
+    # it for appending: these are the requests a previous crashed run
+    # accepted but never finished
+    n_pending = 0
+    if ns.journal and Path(ns.journal).exists():
+        from idc_models_tpu.serve import pending_requests
+
+        n_pending = len(pending_requests(ns.journal))
     server = LMServer(
         params, embed_dim=ns.embed_dim, num_heads=ns.num_heads,
         num_blocks=ns.num_blocks, t_max=ns.t_max, n_slots=ns.slots,
@@ -1067,7 +1162,22 @@ def _serve_body(ns, mesh, params, logger) -> None:
         max_prefills_per_cycle=ns.max_prefills_per_cycle, logger=logger,
         prefill_chunk=ns.prefill_chunk or None,
         prefix_cache_mb=ns.prefix_cache_mb,
-        kv_dtype=("int8" if ns.kv_dtype == "int8" else None), slo=slo)
+        kv_dtype=("int8" if ns.kv_dtype == "int8" else None), slo=slo,
+        retry=retry, fault_plan=ns.serve_fault_plan,
+        journal=ns.journal, brownout=brownout)
+    if n_pending:
+        readmitted = server.resubmit_pending(ns.journal)
+        line = (f"journal: re-admitted {len(readmitted)} in-flight "
+                f"request(s) from a previous run")
+        refused = n_pending - len(readmitted)
+        if refused:
+            # backpressure refusals leave no finish record, so the WAL
+            # still holds them — an honest count beats claiming full
+            # recovery, and a rerun picks up the remainder
+            line += (f"; {refused} refused by backpressure — raise "
+                     f"--max-queue-depth and rerun with the same "
+                     f"--journal to recover them")
+        print(line)
     if ns.trace:
         trace = load_trace(ns.trace)
     else:
@@ -1080,9 +1190,24 @@ def _serve_body(ns, mesh, params, logger) -> None:
     print(f"serving {len(trace)} requests on {ns.slots} slots "
           f"(window {ns.window}, t_max {ns.t_max}, ring "
           f"{ns.seq_parallel})")
+    from idc_models_tpu.serve import InjectedEngineCrash
+
+    crashed = None
     with Timer("Serving trace", logger=logger), \
             profile_trace(ns.profile_dir):
-        results = server.run(trace, realtime=ns.realtime)
+        try:
+            results = server.run(trace, realtime=ns.realtime)
+        except InjectedEngineCrash as e:
+            # the drill's hard death: the failure cleanup already
+            # finalized every in-flight request as an error Result —
+            # salvage them, report honestly, and point at the recovery
+            crashed = e
+            results = server.results()
+    if crashed is not None:
+        hint = (f"; rerun with --journal {ns.journal} to recover the "
+                f"in-flight requests" if ns.journal else
+                "; arm --journal to make this recoverable")
+        print(f"engine crashed mid-run (injected): {crashed}{hint}")
     n_ok = sum(r.status == "ok" for r in results)
     summary = server.summary()
     print(f"served: ok={n_ok} timeout={summary['serve_timed_out']} "
@@ -1106,9 +1231,23 @@ def _serve_body(ns, mesh, params, logger) -> None:
         names = sorted({a["slo"] for a in slo.alerts})
         print(f"slo: {len(slo.alerts)} alert(s)"
               + (f" ({', '.join(names)})" if names else ""))
+    # resilience epilogue: what the armed machinery actually did —
+    # faults fired, quarantines, retries, brownout sheds/clamps
+    if (ns.serve_fault_plan is not None or retry is not None
+            or brownout is not None or summary["serve_slot_faults"]):
+        line = (f"resilience: injected={summary['serve_faults_injected']}"
+                f" slot_faults={summary['serve_slot_faults']}"
+                f" retries={summary['serve_retries']}"
+                f" shed={summary['serve_shed']}"
+                f" clamped={summary['serve_clamped']}")
+        if brownout is not None:
+            line += (f" brownout_max_stage={brownout.max_stage_seen}"
+                     f" (stage {brownout.stage} at exit)")
+        print(line)
     print("serve summary:", json.dumps(summary))
     if logger:
         logger.log(event="serve_summary", **summary)
+    server.close()
     _finish_logger(logger)
 
 

@@ -176,6 +176,40 @@ class FaultPlan:
                 f"faults={list(self.faults)!r})")
 
 
+GRAMMAR = ("comma-separated kind:clients[:param] groups; clients = a "
+           "single id, an inclusive a-b range, or a +-joined list; "
+           "param = scale (optionally x-prefixed) for scale/sign_flip, "
+           "staleness lag for straggler (crash/nan/inf take none)")
+
+
+def format_spec_error(group: str, detail: str, *, kinds=KINDS,
+                      grammar=GRAMMAR) -> str:
+    """One message shape for every fault-spec parse failure, federated
+    AND serving (serve/faults.py): the offending group, what was wrong
+    with it, the full grammar, and the valid kinds — so a mistyped
+    drill flag teaches its own syntax instead of bare-rejecting."""
+    return (f"bad fault group {group!r}: {detail} (grammar: {grammar}; "
+            f"valid kinds: {', '.join(kinds)})")
+
+
+def parse_id_field(field: str, *, what: str, group: str, kinds=KINDS,
+                   grammar=GRAMMAR) -> list[int]:
+    """The shared id-list grammar both spec parsers target with
+    `field`: a single integer, an inclusive ``a-b`` range, or a
+    ``+``-joined list — client ids for the federated plan, tick
+    indices for the serving one (serve/faults.py). One implementation
+    so a parsing fix cannot land in one grammar and miss the other."""
+    try:
+        if "-" in field:
+            a, b = field.split("-", 1)
+            return list(range(int(a), int(b) + 1))
+        return [int(c) for c in field.split("+")]
+    except ValueError:
+        raise ValueError(format_spec_error(
+            group, f"bad {what} field {field!r}", kinds=kinds,
+            grammar=grammar)) from None
+
+
 def parse_fault_spec(spec: str, n_clients: int) -> FaultPlan:
     """CLI fault grammar: comma-separated ``kind:clients[:param]``
     groups, clients as a single id, an inclusive ``a-b`` range, or a
@@ -183,7 +217,9 @@ def parse_fault_spec(spec: str, n_clients: int) -> FaultPlan:
     scale (optionally ``x``-prefixed) for scale/sign_flip, staleness
     lag for straggler — and is rejected for kinds that take none
     (crash/nan/inf), so a mistyped drill fails loudly instead of
-    silently running a different fault model.
+    silently running a different fault model. Every parse error
+    enumerates the valid kinds and shows the grammar
+    (`format_spec_error`).
 
         "sign_flip:0-2:x1000,crash:5"     3 sign-flip attackers + crash
         "scale:1+4:100"                   2 scaling attackers
@@ -196,25 +232,31 @@ def parse_fault_spec(spec: str, n_clients: int) -> FaultPlan:
             continue
         parts = group.split(":")
         if len(parts) not in (2, 3):
-            raise ValueError(
-                f"bad fault group {group!r}: want kind:clients[:param]")
+            raise ValueError(format_spec_error(
+                group, "want kind:clients[:param]"))
         kind, clients = parts[0].strip(), parts[1].strip()
+        if kind not in KINDS:
+            raise ValueError(format_spec_error(
+                group, f"unknown fault kind {kind!r}"))
         kw = {}
         if len(parts) == 3:
             param = parts[2].strip()
-            if kind in ("scale", "sign_flip"):
-                kw["scale"] = float(param.lstrip("x"))
-            elif kind == "straggler":
-                kw["staleness"] = int(param)
-            else:
-                raise ValueError(
-                    f"fault kind {kind!r} takes no parameter, got "
-                    f"{param!r} in group {group!r}")
-        if "-" in clients:
-            a, b = clients.split("-", 1)
-            ids = range(int(a), int(b) + 1)
-        else:
-            ids = [int(c) for c in clients.split("+")]
+            try:
+                if kind in ("scale", "sign_flip"):
+                    kw["scale"] = float(param.lstrip("x"))
+                elif kind == "straggler":
+                    kw["staleness"] = int(param)
+                else:
+                    raise ValueError(format_spec_error(
+                        group, f"fault kind {kind!r} takes no "
+                               f"parameter, got {param!r}"))
+            except ValueError as e:
+                if "bad fault group" in str(e):
+                    raise
+                raise ValueError(format_spec_error(
+                    group, f"bad parameter {param!r} for kind "
+                           f"{kind!r}")) from None
+        ids = parse_id_field(clients, what="clients", group=group)
         faults.extend(Fault(kind, int(c), **kw) for c in ids)
     return FaultPlan(n_clients, faults)
 

@@ -277,9 +277,14 @@ class SLOEngine:
                                     burn_long=round(burn_l, 4))
         return fired
 
-    def breached(self, name: str) -> bool:
+    def breached(self, name: str | None = None) -> bool:
         """Live alert state for `name` — the signal an admission policy
-        consumes (shed/deprioritize while True)."""
+        consumes (shed/deprioritize while True). With ``name=None``,
+        True while ANY declared objective is breached — the brownout
+        controller's default trigger (serve/brownout.py), so one
+        controller can guard a server that declares several SLOs."""
+        if name is None:
+            return any(self._alerting.values())
         if name not in self.slos:
             raise ValueError(f"unknown SLO {name!r} (declared: "
                              f"{sorted(self.slos)})")

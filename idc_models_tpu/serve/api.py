@@ -57,9 +57,14 @@ class Result:
     echoed), truncated at EOS (inclusive) when one is configured.
     `status` is "ok" (ran to EOS/budget), "timeout" (deadline hit —
     possibly with partial tokens), "rejected" (queue full at submit
-    with on_full="reject"), or "error" (the engine failed mid-flight;
-    `error` carries the failure detail and `tokens` whatever was
-    generated before it)."""
+    with on_full="reject"), "shed" (refused by the brownout
+    controller's shed stage — explicit overload, retry elsewhere/later),
+    or "error" (the engine failed mid-flight, or a quarantined slot
+    exhausted its retries — `error` carries the detail and `tokens`
+    whatever clean prefix was generated). `attempts`/`retried` expose
+    the retry policy's work: a request recovered from a poisoned slot
+    finishes with attempts > 1 and its output bit-identical to an
+    unfaulted run (the engine's serial-parity contract)."""
     id: str
     tokens: list
     status: str
@@ -70,6 +75,8 @@ class Result:
     # the id stamped on every span of this request's lifecycle chain in
     # an exported trace (serve.request/queued/first_token + rid attrs)
     trace_id: str | None = None
+    attempts: int = 1
+    retried: bool = False
 
 
 class LMServer:
@@ -90,7 +97,10 @@ class LMServer:
                  warmup: bool = True, clock=time.monotonic,
                  prefill_chunk: int | None = None,
                  prefix_cache_mb: float = 0.0,
-                 kv_dtype: str | None = None, slo=None):
+                 kv_dtype: str | None = None, slo=None,
+                 retry=None, fault_plan=None,
+                 health_checks: bool | None = None, journal=None,
+                 brownout=None, prefix_cache=None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
@@ -100,9 +110,15 @@ class LMServer:
 
         # prefix reuse rides the chunk grid: snapshots are taken at
         # chunk boundaries and extended by the chunk program, so the
-        # knob implies chunked admission
-        prefix_cache = None
-        if prefix_cache_mb and prefix_cache_mb > 0:
+        # knob implies chunked admission. An EXISTING PrefixCache may be
+        # passed instead of a budget — the warm-restart path: a server
+        # rebuilt after an engine crash reuses the dead engine's
+        # snapshots and recovered requests re-prefill only their
+        # uncached suffix (gated by test).
+        if prefix_cache is not None and prefix_cache_mb:
+            raise ValueError("pass prefix_cache OR prefix_cache_mb, "
+                             "not both")
+        if prefix_cache is None and prefix_cache_mb and prefix_cache_mb > 0:
             if prefill_chunk is None:
                 raise ValueError("prefix_cache_mb needs prefill_chunk")
             prefix_cache = PrefixCache(
@@ -122,11 +138,28 @@ class LMServer:
         # evaluate burn rates once per scheduler cycle
         self.metrics = ServingMetrics(logger, prefix_cache=prefix_cache,
                                       slo=slo)
+        # journal: a RequestJournal or a path — the WAL of accepted
+        # work a rebuilt server recovers in-flight requests from
+        # (resubmit_pending / serve/journal.py)
+        if journal is not None and not hasattr(journal, "record_submit"):
+            from idc_models_tpu.serve.journal import RequestJournal
+
+            journal = RequestJournal(journal)
+        self.journal = journal
+        # brownout: a BrownoutController; it degrades the prefix cache
+        # first, so hand it ours unless the caller wired its own
+        if (brownout is not None and brownout.prefix_cache is None
+                and prefix_cache is not None):
+            brownout.prefix_cache = prefix_cache
+        self.brownout = brownout
+        self._fault_plan = fault_plan
         self.scheduler = Scheduler(
             self.engine, window=window, max_queue_depth=max_queue_depth,
             max_prefills_per_cycle=max_prefills_per_cycle,
             admit_after_collect=admit_after_collect,
-            metrics=self.metrics, clock=clock)
+            metrics=self.metrics, clock=clock, retry=retry,
+            fault_plan=fault_plan, health_checks=health_checks,
+            journal=journal, brownout=brownout)
         self._results: dict[str, Result] = {}
         self._inflight: set[str] = set()
         if warmup:
@@ -139,9 +172,14 @@ class LMServer:
         raises ValueError for requests that could never be served."""
         from idc_models_tpu.serve.scheduler import Entry
 
-        if request.id in self._results or request.id in self._inflight:
+        prior = self._results.get(request.id)
+        if ((prior is not None and prior.status != "shed")
+                or request.id in self._inflight):
             # includes QUEUED/RUNNING ids: a duplicate in flight would
-            # silently overwrite the other's Result at finish
+            # silently overwrite the other's Result at finish. A SHED
+            # id is the one exception — the brownout refused it without
+            # serving anything, and its docstring tells the client to
+            # retry later, so the same id may try again
             raise ValueError(f"request id {request.id!r} already used")
         entry = Entry(
             rid=request.id,
@@ -155,10 +193,62 @@ class LMServer:
             trace_id=request.trace_id)
         ok = self.scheduler.submit(entry)
         if not ok:
-            # leave no Result: the caller may retry the same id later
+            if entry.status == "shed":
+                # a brownout shed is a TERMINAL outcome, not transient
+                # backpressure: record the honest Result so poll()
+                # answers for it
+                r = _to_result(entry)
+                self._results[r.id] = r
+                return False
+            # backpressure: leave no Result — the caller may retry the
+            # same id later
             return False
+        # a resubmit after a terminal shed/rejection must not leave the
+        # stale Result answering poll() while the request actually
+        # queues — poll's contract is None until it finishes
+        self._results.pop(request.id, None)
         self._inflight.add(request.id)
         return True
+
+    def close(self) -> None:
+        """Shut the server down: submit() afterwards raises
+        RuntimeError (the scheduler's close contract) and the request
+        journal, if any, is flushed closed. Accepted work can still be
+        drained first."""
+        self.scheduler.close()
+        if self.journal is not None:
+            self.journal.close()
+
+    def resubmit_pending(self, journal_path) -> list[str]:
+        """Crash recovery: re-admit every request `journal_path` shows
+        accepted but unfinished (in original submit order) through the
+        NORMAL admission path — chunked prefill and prefix-cache reuse
+        included — and return the re-admitted ids. Each recovered
+        request keeps its journaled id, seed, deadline, and trace_id,
+        and its greedy/seeded output is bit-identical to what an
+        uncrashed run would have produced (the engine's serial-parity
+        contract; gated by test)."""
+        from idc_models_tpu.serve.journal import pending_requests
+
+        out = []
+        for req in pending_requests(journal_path):
+            if self.submit(req):
+                out.append(req.id)
+        return out
+
+    def _fire_bursts(self) -> None:
+        """Inject the fault plan's burst arrivals scheduled for the
+        NEXT scheduler cycle — synthetic overload waves, submitted
+        through the normal (backpressure/shed-visible) path. Runs once
+        per step(), and the cycle counter strictly increments per tick,
+        so each burst fires exactly once."""
+        cycle = self.scheduler._cycle
+        for f in self._fault_plan.bursts_at(cycle):
+            self.metrics.on_fault_injected("burst", tick=cycle)
+            vocab = self.engine._logits.shape[1]
+            for req in self._fault_plan.burst_requests(
+                    f, vocab=vocab, t_max=self.engine.t_max):
+                self.submit(req)
 
     def step(self) -> list[Result]:
         """One scheduler tick (admissions + one fused decode window);
@@ -168,6 +258,8 @@ class LMServer:
         intact) so poll() answers for them and a recovering caller can
         keep serving."""
         finished = []
+        if self._fault_plan is not None:
+            self._fire_bursts()
         try:
             ticked = self.scheduler.tick()
         except Exception:
@@ -187,6 +279,13 @@ class LMServer:
         """The finished Result for `rid`, or None while it is still
         queued/running."""
         return self._results.get(rid)
+
+    def results(self) -> list[Result]:
+        """Snapshot of every finished Result so far — what a caller
+        salvages when run() is interrupted by an engine crash (the
+        in-flight requests were already finalized as error Results by
+        the failure cleanup)."""
+        return list(self._results.values())
 
     def drain(self) -> list[Result]:
         """Tick until idle; returns everything that finished."""
@@ -221,12 +320,21 @@ class LMServer:
                 # in block mode, don't OFFER a request the queue cannot
                 # take: every refused submit() counts as a rejection in
                 # the metrics, and a head request re-offered for 50
-                # ticks is one blocked request, not 50 rejected ones
-                if (on_full == "block"
+                # ticks is one blocked request, not 50 rejected ones.
+                # While the brownout SHEDS, offer anyway — a shed is a
+                # terminal answer, not a queue race to wait out.
+                shedding = (self.brownout is not None
+                            and self.brownout.shedding)
+                if (on_full == "block" and not shedding
                         and len(self.scheduler.queue)
                         >= self.scheduler.queue.max_depth):
                     break               # blocked: re-offer next tick
                 if self.submit(trace[i][1]):
+                    i += 1
+                    continue
+                shed = self._results.get(trace[i][1].id)
+                if shed is not None and shed.status == "shed":
+                    out.append(shed)
                     i += 1
                 elif on_full == "reject":
                     r = Result(id=trace[i][1].id, tokens=[],
@@ -252,7 +360,7 @@ def _to_result(e) -> Result:
     return Result(
         id=e.rid, tokens=list(e.tokens), status=e.status,
         finish_reason=e.finish_reason, error=e.error,
-        trace_id=e.trace_id,
+        trace_id=e.trace_id, attempts=e.attempts, retried=e.retried,
         ttft_ms=(None if e.t_first is None
                  else (e.t_first - e.t_submit) * 1e3),
         latency_ms=(None if e.t_done is None

@@ -127,6 +127,15 @@ class _EngineFns(NamedTuple):
     window: object    # (params, caches, logits, kd, pos, rem, eos,
     #                    kscales, vscales, W)
     insert: object    # (state..., new_caches, new_logits, slot, ...)
+    health: object    # (logits) -> [S] int32 fault code
+
+
+# a last-token logit past this magnitude is corruption, not a model
+# output: real logits live within a few hundred even on poorly scaled
+# models, and the finite-garbage fault class (bit flips, a blown-up
+# matmul) is exactly what a pure isfinite check is blind to
+_HEALTH_LOGIT_LIMIT = 1e30
+HEALTH_KINDS = {1: "nonfinite_logits", 2: "logit_magnitude"}
 
 
 @functools.lru_cache(maxsize=16)
@@ -296,7 +305,21 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False) -> _EngineFns:
         return q, s
 
     insert = jax.jit(insert_body, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
-    return _EngineFns(init_caches, init_scales, window, insert)
+
+    def health_body(logits):
+        # per-slot fault codes in ONE tiny reduce + fetch ([S] int32):
+        # 1 = non-finite logits, 2 = finite but magnitude-blown, 0 = ok.
+        # Runs once per scheduler cycle when health checks are armed,
+        # on the last-token logits every window reads next — the state
+        # a poisoned slot corrupts first.
+        lf = logits.astype(jnp.float32)
+        nonfinite = jnp.any(~jnp.isfinite(lf), axis=1)
+        huge = jnp.any(jnp.abs(lf) > _HEALTH_LOGIT_LIMIT, axis=1)
+        return jnp.where(nonfinite, 1,
+                         jnp.where(huge, 2, 0)).astype(jnp.int32)
+
+    health = jax.jit(health_body)
+    return _EngineFns(init_caches, init_scales, window, insert, health)
 
 
 class SlotEngine:
@@ -685,6 +708,47 @@ class SlotEngine:
         self.begin_window(n_steps)
         return self.collect()
 
+    # -- resilience hooks -----------------------------------------------
+
+    def slot_health(self) -> np.ndarray:
+        """Per-slot fault codes ([n_slots] int32, see `HEALTH_KINDS`):
+        0 healthy, 1 non-finite last-token logits, 2 finite but
+        magnitude-blown. One tiny jitted reduce + one [S]-int fetch —
+        the scheduler runs it once per cycle when health checks are
+        armed, BEFORE the next window dispatch, so a poisoned slot is
+        quarantined before a single token is sampled from its
+        corrupted logits."""
+        return np.asarray(self._efns.health(self._logits))
+
+    def slot_invariants_ok(self, slot: int) -> bool:
+        """Host-shadow sanity for one slot: position within the cache,
+        budget non-negative. Free (no device traffic) — the scheduler
+        folds it into the same per-cycle health pass."""
+        return bool(0 <= self._pos_h[slot] <= self.t_max
+                    and self._rem_h[slot] >= 0)
+
+    def inject_slot_fault(self, slot: int, kind: str) -> None:
+        """Fault-injection hook (serve/faults.py, default-off): corrupt
+        `slot`'s last-token logits row in place — NaN for
+        ``nan_logits``, huge-but-finite (1e32, past the health bound
+        but inside every float dtype's range) for ``garbage_logits``.
+        The host round-trip is fine here: this runs only when a fault
+        plan fires, never on the clean path."""
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.n_slots})")
+        try:
+            val = {"nan_logits": float("nan"),
+                   "garbage_logits": 1e32}[kind]
+        except KeyError:
+            raise ValueError(
+                f"inject_slot_fault kind must be 'nan_logits' or "
+                f"'garbage_logits', got {kind!r}") from None
+        rep = meshlib.replicated(self._cfg.mesh)
+        logits = np.array(self._logits)      # blocks on any in-flight window
+        logits[slot, :] = val
+        self._logits = meshlib.put_with_sharding(logits, rep)
+
     # -- observability --------------------------------------------------
 
     def cache_sizes(self) -> dict:
@@ -693,7 +757,8 @@ class SlotEngine:
         slot must not grow these (gated by test)."""
         out = {"window": self._efns.window._cache_size(),
                "insert": self._efns.insert._cache_size(),
-               "prefill": self._sfns.prefill._cache_size()}
+               "prefill": self._sfns.prefill._cache_size(),
+               "health": self._efns.health._cache_size()}
         if self.prefill_chunk is not None:
             out["prefill_chunk"] = self._sfns.prefill_chunk._cache_size()
         return out
@@ -737,6 +802,9 @@ class SlotEngine:
                 np.int32(0), np.int32(1), np.int32(0), np.int32(-1),
                 np.zeros(2, np.uint32))
             self.step_window(n_steps)
+        # the health reduce is part of the armed serve loop's steady
+        # state (one dispatch per cycle) — warm it with everything else
+        self.slot_health()
 
     def kv_bytes_per_slot(self) -> int:
         """HBM bytes of ring-cache state per decode slot (K + V rows

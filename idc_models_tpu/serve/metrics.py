@@ -81,11 +81,36 @@ class ServingMetrics:
             "serve_last_tick_monotonic_seconds",
             "time.monotonic() stamp of the last scheduler cycle — "
             "/healthz reports now minus this as last_tick_age_s")
+        # resilience instruments (ISSUE 8): quarantines, retries, shed
+        # submits, brownout clamps, and injected drill faults
+        self._m_slot_faults = reg.counter(
+            "serve_slot_faults_total",
+            "slots quarantined by the per-cycle health checks, by "
+            "fault kind", labels=("kind",))
+        self._m_retries = reg.counter(
+            "serve_retries_total",
+            "quarantined requests re-admitted after backoff")
+        self._m_shed = reg.counter(
+            "serve_shed_total",
+            "submits refused by the brownout controller's shed stage")
+        self._m_clamped = reg.counter(
+            "serve_clamped_total",
+            "admissions whose max_new_tokens the brownout clamp "
+            "shortened")
+        self._m_faults_injected = reg.counter(
+            "serve_faults_injected_total",
+            "declarative serve faults fired by an armed ServeFaultPlan,"
+            " by kind", labels=("kind",))
         self._jit_cache_seen: int | None = None
         self.compiles_observed = 0
         self.submitted = 0
         self.rejected = 0
         self.timed_out = 0
+        self.slot_faults = 0
+        self.retries = 0
+        self.shed = 0
+        self.clamped = 0
+        self.faults_injected = 0
         self.finished = 0
         self.tokens_out = 0
         self.cycles = 0
@@ -164,6 +189,51 @@ class ServingMetrics:
         self._log(event="serve_finish", id=rid, tokens=n_tokens,
                   reason=reason,
                   ttft_ms=None if ttft_s is None else ttft_s * 1e3)
+
+    # -- resilience ------------------------------------------------------
+
+    def on_slot_fault(self, rid, *, kind: str, slot=None) -> None:
+        """A running/prefilling slot was quarantined: `kind` is the
+        detector that fired (nonfinite_logits / logit_magnitude /
+        invariant / prefill_error). New event type only — the frozen
+        serve.jsonl schema is untouched."""
+        self.slot_faults += 1
+        self._m_slot_faults.inc(kind=kind)
+        self._log(event="serve_slot_fault", id=rid, kind=kind,
+                  slot=slot)
+
+    def on_retry(self, rid, *, attempt: int, delay_s: float) -> None:
+        """A quarantined request was scheduled for re-admission
+        `delay_s` seconds out; `attempt` is the total attempt count it
+        re-enters with."""
+        self.retries += 1
+        self._m_retries.inc()
+        self._log(event="serve_retry", id=rid, attempt=attempt,
+                  delay_ms=delay_s * 1e3)
+
+    def on_shed(self, rid) -> None:
+        """A submit was refused by the brownout shed stage. Counted as
+        its own terminal outcome — deliberately NOT fed to the
+        error-rate SLO: shedding is the controller's intended action,
+        and scoring it as an error would make shedding beget more
+        shedding."""
+        self.shed += 1
+        self._m_shed.inc()
+        self._m_requests.inc(status="shed")
+        self._log(event="serve_shed", id=rid)
+
+    def on_clamp(self, rid, *, asked: int, clamp: int) -> None:
+        """The brownout clamp shortened an admission's budget."""
+        self.clamped += 1
+        self._m_clamped.inc()
+        self._log(event="serve_clamp", id=rid, max_new_tokens=clamp,
+                  asked=asked)
+
+    def on_fault_injected(self, kind: str, *, tick: int = 0) -> None:
+        """A declarative drill fault fired (ServeFaultPlan)."""
+        self.faults_injected += 1
+        self._m_faults_injected.inc(kind=kind)
+        self._log(event="serve_fault_injected", kind=kind, tick=tick)
 
     # -- engine cycle ----------------------------------------------------
 
@@ -245,6 +315,14 @@ class ServingMetrics:
             # cache-size growth seen after the first cycle; nonzero
             # means admission traffic compiled something mid-serve
             "serve_compiles_observed": self.compiles_observed,
+            # resilience rollup (additive, ISSUE 8): quarantines by
+            # the health checks, bounded re-admissions, brownout sheds
+            # and clamps, and drill faults fired
+            "serve_slot_faults": self.slot_faults,
+            "serve_retries": self.retries,
+            "serve_shed": self.shed,
+            "serve_clamped": self.clamped,
+            "serve_faults_injected": self.faults_injected,
         }
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.summary())

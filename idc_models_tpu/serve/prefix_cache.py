@@ -102,6 +102,10 @@ class PrefixCache:
             "serve_prefix_cache_bytes", "bytes of stored snapshots")
         self._pack = None             # (caches, n_tokens) -> stored tree
         self._unpack = None           # stored tree -> caller tree
+        # brownout hook: while True, insert() stores nothing (lookups
+        # still serve hits) — snapshot copies + eviction churn are the
+        # first work a degrading server sheds
+        self.writes_paused = False
         self._root = _Node()
         self._clock = 0
         self.nbytes = 0
@@ -189,6 +193,8 @@ class PrefixCache:
                 f"prefix length {toks.size} is not a multiple of the "
                 f"chunk {self.chunk} — snapshots live on chunk "
                 f"boundaries only")
+        if self.writes_paused:
+            return False
         node = self._root
         for edge in self._chunks(toks):
             node = node.children.setdefault(edge, _Node(node, edge))
@@ -248,6 +254,12 @@ class PrefixCache:
                and not node.children and node.parent is not None):
             del node.parent.children[node.edge]
             node = node.parent
+
+    def pause_writes(self, paused: bool) -> None:
+        """Brownout stage-1 side effect (serve/brownout.py): toggle
+        snapshot storage. Reads are never paused — a warm cache keeps
+        serving hits through the brownout."""
+        self.writes_paused = bool(paused)
 
     def clear(self) -> None:
         self._root = _Node()

@@ -23,6 +23,17 @@ about WHICH request runs where or when; this module is the policy:
   route through `SlotEngine.release`; the vacated row is eligible for
   admission on the SAME tick the finish is observed, so slots never
   idle a full cycle between requests.
+- **Resilience (ISSUE 8)** — per-cycle slot health checks quarantine a
+  poisoned slot (non-finite/blown logits, violated invariants) and
+  recover the REQUEST instead of failing the server: with a
+  `RetryPolicy` armed the entry re-queues after an exponential backoff
+  (keeping its original deadline and trace_id; `attempts`/`retried`
+  surface on the Result), otherwise it finishes with an honest
+  ``error``/``slot_fault`` status. A `ServeFaultPlan`
+  (serve/faults.py) drives deterministic failure drills behind a
+  default-off hook; a `RequestJournal` (serve/journal.py) WALs
+  accepted work for crash recovery; a `BrownoutController`
+  (serve/brownout.py) sheds load in stages when the SLO burns.
 """
 
 from __future__ import annotations
@@ -34,6 +45,10 @@ import time
 from collections import deque
 
 from idc_models_tpu.observe import trace
+from idc_models_tpu.serve.engine import HEALTH_KINDS
+from idc_models_tpu.serve.faults import (
+    InjectedEngineCrash, InjectedPrefillError,
+)
 
 # process-unique request trace ids (pid + monotone counter): cheap
 # enough to stamp on EVERY request whether or not a tracer is armed, so
@@ -73,9 +88,48 @@ class Entry:
     t_done: float | None = None
     slot: int | None = None
     tokens: list = dataclasses.field(default_factory=list)
-    status: str = "pending"     # pending|running|ok|timeout|rejected|error
-    finish_reason: str | None = None  # eos|budget|deadline|error|None
+    # pending|running|retrying|ok|timeout|rejected|shed|error
+    status: str = "pending"
+    # eos|budget|deadline|slot_fault|shed|error|None
+    finish_reason: str | None = None
     error: str | None = None         # engine failure detail (status=error)
+    # retry bookkeeping (RetryPolicy): total admission attempts (1 =
+    # never faulted), whether any retry happened, and the absolute
+    # clock time before which a quarantined entry must not re-queue
+    attempts: int = 1
+    retried: bool = False
+    not_before: float = 0.0
+    clamped: bool = False            # brownout shortened the budget
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded re-admission for requests recovered from a quarantined
+    slot or a failed prefill dispatch. A retried request re-enters the
+    queue FRONT after `backoff_s * backoff_factor**k` (k = prior
+    retries), keeps its original deadline and trace_id, and restarts
+    from its prompt — the engine's serial-parity contract then makes
+    the recovered greedy/seeded output bit-identical to an unfaulted
+    run. A retry whose backoff would land past the deadline finishes
+    immediately with the honest timeout/deadline status instead."""
+
+    max_retries: int = 2
+    backoff_s: float = 0.05
+    backoff_factor: float = 2.0
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError(f"need max_retries >= 0, got "
+                             f"{self.max_retries}")
+        if self.backoff_s < 0:
+            raise ValueError(f"need backoff_s >= 0, got "
+                             f"{self.backoff_s}")
+        if self.backoff_factor < 1.0:
+            raise ValueError(f"need backoff_factor >= 1, got "
+                             f"{self.backoff_factor}")
+
+    def delay(self, prior_retries: int) -> float:
+        return self.backoff_s * self.backoff_factor ** prior_retries
 
 
 class AdmissionQueue:
@@ -100,6 +154,14 @@ class AdmissionQueue:
     def pop(self) -> Entry:
         return self._q.popleft()
 
+    def push_front(self, entry: Entry) -> None:
+        """Head-of-line insertion for RETRIED entries only: they were
+        already admitted once (so they do not cheat the backpressure
+        bound — the in-flight population is unchanged) and recovery
+        latency beats FIFO fairness for a request that already waited
+        its backoff."""
+        self._q.appendleft(entry)
+
     def expire(self, now: float) -> list[Entry]:
         """Drop queued entries past their deadline (they never reach a
         slot); returns them for result bookkeeping."""
@@ -118,7 +180,10 @@ class Scheduler:
 
     def __init__(self, engine, *, window: int = 8, max_queue_depth: int = 64,
                  max_prefills_per_cycle: int = 1, metrics=None,
-                 admit_after_collect: bool = True, clock=time.monotonic):
+                 admit_after_collect: bool = True, clock=time.monotonic,
+                 retry=None, fault_plan=None,
+                 health_checks: bool | None = None, journal=None,
+                 brownout=None):
         if window < 1:
             raise ValueError(f"need window >= 1, got {window}")
         self.engine = engine
@@ -126,6 +191,22 @@ class Scheduler:
         self.queue = AdmissionQueue(max_queue_depth)
         self.max_prefills_per_cycle = max(int(max_prefills_per_cycle), 1)
         self.metrics = metrics
+        # resilience wiring (all default-off; see the module docstring):
+        # retry = RetryPolicy, fault_plan = serve/faults.ServeFaultPlan,
+        # journal = serve/journal.RequestJournal, brownout =
+        # serve/brownout.BrownoutController. Health checks default to
+        # armed exactly when quarantine could act on them.
+        self.retry = retry
+        self.fault_plan = fault_plan
+        self.journal = journal
+        self.brownout = brownout
+        if health_checks is None:
+            health_checks = retry is not None or fault_plan is not None
+        self.health_checks = bool(health_checks)
+        self._retrying: list[Entry] = []
+        self._cycle = 0
+        self._closed = False
+        self._prefill_error_pending = 0
         # refill slots the just-collected window freed before the next
         # window dispatches (recycle idles one window, not two) — at the
         # price of those prefills sitting in the device-idle gap instead
@@ -144,11 +225,28 @@ class Scheduler:
 
     # -- admission -------------------------------------------------------
 
+    def close(self) -> None:
+        """Shut the admission surface down: every later `submit()`
+        raises RuntimeError instead of enqueueing into a loop nobody
+        will ever tick again (previously undefined behavior — the
+        request would sit queued forever). Already-accepted work can
+        still be ticked/drained by the caller before discarding the
+        scheduler."""
+        self._closed = True
+
     def submit(self, entry: Entry) -> bool:
-        """Validate + enqueue. Returns False (backpressure) when the
-        queue is at max depth; raises on requests that could NEVER be
-        served (too long for t_max, missing rng for sampling) — those
-        are caller errors, not load."""
+        """Validate + enqueue. Returns False (backpressure, or a
+        brownout shed — distinguishable by `entry.status == "shed"`)
+        when the request is refused; raises on requests that could
+        NEVER be served (too long for t_max, missing rng for sampling)
+        — those are caller errors, not load — and RuntimeError after
+        `close()`."""
+        if self._closed:
+            raise RuntimeError(
+                "Scheduler.submit() after close(): the serving loop "
+                "has shut down and would never tick this request — "
+                "build a new server instead of submitting to a dead "
+                "queue")
         p_len = len(entry.prompt)
         if p_len < 1:
             raise ValueError("empty prompt")
@@ -170,6 +268,21 @@ class Scheduler:
         if entry.eos_id is not None and entry.eos_id < 0:
             entry.eos_id = None
         entry.t_submit = self.clock()
+        # brownout shed beats backpressure: an explicit, honest
+        # refusal (Result.status == "shed") the client can act on,
+        # recorded BEFORE the queue is consulted so shedding actually
+        # relieves the queue instead of racing it
+        if self.brownout is not None and self.brownout.shedding:
+            entry.status, entry.finish_reason = "shed", "shed"
+            entry.t_done = entry.t_submit
+            if entry.trace_id is None:
+                entry.trace_id = _next_trace_id()
+            trace.point("serve.shed", rid=entry.rid,
+                        trace_id=entry.trace_id)
+            if self.metrics:
+                self.metrics.on_shed(entry.rid)
+            return False
+        deadline_rel = entry.deadline
         if entry.deadline is not None:
             entry.deadline = entry.t_submit + entry.deadline
         if not self.queue.push(entry):
@@ -179,6 +292,8 @@ class Scheduler:
             return False
         if entry.trace_id is None:
             entry.trace_id = _next_trace_id()
+        if self.journal is not None:
+            self.journal.record_submit(entry, deadline_s=deadline_rel)
         # the request-lifecycle chain: a detached serve.request span
         # covering submit->finish (it spans many ticks, so it must not
         # enter any thread's open-span stack), with the queued segment
@@ -204,10 +319,20 @@ class Scheduler:
         monolithic dispatch."""
         admitted = 0
         free = self.engine.free_slots()
+        clamp = (self.brownout.token_clamp if self.brownout is not None
+                 else None)
         while (admitted < self.max_prefills_per_cycle and free
                and len(self.queue)):
             e = self.queue.pop()
             slot = free.pop(0)
+            if clamp is not None and e.budget > clamp:
+                # brownout stage 2: shorter answers for everyone beats
+                # no answers for some — recorded per request so the
+                # truncated budget is visible next to the finish
+                if self.metrics:
+                    self.metrics.on_clamp(e.rid, asked=e.budget,
+                                          clamp=clamp)
+                e.budget, e.clamped = clamp, True
             eos = e.eos_id if e.eos_id is not None else -1
             e.slot, e.status, e.t_admit = slot, "running", self.clock()
             # registered BEFORE the engine call: if the engine raises
@@ -235,27 +360,166 @@ class Scheduler:
             admitted += 1
         return admitted
 
-    def _step_prefills(self) -> int:
+    def _step_prefills(self, done) -> int:
         """Advance pending chunked prefills: at most
         max_prefills_per_cycle chunk DISPATCHES per cycle, oldest
         pending prefill first (FIFO completes a long prompt before
         starting to chunk the next — TTFT order follows admission
         order). Entries whose final chunk lands move to _running and
-        decode from the next window. Returns chunk dispatches spent."""
+        decode from the next window. Returns chunk dispatches spent.
+
+        A chunk dispatch that raises is REQUEST-scoped when a retry
+        policy is armed (the dispatch's inputs are that request's own
+        caches): the prefilling entry is quarantined — retried or
+        failed honestly — and every other slot keeps serving. Without
+        a retry policy the historical contract holds: the error
+        propagates and the tick's failure cleanup aborts the batch."""
         steps = 0
         while steps < self.max_prefills_per_cycle and self._prefilling:
             slot = next(iter(self._prefilling))
-            if self.engine.prefill_step(slot):
+            try:
+                if self._prefill_error_pending:
+                    self._prefill_error_pending -= 1
+                    raise InjectedPrefillError(
+                        f"injected prefill-chunk failure (slot {slot})")
+                finished = self.engine.prefill_step(slot)
+            except Exception as exc:
+                if self.retry is None:
+                    raise
+                e = self._prefilling.pop(slot)
+                self.engine.cancel_prefill(slot)
+                self._quarantine(e, "prefill_error", self.clock(), done,
+                                 detail=f"{type(exc).__name__}: {exc}")
+                steps += 1
+                continue
+            if finished:
                 self._running[slot] = self._prefilling.pop(slot)
             steps += 1
         return steps
+
+    def _quarantine(self, e: Entry, kind: str, now: float, done,
+                    *, detail: str | None = None) -> None:
+        """Recover ONE faulted request: re-queue it after the retry
+        backoff when the policy and its deadline allow, else finish it
+        with an honest status. Emits the `serve.slot_fault` (and
+        `serve.retry`) lifecycle points so one rid grep shows
+        fault -> quarantine -> retry -> finish under the request's
+        trace_id."""
+        detail = detail or f"slot fault: {kind}"
+        parent = e.span.span_id if e.span is not None else None
+        trace.point("serve.slot_fault", parent=parent, rid=e.rid,
+                    kind=kind, slot=e.slot, trace_id=e.trace_id)
+        if self.metrics:
+            self.metrics.on_slot_fault(e.rid, kind=kind, slot=e.slot)
+        e.slot = None
+        prior = e.attempts - 1
+        can_retry = (self.retry is not None
+                     and prior < self.retry.max_retries)
+        delay = self.retry.delay(prior) if can_retry else 0.0
+        deadline_blocks = (e.deadline is not None
+                           and now + delay >= e.deadline)
+        if can_retry and not deadline_blocks:
+            # restart from the prompt: the tokens emitted so far came
+            # from (or raced) the poisoned state, and a clean re-run
+            # re-derives the exact stream (serial-parity contract), so
+            # discarding is what makes recovery bit-identical
+            e.attempts += 1
+            e.retried = True
+            e.tokens = []
+            e.t_first = None
+            e.status = "retrying"
+            e.not_before = now + delay
+            self._retrying.append(e)
+            trace.point("serve.retry", parent=parent, rid=e.rid,
+                        attempt=e.attempts,
+                        delay_ms=round(delay * 1e3, 3),
+                        trace_id=e.trace_id)
+            if self.metrics:
+                self.metrics.on_retry(e.rid, attempt=e.attempts,
+                                      delay_s=delay)
+            return
+        if deadline_blocks or (e.deadline is not None
+                               and now >= e.deadline):
+            e.status, e.finish_reason = "timeout", "deadline"
+        else:
+            e.status, e.finish_reason = "error", "slot_fault"
+            e.error = f"{detail} (attempt {e.attempts})"
+        e.t_done = now
+        self._finish(e, done)
 
     # -- the cycle -------------------------------------------------------
 
     def idle(self) -> bool:
         return (not self._running and not self._prefilling
-                and not len(self.queue)
+                and not len(self.queue) and not self._retrying
                 and self.engine._pending is None)
+
+    def _apply_faults(self, cycle: int) -> None:
+        """Fire the plan's non-burst faults scheduled for this cycle —
+        pure function of (plan, cycle), so drills replay exactly.
+        Burst arrivals are injected by the api layer (they are
+        submits, not engine events)."""
+        for f in self.fault_plan.at(cycle):
+            if self.metrics:
+                self.metrics.on_fault_injected(f.kind, tick=cycle)
+            if f.kind == "stall":
+                # a straggling dispatch / GC pause / noisy neighbor:
+                # the tick simply takes longer — the latency fault the
+                # TTFT SLO burn is supposed to catch
+                time.sleep(f.seconds)
+            elif f.kind == "crash":
+                exc = InjectedEngineCrash(
+                    f"injected engine crash at cycle {cycle}")
+                self._abort_running(exc)
+                raise exc
+            elif f.kind in ("nan_logits", "garbage_logits"):
+                self.engine.inject_slot_fault(f.slot, f.kind)
+            elif f.kind == "prefill_error":
+                self._prefill_error_pending += 1
+
+    def _requeue_retries(self, now: float, done) -> None:
+        """Move quarantined entries whose backoff elapsed back to the
+        queue FRONT (oldest first); entries whose deadline died while
+        they waited finish honestly instead of burning a slot."""
+        due, waiting = [], []
+        for e in self._retrying:
+            if e.deadline is not None and now >= e.deadline:
+                e.status, e.finish_reason = "timeout", "deadline"
+                e.t_done = now
+                self._finish(e, done)
+            elif now >= e.not_before:
+                e.status = "pending"
+                due.append(e)
+            else:
+                waiting.append(e)
+        self._retrying = waiting
+        for e in reversed(due):
+            self.queue.push_front(e)
+
+    def _check_slot_health(self, now: float, got, done) -> list:
+        """Per-cycle health pass over the RUNNING slots: one tiny
+        jitted reduce + [S]-int fetch (engine.slot_health) plus the
+        free host-shadow invariants. Runs after collect and BEFORE the
+        next window dispatch, so a slot whose logits a fault poisoned
+        this cycle is quarantined before a single token is sampled
+        from them. Returns `got` with the quarantined entries' just-
+        collected tokens dropped (they were computed from, or raced,
+        the corrupted state)."""
+        codes = self.engine.slot_health()
+        quarantined = set()
+        for slot, e in list(self._running.items()):
+            kind = HEALTH_KINDS.get(int(codes[slot]))
+            if kind is None and not self.engine.slot_invariants_ok(slot):
+                kind = "invariant"
+            if kind is None:
+                continue
+            self.engine.release(slot)
+            del self._running[slot]
+            quarantined.add(id(e))
+            self._quarantine(e, kind, now, done)
+        if not quarantined:
+            return got
+        return [(e, t) for e, t in got if id(e) not in quarantined]
 
     def tick(self) -> list[Entry]:
         """One pipelined cycle. Host work (admission prefills, result
@@ -277,10 +541,22 @@ class Scheduler:
     def _tick(self) -> list[Entry]:
         now = self.clock()
         done: list[Entry] = []
+        # 0. declarative fault drills (default-off): stall/crash/
+        #    poison/prefill-error faults scheduled for this cycle fire
+        #    before any real work, so the cycle index a fault names is
+        #    exactly the cycle it perturbs
+        cycle = self._cycle
+        self._cycle += 1
+        if self.fault_plan is not None:
+            self._apply_faults(cycle)
         # 1. queued requests past deadline never occupy a slot
         for e in self.queue.expire(now):
             e.status, e.finish_reason, e.t_done = "timeout", "deadline", now
             self._finish(e, done)
+        # 1.5 quarantined entries whose backoff elapsed re-queue at the
+        #     head; ones whose deadline died waiting finish honestly
+        if self._retrying:
+            self._requeue_retries(now, done)
         # 2. interleave policy: refill known-free slots and (chunked
         #    engines) advance pending prefills by at most
         #    max_prefills_per_cycle chunk dispatches — all of it
@@ -298,7 +574,7 @@ class Scheduler:
         with trace.span("serve.admit") as _sp:
             try:
                 admitted = self._admit_free_slots()
-                chunk_steps = (self._step_prefills() if self._chunked
+                chunk_steps = (self._step_prefills(done) if self._chunked
                                else 0)
             except Exception as e:
                 self._failed.extend(done)
@@ -338,6 +614,12 @@ class Scheduler:
                 self.engine.release(slot)
                 del self._running[slot]
                 finished.append(e)
+        # 3.5 per-window slot health: quarantine poisoned slots (and
+        #     drop their just-collected tokens) BEFORE the next window
+        #     dispatches — the request recovers, the server keeps
+        #     serving every other slot
+        if self.health_checks and self._running:
+            got = self._check_slot_health(now, got, done)
         # 4. running requests past deadline are cancelled mid-generation
         #    (after collect, so the partial tokens reach the result);
         #    prefilling requests past deadline drop their partial chunks
@@ -415,6 +697,10 @@ class Scheduler:
         #    empty drain ticks are skipped.
         emitted = self._finalize_window(got, finished, cancelled, t_now,
                                         now, done)
+        # brownout runs EVERY cycle (drain ticks included — recovery
+        # hysteresis needs to see the queue empty out)
+        if self.brownout is not None:
+            self.brownout.evaluate(queue_depth=len(self.queue))
         if (self._running or admitted or chunk_steps) and self.metrics:
             self.metrics.on_cycle(queue_depth=len(self.queue),
                                   occupancy=occupancy, tokens=emitted,
@@ -452,6 +738,7 @@ class Scheduler:
         normal deferred pass AND the engine-failure salvage path, so
         the two cannot drift. Returns the emitted-token count."""
         emitted = 0
+        progress = {} if self.journal is not None else None
         for e, toks in got:
             if toks and e.t_first is None:
                 e.t_first = t_now
@@ -465,6 +752,13 @@ class Scheduler:
                     self.metrics.on_first_token(e.rid, t_now - e.t_submit)
             e.tokens.extend(toks)
             emitted += len(toks)
+            if progress is not None and toks:
+                progress[e.rid] = len(e.tokens)
+        if progress:
+            # one batched (and journal-strided) record per cycle — the
+            # per-slot-per-cycle write pattern was the armed clean
+            # path's dominant cost (bench_serving_resilience)
+            self.journal.record_progress(progress)
         for e in finished:
             e.status, e.t_done = "ok", t_now
             e.finish_reason = (
@@ -510,6 +804,9 @@ class Scheduler:
 
     def _finish(self, e: Entry, done: list[Entry]) -> None:
         done.append(e)
+        if self.journal is not None:
+            self.journal.record_finish(e.rid, e.status,
+                                       reason=e.finish_reason)
         # close the lifecycle chain: the queued child first (a no-op if
         # admission already closed it — `expired` only lands on entries
         # that died IN the queue; Span.close applies attrs on the first

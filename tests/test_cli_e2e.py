@@ -537,3 +537,102 @@ def test_cli_lm(tmp_path, capsys):
     with pytest.raises(SystemExit):
         cli.main(["lm", "--host-devices", "8", "--seq-len", "30",
                   "--layout", "zigzag"])
+
+
+def test_cli_serve_faulted_lifecycle_and_journal_recovery(tmp_path,
+                                                          capsys):
+    """ISSUE-8 acceptance from the product surface, two drills:
+
+    1. LIFECYCLE — a traced serve run with an injected nan_logits
+       fault and retries armed: one rid grep of the exported trace
+       reconstructs submit -> fault -> quarantine -> retry -> finish
+       under the request's shared trace_id, the recovered request
+       finishes ok, and the resilience epilogue reports the counts.
+    2. CRASH RECOVERY — an injected mid-run engine crash with
+       --journal armed kills the run honestly (salvaged results +
+       recovery hint); rerunning with the same journal re-admits the
+       in-flight requests and serves them.
+
+    Recovery bit-parity is owned by tests/test_serve_resilience.py;
+    this drives the CLI wiring end to end."""
+    import json
+
+    from idc_models_tpu.serve import Request, save_trace
+
+    model = ["--host-devices", "8", "--slots", "2", "--window", "4",
+             "--t-max", "32", "--vocab", "11", "--embed-dim", "16",
+             "--num-heads", "2", "--mlp-dim", "32", "--num-blocks", "1"]
+    trace = [(0.0, Request(id=f"f{i}", prompt=(1 + i, 2, 3),
+                           max_new_tokens=12))
+             for i in range(3)]
+    tr = save_trace(tmp_path / "trace.jsonl", trace)
+    trace_json = tmp_path / "faulted.json"
+    out = _run(["serve", *model, "--trace", tr,
+                "--serve-faults", "nan_logits:1:0",
+                "--max-retries", "2", "--retry-backoff-ms", "0",
+                "--trace-out", str(trace_json),
+                "--path", str(tmp_path)], capsys)
+    assert "served: ok=3" in out
+    assert "resilience: injected=1 slot_faults=1 retries=1" in out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("serve summary:")][0]
+    summary = json.loads(line.split("serve summary:", 1)[1])
+    assert summary["serve_slot_faults"] == 1
+    assert summary["serve_retries"] == 1
+
+    # ONE rid grep over the exported trace tells the whole story
+    doc = json.loads(trace_json.read_text())
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    fault = next(e for e in spans if e["name"] == "serve.slot_fault")
+    rid = fault["args"]["rid"]
+    assert fault["args"]["kind"] == "nonfinite_logits"
+    mine = [e for e in spans if e["args"].get("rid") == rid]
+    names = {e["name"] for e in mine}
+    assert {"serve.request", "serve.queued", "serve.slot_fault",
+            "serve.retry", "serve.first_token"} <= names, names
+    req = next(e for e in mine if e["name"] == "serve.request")
+    assert req["args"]["status"] == "ok"
+    tids = {e["args"]["trace_id"] for e in mine
+            if "trace_id" in e["args"]}
+    assert tids == {req["args"]["trace_id"]}
+    retry = next(e for e in mine if e["name"] == "serve.retry")
+    assert retry["args"]["attempt"] == 2
+    # the fault/retry markers hang off the request's lifecycle span
+    assert fault["args"]["parent_id"] == req["args"]["span_id"]
+    # ...and the run's jsonl carries the same chain as events
+    events = [json.loads(l) for l in
+              (tmp_path / "logs" / "serve.jsonl").read_text()
+              .splitlines()]
+    chain = [r["event"] for r in events if r.get("id") == rid]
+    for ev in ("serve_submit", "serve_slot_fault", "serve_retry",
+               "serve_finish"):
+        assert ev in chain, (ev, chain)
+    assert chain.index("serve_slot_fault") \
+        < chain.index("serve_retry") < chain.index("serve_finish")
+
+    # -- drill 2: crash + journal recovery ------------------------------
+    wal = tmp_path / "journal.jsonl"
+    trace2 = [(0.0, Request(id=f"j{i}", prompt=(2 + i, 4),
+                            max_new_tokens=16))
+              for i in range(3)]
+    tr2 = save_trace(tmp_path / "trace2.jsonl", trace2)
+    out = _run(["serve", *model, "--trace", tr2,
+                "--serve-faults", "crash:2",
+                "--journal", str(wal)], capsys)
+    assert "engine crashed mid-run (injected)" in out
+    assert f"rerun with --journal {wal}" in out
+    out = _run(["serve", *model, "--trace",
+                save_trace(tmp_path / "empty.jsonl", []),
+                "--journal", str(wal)], capsys)
+    assert "journal: re-admitted 3 in-flight request(s)" in out
+    assert "served: ok=3" in out
+    # a second recovery finds a clean WAL
+    from idc_models_tpu.serve import pending_requests
+
+    assert pending_requests(wal) == []
+    # usage errors die cleanly: bad fault spec (teaching message), bad
+    # retry knobs
+    with pytest.raises(SystemExit):
+        cli.main(["serve", *model, "--serve-faults", "meteor:1"])
+    with pytest.raises(SystemExit):
+        cli.main(["serve", *model, "--max-retries", "-1"])

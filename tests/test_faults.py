@@ -68,6 +68,23 @@ def test_plan_codes_and_spec_parse():
     assert lagged.faults[0].staleness == 2
     with pytest.raises(ValueError, match="takes no parameter"):
         faults.parse_fault_spec("crash:2:x100", 8)
+    # ISSUE-8 satellite: every parse failure enumerates the valid kinds
+    # and shows the grammar (shared with the serve fault specs), so a
+    # mistyped drill flag teaches its own syntax
+    for bad in ("meteor:3", "crash", "crash:2:x100", "scale:1:huge",
+                "crash:one"):
+        with pytest.raises(ValueError) as ei:
+            faults.parse_fault_spec(bad, 8)
+        msg = str(ei.value)
+        assert "grammar" in msg, (bad, msg)
+        for kind in faults.KINDS:
+            assert kind in msg, (bad, kind, msg)
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.parse_fault_spec("meteor:3", 8)
+    with pytest.raises(ValueError, match="bad parameter"):
+        faults.parse_fault_spec("scale:1:huge", 8)
+    with pytest.raises(ValueError, match="bad clients field"):
+        faults.parse_fault_spec("crash:one", 8)
     # seeded sampling is deterministic
     a = faults.FaultPlan.byzantine(10, 3, seed=5)
     b = faults.FaultPlan.byzantine(10, 3, seed=5)

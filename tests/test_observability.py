@@ -308,12 +308,21 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
                    prefill_s=0.01)
         m.on_finish("r0", n_tokens=3, ttft_s=0.05, decode_s=0.1,
                     reason="budget", t=10.3)
+        # ISSUE-8 resilience hooks: NEW event types only — the
+        # historical five keep their exact shapes below
+        m.on_slot_fault("r2", kind="nonfinite_logits", slot=1)
+        m.on_retry("r2", attempt=2, delay_s=0.05)
+        m.on_shed("r3")
+        m.on_clamp("r4", asked=64, clamp=8)
+        m.on_fault_injected("stall", tick=3)
     recs = [json.loads(l) for l in open(log)]
     by_event = {r["event"]: r for r in recs}
     # the historical event set + per-event keys, byte-for-byte names
     assert set(by_event) == {"serve_submit", "serve_reject",
                              "serve_admit", "serve_first_token",
-                             "serve_finish"}
+                             "serve_finish", "serve_slot_fault",
+                             "serve_retry", "serve_shed",
+                             "serve_clamp", "serve_fault_injected"}
     assert set(by_event["serve_submit"]) == {"ts", "event", "id"}
     assert set(by_event["serve_admit"]) == {"ts", "event", "id",
                                             "queue_wait_ms"}
@@ -322,6 +331,16 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
     assert set(by_event["serve_finish"]) == {"ts", "event", "id",
                                              "tokens", "reason",
                                              "ttft_ms"}
+    # the ISSUE-8 events are frozen from day one, same discipline
+    assert set(by_event["serve_slot_fault"]) == {"ts", "event", "id",
+                                                 "kind", "slot"}
+    assert set(by_event["serve_retry"]) == {"ts", "event", "id",
+                                            "attempt", "delay_ms"}
+    assert set(by_event["serve_shed"]) == {"ts", "event", "id"}
+    assert set(by_event["serve_clamp"]) == {"ts", "event", "id",
+                                            "max_new_tokens", "asked"}
+    assert set(by_event["serve_fault_injected"]) == {"ts", "event",
+                                                     "kind", "tick"}
     # the historical summary keys all still present
     s = m.summary()
     for k in ("serve_requests", "serve_rejected", "serve_timed_out",
@@ -333,8 +352,13 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
               "serve_queue_depth_mean", "serve_queue_depth_max",
               "serve_window_tokens_mean",
               "serve_prefill_stall_ms_mean",
-              "serve_prefill_stall_ms_max"):
+              "serve_prefill_stall_ms_max",
+              # the ISSUE-8 additive resilience rollup
+              "serve_slot_faults", "serve_retries", "serve_shed",
+              "serve_clamped", "serve_faults_injected"):
         assert k in s, k
+    assert s["serve_slot_faults"] == 1 and s["serve_retries"] == 1
+    assert s["serve_shed"] == 1 and s["serve_clamped"] == 1
 
 
 def test_fed_driver_round_health_schema_unchanged(tmp_path):

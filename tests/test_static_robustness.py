@@ -314,3 +314,80 @@ def test_allowlist_entries_still_exist():
         walk(tree, [])
     stale = set(ALLOWLIST) - live
     assert not stale, f"allowlist entries no longer match any code: {stale}"
+
+
+# -- serve/ per-slot exception discipline (ISSUE 8 satellite) ---------------
+#
+# The resilience layer's whole contract is that a fault is either
+# RECOVERED (the entry is quarantined/retried/finished honestly) or
+# PROPAGATED (the engine-failure cleanup aborts the batch and the error
+# re-raises). An except block in serve/ that does neither — catches,
+# logs-or-not, and falls through — is a request silently lost, the
+# exact bug class the quarantine machinery exists to kill. This scan
+# walks every handler in serve/ and requires a `raise` or a call to one
+# of the recovery entry points in its body, outside the documented
+# allowlist.
+
+_SERVE_RECOVERY_CALLS = {"_quarantine", "_abort_running"}
+
+# (path relative to serve/, enclosing function) -> why neither raising
+# nor quarantining is correct there
+SERVE_EXCEPT_ALLOWLIST = {
+    ("scheduler.py", "_abort_running"):
+        "the cleanup itself: release() may fail on the already-broken "
+        "engine, but every in-flight slot must still be marked failed "
+        "while the ORIGINAL engine error propagates to the caller",
+}
+
+
+def _handler_recovers(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises (any Raise, including a
+    translated one) or calls a recovery entry point."""
+    for node in ast.walk(ast.Module(body=handler.body,
+                                    type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = (node.func.attr if isinstance(node.func,
+                                                 ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else None)
+            if name in _SERVE_RECOVERY_CALLS:
+                return True
+    return False
+
+
+def _scan_serve_handlers(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(PACKAGE / "serve")).replace("\\", "/")
+    violations, live = [], set()
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ExceptHandler):
+                key = (rel, _enclosing_function(stack))
+                if not _handler_recovers(child):
+                    live.add(key)
+                    if key not in SERVE_EXCEPT_ALLOWLIST:
+                        violations.append((rel, child.lineno, key[1]))
+            walk(child, stack + [child])
+
+    walk(tree, [])
+    return violations, live
+
+
+def test_serve_handlers_quarantine_or_reraise():
+    violations, live = [], set()
+    for f in sorted((PACKAGE / "serve").rglob("*.py")):
+        v, l = _scan_serve_handlers(f)
+        violations.extend(v)
+        live.update(l)
+    assert not violations, (
+        "serve/ except blocks that neither re-raise nor quarantine — a "
+        "caught fault must recover the request or propagate to the "
+        "engine-failure cleanup, never vanish (extend the documented "
+        f"SERVE_EXCEPT_ALLOWLIST only for cleanup-path sites): "
+        f"{violations}")
+    stale = set(SERVE_EXCEPT_ALLOWLIST) - live
+    assert not stale, (
+        f"serve except allowlist entries match no code: {stale}")
