@@ -53,24 +53,18 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from mfu_matrix import _timed  # noqa: E402  (shared honest-timing loop)
 
+from idc_models_tpu.observe.profile import program_report  # noqa: E402
+
 OUT = Path(__file__).resolve().parent / "backbone_mfu.jsonl"
 
-# Nominal peak HBM bandwidth per chip, GB/s, by device_kind substring —
-# the roofline's other axis (public TPU spec sheet numbers).
-_PEAK_HBM_GBPS = {
-    "v2": 700.0, "v3": 900.0, "v4": 1228.0,
-    "v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0,
-    "v6 lite": 1640.0, "v6e": 1640.0,
-}
-
-
 def _peak_gbps(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    best = None
-    for key, val in _PEAK_HBM_GBPS.items():
-        if key in kind and (best is None or len(key) > best[0]):
-            best = (len(key), val)
-    return best[1] if best else None
+    """Nominal peak HBM GB/s per chip — the per-backend roofline
+    registry (observe/profile.py BACKEND_ROOFS, seeded from the table
+    that used to live here) is the one source of truth."""
+    from idc_models_tpu.observe.profile import roofline_for
+
+    spec = roofline_for(device)
+    return spec.peak_hbm_gbps if spec else None
 
 
 # ---------------------------------------------------------------------------
@@ -162,9 +156,9 @@ def measure_train(preset: str, *, batch=1024, fwd_only=False,
         def fence():
             return float(digest(box["s"]))
 
-    ca = compiled.cost_analysis()
-    flops_per_step = float(ca.get("flops", 0.0)) if ca else 0.0
-    bytes_per_step = float(ca.get("bytes accessed", 0.0)) if ca else 0.0
+    rep = program_report(compiled, name=f"{preset}.train_step")
+    flops_per_step = rep.flops or 0.0
+    bytes_per_step = rep.bytes_accessed or 0.0
     steps, dt, dts = _timed(dispatch, fence)
     step_s = dt / steps
     return {
@@ -262,9 +256,9 @@ def measure_group(preset: str, group: str, *, batch=1024):
         return jnp.sum(apply(params, state, x).astype(jnp.float32))
 
     compiled = fwd.lower(variables.params, variables.state, x).compile()
-    ca = compiled.cost_analysis()
-    flops_per_step = float(ca.get("flops", 0.0)) if ca else 0.0
-    bytes_per_step = float(ca.get("bytes accessed", 0.0)) if ca else 0.0
+    rep = program_report(compiled, name=f"{preset}.{group}_fwd")
+    flops_per_step = rep.flops or 0.0
+    bytes_per_step = rep.bytes_accessed or 0.0
     box = {}
 
     def dispatch(n):

@@ -35,6 +35,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from mfu_matrix import _timed  # noqa: E402
 
+from idc_models_tpu.observe.profile import program_report  # noqa: E402
+
 OUT = Path(__file__).resolve().parent / "dense_smallconv.jsonl"
 
 
@@ -123,8 +125,8 @@ def measure_stage(group: str, *, transform: bool, batch=1024):
         return jnp.sum(apply(params, state, x).astype(jnp.float32))
 
     compiled = fwd.lower(variables.params, variables.state, x).compile()
-    ca = compiled.cost_analysis()
-    flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    flops = program_report(compiled,
+                           name="dense_smallconv.fwd").flops or 0.0
     box = {}
 
     def dispatch(n):

@@ -45,6 +45,8 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from idc_models_tpu.observe.profile import program_report  # noqa: E402
+
 OUT = Path(__file__).resolve().parent / "mfu_matrix.jsonl"
 
 
@@ -230,8 +232,8 @@ def measure_train(*, batch=2048, in_channels=3, image_size=50,
             def fence():
                 return float(digest(box["s"]))
 
-    ca = compiled.cost_analysis()
-    flops_per_step = float(ca.get("flops", 0.0)) if ca else 0.0
+    flops_per_step = program_report(compiled,
+                                    name="mfu_matrix.step").flops or 0.0
     steps, dt, dts = _timed(dispatch, fence)
     return {
         "patches_per_sec_per_chip": steps * total / dt / n_dev,
@@ -280,8 +282,8 @@ def measure_block_fwd(block: int, *, batch=2048):
         return jnp.sum(y.astype(jnp.float32))
 
     compiled = fwd.lower(variables.params, x).compile()
-    ca = compiled.cost_analysis()
-    flops_per_step = float(ca.get("flops", 0.0)) if ca else 0.0
+    flops_per_step = program_report(
+        compiled, name=f"mfu_matrix.block{block}_fwd").flops or 0.0
     box = {}
 
     def dispatch(n):
@@ -336,8 +338,8 @@ def measure_cached(*, batch):
     state = replicate(mesh, state)
     x, y = shard_batch(mesh, feats, labels)
     compiled = step.lower(state, x, y, jax.random.key(1)).compile()
-    ca = compiled.cost_analysis()
-    flops_per_step = float(ca.get("flops", 0.0)) if ca else 0.0
+    flops_per_step = program_report(compiled,
+                                    name="mfu_matrix.cached").flops or 0.0
     digest = jax.jit(lambda s: jnp.sum(
         s.params["head"]["kernel"].astype(jnp.float32)))
     box = {"s": state, "k": jax.random.key(1)}

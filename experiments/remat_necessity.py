@@ -23,9 +23,11 @@ import sys
 import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
-import jax
+import jax  # noqa: E402
 import jax.numpy as jnp
 import numpy as np
+
+from idc_models_tpu.observe.profile import program_report  # noqa: E402
 
 OUT = pathlib.Path(__file__).parent / "remat_necessity.jsonl"
 
@@ -69,12 +71,14 @@ def try_step(seq_len: int, num_blocks: int, remat: bool):
         state = replicate(mesh, state)
         key = jax.random.key(1)
         compiled = step.lower(state, x, y, key).compile()
-        try:
-            ma = compiled.memory_analysis()
-            mem = {"temp_gb": round(ma.temp_size_in_bytes / 2**30, 2),
-                   "args_gb": round(ma.argument_size_in_bytes / 2**30, 2)}
-        except Exception:  # noqa: BLE001 — not all backends expose it
-            mem = {}
+        # one extraction point for XLA memory accounting (ISSUE 9):
+        # program_report degrades to None fields on backends that do
+        # not expose memory_analysis
+        rep = program_report(compiled, name="remat.step")
+        mem = ({"temp_gb": round(rep.temp_bytes / 2**30, 2),
+                "args_gb": round(rep.argument_bytes / 2**30, 2)}
+               if rep.temp_bytes is not None
+               and rep.argument_bytes is not None else {})
         digest = jax.jit(lambda s: jnp.sum(
             s.params["head"]["kernel"].astype(jnp.float32)))
         state, _ = compiled(state, x, y, key)      # warm

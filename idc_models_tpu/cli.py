@@ -36,6 +36,7 @@ def main(argv: list[str] | None = None) -> int:
               "fed": _run_fed, "secure_fed": _run_secure,
               "attention": _run_attention, "lm": _run_lm,
               "serve": _run_serve, "stats": _run_stats,
+              "profile": _run_profile,
               "convert_weights": _run_convert}[ns.preset_key]
     # --trace-out: ONE wiring point arms the runtime tracer for every
     # verb — the instrumented spans (serve scheduler cycles, federated
@@ -399,6 +400,59 @@ def _parse(argv):
                     help="the SLO engine's SHORT evaluation window in "
                          "seconds (the long window is 5x this)")
 
+    sp = sub.add_parser(
+        "profile",
+        help="performance attribution over a subsystem's hot loop "
+             "(observe/profile.py): run N steps, report every compiled "
+             "program's XLA cost/memory account, a compute-bound vs "
+             "bandwidth-bound roofline verdict, device-wait vs "
+             "host-gap step-time attribution, and the compile-churn "
+             "watchdog's findings; writes frozen-schema "
+             "profile_program/profile_step jsonl (rendered by `stats`)")
+    sp.add_argument("--model", required=True,
+                    choices=("vgg", "mobile", "dense", "small", "serve"),
+                    help="which hot loop to profile: a backbone's "
+                         "fine-tune train step (vgg/mobile/dense, the "
+                         "bench.py configurations; `small` is the tiny "
+                         "CPU-smoke CNN) or the continuous-batching "
+                         "serve decode loop")
+    sp.add_argument("--steps", type=int, default=None,
+                    help="measured steps/windows (default: 30 on an "
+                         "accelerator, 4 on CPU)")
+    sp.add_argument("--batch-size", type=int, default=None,
+                    help="per-chip batch for the train loops (default: "
+                         "the bench.py batch on an accelerator, 8 on "
+                         "CPU — match bench to compare MFU)")
+    sp.add_argument("--path", default=None,
+                    help="artifact root (profile events stream to "
+                         "<path>/logs/profile.jsonl)")
+    sp.add_argument("--out", default=None,
+                    help="explicit profile jsonl path (overrides "
+                         "--path's default location)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--host-devices", type=int, default=0,
+                    help="force N virtual CPU devices (TPU stand-in)")
+    sp.add_argument("--compile-limit", type=int, default=5,
+                    help="compile-churn watchdog: flag any program "
+                         "compiled more than this many times during "
+                         "the run")
+    sp.add_argument("--peak-tflops", type=float, default=None,
+                    help="override/declare the backend's peak dense "
+                         "bf16 TFLOP/s (required with --peak-gbps for "
+                         "roofline verdicts on backends the table "
+                         "does not know, e.g. CPU)")
+    sp.add_argument("--peak-gbps", type=float, default=None,
+                    help="override/declare the backend's peak memory "
+                         "bandwidth in GB/s")
+    sp.add_argument("--churn-drill", action="store_true",
+                    help="end the run with a deliberately "
+                         "shape-varying jitted loop so the "
+                         "compile-churn watchdog demonstrably fires "
+                         "(drill; a clean run stays silent)")
+    sp.add_argument("--trace-out", default=None,
+                    help="also export the run's spans as Chrome "
+                         "trace-event JSON (Perfetto-loadable)")
+
     sp = sub.add_parser("stats",
                         help="offline summary of any run jsonl (train, "
                              "fed, or serve): per-event counts, "
@@ -416,6 +470,10 @@ def _parse(argv):
                          "event and rid-stamped span for that id, "
                          "time-ordered) instead of the whole-run "
                          "summary")
+    sp.add_argument("--top", type=int, default=15,
+                    help="rows in the span self-time (exclusive-time) "
+                         "table — the flame-style 'where does the "
+                         "time go' answer from any span export")
 
     sp = sub.add_parser("convert-weights", aliases=["convert_weights"],
                         help="one-time offline conversion of a Keras "
@@ -577,7 +635,296 @@ def _run_stats(ns):
     elif ns.json:
         print(json.dumps(summary))
     else:
-        print(format_summary(summary))
+        if ns.top < 1:
+            sys.exit(f"stats: --top {ns.top} must be >= 1")
+        print(format_summary(summary, top=ns.top))
+
+
+def _run_profile(ns):
+    """Performance attribution over one subsystem's hot loop (ISSUE 9,
+    observe/profile.py): program cost/memory accounting through the
+    single `program_report` extraction point, a roofline verdict
+    (compute-bound vs bandwidth-bound with achieved-fraction-of-roof
+    numbers), device-wait vs host-gap step-time attribution from
+    `device.sync`-bracketed spans, and the compile-churn watchdog's
+    process-wide findings — printed human-readable and written as
+    frozen-schema `profile_program`/`profile_step` jsonl events."""
+    import json  # noqa: F401  (parity with sibling runners)
+
+    import jax
+
+    from idc_models_tpu.observe import JsonlLogger, REGISTRY, trace
+    from idc_models_tpu.observe import profile as prof
+
+    if ns.steps is not None and ns.steps < 1:
+        sys.exit(f"profile: --steps {ns.steps} must be >= 1")
+    if ns.batch_size is not None and ns.batch_size < 1:
+        sys.exit(f"profile: --batch-size {ns.batch_size} must be >= 1")
+    if ns.compile_limit < 1:
+        sys.exit(f"profile: --compile-limit {ns.compile_limit} must "
+                 f"be >= 1")
+    if (ns.peak_tflops is None) != (ns.peak_gbps is None):
+        sys.exit("profile: --peak-tflops and --peak-gbps declare the "
+                 "two axes of one roofline — pass both or neither")
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    if ns.peak_tflops is not None:
+        try:
+            prof.register_roof(dev.device_kind, ns.peak_tflops,
+                               ns.peak_gbps)
+        except ValueError as e:
+            sys.exit(f"profile: {e}")
+    wd = prof.arm_watchdog(limit=ns.compile_limit)
+    # the main() --trace-out context may already have armed a tracer
+    # (then the full run, warmups included, lands in the export); the
+    # timeline below only consumes the measured region either way
+    own = trace.get_tracer() is None
+    prev = trace.set_tracer(trace.Tracer()) if own else None
+    tr = trace.get_tracer()
+    try:
+        if ns.model == "serve":
+            progs, mark = _profile_serve(ns, on_accel)
+        else:
+            progs, mark = _profile_train_step(ns, on_accel, dev)
+        if ns.churn_drill:
+            _profile_churn_drill(ns.compile_limit)
+        records = prof.records_since(tr, mark)
+    finally:
+        prof.disarm_watchdog()
+        if own:
+            trace.set_tracer(prev)
+
+    timeline = prof.DeviceTimeline().consume(records)
+    step_stats = timeline.report()
+    print("programs (performance attribution):")
+    recs = []
+    for name, (cost, roofline, step_ms) in progs.items():
+        rec = prof.program_record(cost, roofline, step_ms=step_ms,
+                                  device_kind=dev.device_kind)
+        recs.append(rec)
+        print(prof.format_program(rec))
+    print("step-time attribution (device-wait vs host-gap):")
+    print(timeline.format_report(step_stats))
+    rep = wd.report()
+    line = (f"compiles: {rep['total_compiles']} observed, "
+            f"{rep['compile_seconds_total']} s total")
+    if rep["flagged"]:
+        line += (f"; CHURN flagged: {', '.join(rep['flagged'])} "
+                 f"(> {rep['limit']} compiles each — a shape/dtype is "
+                 f"varying per call)")
+    else:
+        line += "; churn: none"
+    print(line)
+
+    out_path = ns.out or (Path(ns.path) / "logs" / "profile.jsonl"
+                          if ns.path else None)
+    if out_path:
+        with JsonlLogger(out_path) as logger:
+            for rec in recs:
+                logger.log(event="profile_program", **rec)
+            for loop, st in step_stats.items():
+                logger.log(event="profile_step",
+                           **prof.step_record(loop, st))
+            REGISTRY.log_snapshot(logger)
+        print(f"profile events written to {out_path}")
+
+
+def _profile_train_step(ns, on_accel, dev):
+    """Profile one backbone's fine-tune train step at the bench.py
+    configuration (smoke scale on CPU). Two measured passes: a
+    bench-methodology throughput window (k dispatches, ONE data-
+    dependent fence — per-step fencing would wreck the MFU number on
+    a tunneled runtime) for the roofline verdict, then a FENCED pass
+    (one `device.sync` fetch per `profile.step`) for the device-wait
+    vs host-gap split."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models import registry, small_cnn
+    from idc_models_tpu.observe import profile as prof
+    from idc_models_tpu.observe import trace
+    from idc_models_tpu.train import (
+        TrainState, jit_data_parallel, make_train_step, replicate,
+        rmsprop, shard_batch,
+    )
+    from idc_models_tpu.train.losses import (
+        binary_cross_entropy, sparse_categorical_cross_entropy,
+    )
+
+    from idc_models_tpu.configs import BENCH_TRAIN_CONFIGS
+
+    if ns.model == "small":
+        cfg = dict(model=None, image=10, outputs=1, ft=None,
+                   lr=1e-3, batch=64)
+    else:
+        # the SAME table bench.py times against — the acceptance bar
+        # is MFU agreement with bench's independently computed figure
+        # (within 5%), so the two surfaces must share one config
+        name = {"vgg": "vgg16", "mobile": "mobilenet_v2",
+                "dense": "densenet201"}[ns.model]
+        bc = BENCH_TRAIN_CONFIGS[name]
+        cfg = dict(model=name, image=bc["image_size"],
+                   outputs=bc["num_outputs"], ft=bc["fine_tune_at"],
+                   lr=bc["lr"], batch=bc["batch_per_chip"])
+    n_dev = len(jax.devices())
+    batch = ns.batch_size or (cfg["batch"] if on_accel else 8)
+    steps = ns.steps or (30 if on_accel else 4)
+    total = batch * n_dev
+    if cfg["model"] is None:
+        model = small_cnn(cfg["image"], 3, cfg["outputs"])
+        variables = model.init(jax.random.key(ns.seed))
+        opt = rmsprop(cfg["lr"])
+    else:
+        spec = registry.get_model(cfg["model"])
+        # BN-freeze only exists on the BN backbones (VGG has none)
+        build_kw = ({"bn_frozen_below": cfg["ft"]}
+                    if ns.model in ("mobile", "dense") else {})
+        model = spec.build(cfg["outputs"], 3, **build_kw)
+        variables = model.init(jax.random.key(ns.seed))
+        opt = rmsprop(cfg["lr"],
+                      trainable_mask=spec.fine_tune_mask(
+                          variables.params, cfg["ft"]))
+    loss_fn = (binary_cross_entropy if cfg["outputs"] == 1
+               else sparse_categorical_cross_entropy)
+    mesh = meshlib.data_mesh()
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    step = jit_data_parallel(
+        make_train_step(model, opt, loss_fn,
+                        compute_dtype=jnp.bfloat16), mesh)
+    rng = np.random.default_rng(ns.seed)
+    s = cfg["image"]
+    imgs = rng.random((total, s, s, 3)).astype(np.float32)
+    labels = rng.integers(0, max(cfg["outputs"], 2),
+                          total).astype(np.int32)
+    state = replicate(mesh, state)
+    x, y = shard_batch(mesh, imgs, labels)
+    with prof.compiling("train.step"):
+        compiled = step.lower(state, x, y,
+                              jax.random.key(ns.seed + 1)).compile()
+    cost = prof.register_program("train.step", compiled)
+    digest = jax.jit(
+        lambda st: jnp.sum(jax.tree.leaves(
+            st.params)[0].astype(jnp.float32)))
+    box = {"s": state, "k": jax.random.key(ns.seed + 1)}
+
+    def one_step():
+        box["k"], sub = jax.random.split(box["k"])
+        box["s"], _ = compiled(box["s"], x, y, sub)
+
+    def fence():
+        return float(digest(box["s"]))
+
+    one_step()
+    one_step()
+    fence()                                  # warm + fence
+    mark = prof.trace_mark(trace.get_tracer())
+    t0 = time.perf_counter()                 # throughput window
+    for _ in range(steps):
+        one_step()
+    fence()
+    step_s = (time.perf_counter() - t0) / steps
+    for _ in range(steps):                   # fenced attribution pass
+        with trace.span("profile.step"):
+            one_step()
+            with trace.span("device.sync"):
+                fence()
+    roofline = prof.roofline_verdict(cost, step_s, dev, n_dev=n_dev)
+    pps = total / step_s / n_dev
+    print(f"profile: train.step ({cfg['model'] or 'small_cnn'}, batch "
+          f"{batch}/chip x {n_dev} device(s), {steps} steps)")
+    print(f"  throughput {pps:.1f} patches/sec/chip, "
+          f"{step_s * 1e3:.2f} ms/step")
+    return {"train.step": (cost, roofline, step_s * 1e3)}, mark
+
+
+def _profile_serve(ns, on_accel):
+    """Profile the continuous-batching decode loop: slots saturated
+    with long-budget requests, steady-state windows timed through the
+    scheduler (collect's token fetch is the `device.sync` fence), the
+    engine's compiled programs accounted via AOT accounting copies."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import attention_lm
+    from idc_models_tpu.observe import profile as prof
+    from idc_models_tpu.observe import trace
+    from idc_models_tpu.serve import LMServer, Request
+
+    if on_accel:
+        vocab, e, heads, blocks, mlp = 1024, 512, 8, 2, 2048
+        t_max, n_slots, window = 2048, 8, 64
+    else:
+        vocab, e, heads, blocks, mlp = 32, 32, 2, 2, 64
+        t_max, n_slots, window = 128, 4, 8
+    dev = jax.devices()[0]
+    mesh = meshlib.seq_mesh(1)
+    model = attention_lm(vocab, t_max, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks, mesh=mesh)
+    params = model.init(jax.random.key(ns.seed)).params
+    # the server's warmup compiles ~20 DISTINCT programs once each —
+    # they stay in the unnamed bucket, which the churn detector
+    # exempts for exactly this reason (one bucket of one-shot
+    # compiles is not one program recompiling)
+    server = LMServer(params, embed_dim=e, num_heads=heads,
+                      num_blocks=blocks, t_max=t_max, n_slots=n_slots,
+                      window=window, mesh=mesh,
+                      cache_dtype=jnp.bfloat16)
+    budget = t_max - 8
+    for i in range(n_slots):
+        server.submit(Request(id=f"p{i}", prompt=(1, 2, 3, 4),
+                              max_new_tokens=budget))
+    server.step()                            # admissions + first window
+    server.step()                            # steady state
+    costs = server.engine.program_costs(window)
+    steps = ns.steps or max(budget // window - 4, 2)
+    mark = prof.trace_mark(trace.get_tracer())
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(steps):
+        if server.scheduler.idle():
+            break
+        server.step()
+        n += 1
+    window_s = (time.perf_counter() - t0) / max(n, 1)
+    server.close()
+    wcost = costs["serve.window"]
+    roofline = prof.roofline_verdict(wcost, window_s, dev)
+    progs = {"serve.window": (wcost, roofline, window_s * 1e3)}
+    for name, c in costs.items():
+        if name != "serve.window":
+            progs[name] = (c, {}, None)
+    print(f"profile: serve decode loop ({n_slots} slots x {window} "
+          f"tokens/window, {n} measured windows)")
+    print(f"  {window_s * 1e3:.2f} ms/window, "
+          f"{n_slots * window / window_s:.1f} tokens/sec at full "
+          f"occupancy")
+    return progs, mark
+
+
+def _profile_churn_drill(limit: int) -> None:
+    """The injected recompile loop: a jitted reduction called with a
+    DIFFERENT shape every iteration, so the watchdog's churn detector
+    demonstrably fires (`churn.drill` exceeds the limit) while a clean
+    warm run stays silent."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu.observe import profile as prof
+
+    f = jax.jit(lambda t: jnp.sum(t * 2.0))
+    with prof.compiling("churn.drill"):
+        for n in range(limit + 2):
+            float(f(jnp.zeros((n + 1,), jnp.float32)))
 
 
 def _run_convert(ns):

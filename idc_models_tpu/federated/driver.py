@@ -50,6 +50,7 @@ import numpy as np
 
 from idc_models_tpu.federated.fedavg import ServerState, copy_tree
 from idc_models_tpu.observe import metrics_registry as mreg
+from idc_models_tpu.observe import profile as prof
 from idc_models_tpu.observe import trace
 
 
@@ -178,6 +179,15 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
         # a fully-trained restore is a no-op run, not an error (the
         # resume path hits this when --rounds already completed)
         return DriverResult(server=server, history=[], events=[])
+    if prof.accounting_enabled():
+        # opt-in program accounting (observe/profile.py): register the
+        # round program's cost/memory report under "fed.round" before
+        # the loop (lowering neither executes nor donates, so `good`
+        # is safe to pass); best-effort — a host-side wrapper round_fn
+        # warns and skips
+        kw = {"round_idx": start} if takes_round_idx else {}
+        prof.register_jit("fed.round", round_fn, good, images, labels,
+                          weights, jax.random.key(seed), **kw)
 
     def health(record):
         events.append(record)
@@ -221,9 +231,13 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
                                              rng, **kw)
                     # ONE blocking fetch: materializes the round's
                     # metrics AND fences the wall-clock window (the
-                    # dispatch alone returns before the device finishes)
-                    tm_host = {k: float(v)
-                               for k, v in jax.device_get(tm).items()}
+                    # dispatch alone returns before the device
+                    # finishes) — bracketed as device.sync so a
+                    # DeviceTimeline splits fed.round into device-wait
+                    # vs host gap
+                    with trace.span("device.sync"):
+                        tm_host = {k: float(v)
+                                   for k, v in jax.device_get(tm).items()}
                     params_ok = bool(finite_fn(candidate.params)) and bool(
                         finite_fn(candidate.model_state))
                     if not params_ok or not np.isfinite(

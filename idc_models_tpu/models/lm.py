@@ -723,6 +723,32 @@ class Generator:
                 "prefill_chunk": self._fns.prefill_chunk._cache_size(),
                 "decode_loop": self._fns.decode_loop._cache_size()}
 
+    def program_costs(self, *, batch: int = 1, steps: int = 8) -> dict:
+        """Cost/memory accounts of the serial serving programs
+        (observe/profile.py ProgramCost): the full-bucket ring prefill
+        and the fused `steps`-token decode scan. Lowers ACCOUNTING
+        copies (suppressed from the compile watchdog — lowering
+        neither executes nor donates) and registers them in the
+        process PROGRAMS table under ``lm.prefill`` / ``lm.decode``."""
+        from idc_models_tpu.observe import profile as prof
+
+        vocab = self._params["embed"].shape[0]
+        with prof.compiling(None):
+            toks = np.zeros((batch, self.t_max), np.int32)
+            prefill = prof.register_program(
+                "lm.prefill",
+                self._fns.prefill.lower(self._params, toks,
+                                        np.int32(self.t_max)).compile())
+            caches = self._fns.init_caches(batch)
+            logits = jnp.zeros((batch, vocab), jnp.float32)
+            offsets = jnp.arange(0, steps, dtype=jnp.int32)
+            decode = prof.register_program(
+                "lm.decode",
+                self._fns.decode_loop.lower(
+                    self._params, caches, logits, jax.random.key(0),
+                    offsets).compile())
+        return {"lm.prefill": prefill, "lm.decode": decode}
+
 
 def generate(params, prompt, steps: int, *, embed_dim: int,
              num_heads: int, num_blocks: int, t_max: int,

@@ -1,5 +1,11 @@
 from idc_models_tpu.observe import trace  # noqa: F401
+from idc_models_tpu.observe import profile  # noqa: F401
 from idc_models_tpu.observe.exporter import MetricsExporter  # noqa: F401
+from idc_models_tpu.observe.profile import (  # noqa: F401
+    CompileWatchdog, DeviceTimeline, ProgramCost, RooflineSpec,
+    arm_watchdog, disarm_watchdog, program_report, register_program,
+    register_roof, roofline_for, roofline_verdict,
+)
 from idc_models_tpu.observe.logging import JsonlLogger  # noqa: F401
 from idc_models_tpu.observe.metrics_registry import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,

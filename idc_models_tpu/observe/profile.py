@@ -1,0 +1,796 @@
+"""Performance attribution: program accounting, step-time attribution,
+roofline verdicts, and a compile-churn watchdog (ISSUE 9).
+
+The framework could MEASURE (PR 5 tracer/metrics) but not EXPLAIN: why
+is MobileNet at MFU 0.14 while VGG hits 0.62 (BENCH_r05)? Is a step
+compute-bound or bandwidth-bound, is the chip idling on host gaps, is
+something recompiling every call? This module turns the substrate into
+answers, in four pieces:
+
+1. **Program accounting** — `program_report(compiled)` is THE one
+   extraction point over XLA's `compiled.cost_analysis()` +
+   `memory_analysis()` (a static scan in test_static_robustness.py
+   bans calls anywhere else). It normalizes the backend quirks (list-
+   vs-dict cost returns, missing analyses) into a stable `ProgramCost`
+   record and degrades loudly-but-gracefully: a backend returning
+   nothing yields `available=False` + a `warnings.warn`, never a
+   crash. `register_program(name, compiled)` files the report in the
+   process-wide `PROGRAMS` table and surfaces `program_flops{program}`
+   / `program_bytes_accessed{program}` gauges, so train steps,
+   `_ServeFns` programs, and federated rounds all report through one
+   schema.
+
+2. **Step-time attribution** — the instrumented loops wrap their
+   blocking device fetches in a `device.sync` span (the PR 5 tracer's
+   stream carries it for free; disabled cost is one global read).
+   `DeviceTimeline` consumes a span stream and splits each loop span
+   (`profile.step`, `train.step`/`train.epoch`, `serve.tick`,
+   `fed.round`) into device-wait vs host-gap time: on a synchronously
+   fenced loop the host's blocked-on-device time is the device-busy
+   floor and everything else is bubble. Surfaced as the
+   `device_busy_fraction{loop}` gauge and a per-loop report whose two
+   fractions sum to 1 by construction. (With the serve scheduler's
+   two-deep pipelining the device overlaps host bookkeeping, so there
+   the device fraction is a lower bound — documented, not hidden.)
+
+3. **Roofline verdicts** — `BACKEND_ROOFS` maps device_kind
+   substrings to (peak bf16 TFLOP/s, peak HBM GB/s), seeded from the
+   tables bench.py and experiments/backbone_mfu.py measured against
+   (both now delegate here). `roofline_verdict(cost, step_seconds)`
+   combines (1) + a measured step time into compute-bound vs
+   bandwidth-bound with achieved-fraction-of-roof numbers. Unknown
+   backends (CPU) verdict "unknown" unless `register_roof` (CLI:
+   `profile --peak-tflops/--peak-gbps`) supplies the roof.
+
+4. **Compile-churn watchdog** — `arm_watchdog()` registers ONE
+   process-wide `jax.monitoring` duration listener for XLA's
+   `backend_compile_duration` event, so every compile in the process
+   is recorded: `compiles_total{program}` / `compile_seconds_total`
+   metrics plus a `compile` trace marker. Program names come from the
+   `compiling(name)` thread-local context at the framework's compile
+   choke points, falling back to the innermost open trace span, else
+   `"<unnamed>"`; `compiling(None)` suppresses recording (accounting
+   copies must not look like churn). A program compiled more than
+   `limit` times flags once — the recompile-loop failure mode (a
+   shape/dtype varying per call) that the serve jit-cache gates only
+   catch for serve.
+
+The `profile` CLI verb (cli.py) drives all four over any subsystem's
+hot loop and writes frozen-schema `profile_program`/`profile_step`
+jsonl events; `bench_profile_overhead` (bench.py) holds the armed
+cost under the house <2%-of-a-decode-window bar.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import warnings
+
+from idc_models_tpu.observe import metrics_registry as mreg
+from idc_models_tpu.observe import trace
+
+# ---------------------------------------------------------------------------
+# 1. program accounting
+# ---------------------------------------------------------------------------
+
+_COST_FIELDS = ("flops", "bytes_accessed")
+_MEM_FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+               "alias_bytes", "generated_code_bytes")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramCost:
+    """One compiled program's post-DCE cost/memory account. Every
+    numeric field is `None` when the backend did not report it —
+    consumers branch on `available` / `missing` instead of guessing."""
+
+    program: str
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    arithmetic_intensity: float | None = None   # flops / bytes_accessed
+    argument_bytes: float | None = None
+    output_bytes: float | None = None
+    temp_bytes: float | None = None
+    alias_bytes: float | None = None
+    generated_code_bytes: float | None = None
+    peak_hbm_bytes: float | None = None  # args + outputs + temps − aliased
+    available: bool = True
+    missing: tuple = ()
+
+
+_warned_programs: set[str] = set()
+_warn_lock = threading.Lock()
+
+
+def _positive(d, key) -> float | None:
+    try:
+        v = float(d.get(key, 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
+def program_report(compiled, *, name: str = "<program>") -> ProgramCost:
+    """THE extraction point over ``compiled.cost_analysis()`` +
+    ``compiled.memory_analysis()`` (jax AOT `Compiled` objects; the
+    static scan bans direct calls elsewhere).
+
+    Normalizes the version quirks — cost_analysis returning a dict, a
+    list of dicts, or None; memory_analysis raising or absent on some
+    backends — into one `ProgramCost`. A backend returning nothing is
+    a DEGRADED record (`available=False`, fields None), reported once
+    per program via `warnings.warn` so the gap is loud without killing
+    the run that only wanted wall-clock numbers.
+    """
+    flops = bytes_accessed = None
+    missing = []
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as e:  # noqa: BLE001 — degraded record carries the gap
+        ca = None
+        warnings.warn(f"cost_analysis() raised for {name!r}: {e}",
+                      RuntimeWarning, stacklevel=2)
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        flops = _positive(ca, "flops")
+        bytes_accessed = _positive(ca, "bytes accessed")
+    if flops is None:
+        missing.append("flops")
+    if bytes_accessed is None:
+        missing.append("bytes_accessed")
+
+    mem = dict.fromkeys(_MEM_FIELDS)
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — not every backend exposes it
+        ma = None
+    if ma is not None:
+        for field, attr in (("argument_bytes", "argument_size_in_bytes"),
+                            ("output_bytes", "output_size_in_bytes"),
+                            ("temp_bytes", "temp_size_in_bytes"),
+                            ("alias_bytes", "alias_size_in_bytes"),
+                            ("generated_code_bytes",
+                             "generated_code_size_in_bytes")):
+            v = getattr(ma, attr, None)
+            mem[field] = float(v) if v is not None else None
+    else:
+        missing.extend(_MEM_FIELDS)
+
+    peak = None
+    if mem["argument_bytes"] is not None:
+        # resident-footprint estimate: arguments + outputs + XLA temps,
+        # minus buffers aliased input->output (donation) which exist
+        # once, floored at 0 (alias can exceed outputs on full-donation
+        # programs)
+        peak = max(0.0, (mem["argument_bytes"]
+                         + (mem["output_bytes"] or 0.0)
+                         + (mem["temp_bytes"] or 0.0)
+                         - (mem["alias_bytes"] or 0.0)))
+    intensity = (flops / bytes_accessed
+                 if flops and bytes_accessed else None)
+    available = (flops is not None or bytes_accessed is not None
+                 or mem["argument_bytes"] is not None)
+    if not available:
+        with _warn_lock:
+            fresh = name not in _warned_programs
+            _warned_programs.add(name)
+        if fresh:
+            warnings.warn(
+                f"backend returned no cost OR memory analysis for "
+                f"program {name!r} — ProgramCost degrades to "
+                f"available=False (roofline verdicts for it will read "
+                f"'unknown')", RuntimeWarning, stacklevel=2)
+    return ProgramCost(
+        program=name, flops=flops, bytes_accessed=bytes_accessed,
+        arithmetic_intensity=intensity,
+        argument_bytes=mem["argument_bytes"],
+        output_bytes=mem["output_bytes"], temp_bytes=mem["temp_bytes"],
+        alias_bytes=mem["alias_bytes"],
+        generated_code_bytes=mem["generated_code_bytes"],
+        peak_hbm_bytes=peak, available=available,
+        missing=tuple(missing))
+
+
+# the process-wide named-program table (train.step, serve.window,
+# lm.prefill, fed.round, ... — whatever registered this process)
+PROGRAMS: dict[str, ProgramCost] = {}
+_programs_lock = threading.Lock()
+
+
+def register_program(name: str, compiled, *,
+                     registry: mreg.MetricsRegistry | None = None
+                     ) -> ProgramCost:
+    """`program_report` + file the result under `name` in `PROGRAMS`
+    and the metrics registry (`program_flops{program}` etc.), so every
+    subsystem's programs report through one table."""
+    cost = program_report(compiled, name=name)
+    with _programs_lock:
+        PROGRAMS[name] = cost
+    reg = registry if registry is not None else mreg.REGISTRY
+    for metric, help_txt, value in (
+            ("program_flops", "post-DCE FLOPs per execution of a "
+             "registered program", cost.flops),
+            ("program_bytes_accessed", "XLA bytes-accessed estimate "
+             "per execution of a registered program",
+             cost.bytes_accessed),
+            ("program_peak_hbm_bytes", "resident-footprint estimate "
+             "(args + outputs + temps - aliased) of a registered "
+             "program", cost.peak_hbm_bytes)):
+        if value is not None:
+            reg.gauge(metric, help_txt, labels=("program",)).set(
+                value, program=name)
+    wd = _WATCHDOG
+    if wd is not None and cost.flops is not None:
+        wd.note_flops(name, cost.flops)
+    return cost
+
+
+def register_jit(name: str, fn, *args, **kw) -> ProgramCost | None:
+    """Best-effort accounting registration of a (jitted or traceable)
+    function at the given example arguments: lowers + compiles an
+    ACCOUNTING COPY (suppressed from the compile watchdog — it is not
+    churn) and registers its report. Returns None, with a warning,
+    when the function cannot be lowered (host-side wrappers); callers
+    on hot paths gate this behind `accounting_enabled()`."""
+    try:
+        target = fn
+        if not hasattr(target, "lower"):
+            import jax
+
+            target = jax.jit(fn)
+        with compiling(None):
+            compiled = target.lower(*args, **kw).compile()
+    except Exception as e:  # noqa: BLE001 — accounting is best-effort
+        warnings.warn(f"program accounting for {name!r} failed "
+                      f"({type(e).__name__}: {e}); skipping",
+                      RuntimeWarning, stacklevel=2)
+        return None
+    return register_program(name, compiled)
+
+
+def registered_programs() -> dict[str, ProgramCost]:
+    with _programs_lock:
+        return dict(PROGRAMS)
+
+
+# opt-in switch for the always-on loops (fit, run_rounds): program
+# accounting costs one extra compile per loop, so it only runs when a
+# profile driver armed it
+_ACCOUNTING = False
+
+
+def enable_accounting(on: bool = True) -> None:
+    global _ACCOUNTING
+    _ACCOUNTING = bool(on)
+
+
+def accounting_enabled() -> bool:
+    return _ACCOUNTING
+
+
+# ---------------------------------------------------------------------------
+# 2. step-time attribution
+# ---------------------------------------------------------------------------
+
+# the loop spans a timeline splits (nearest-ancestor match, so a
+# device.sync under serve.collect under serve.tick attributes to the
+# tick) and the device-wait span the instrumented fetch sites emit
+LOOP_SPANS = ("profile.step", "train.step", "train.epoch", "serve.tick",
+              "fed.round")
+DEVICE_SPAN = "device.sync"
+
+
+class DeviceTimeline:
+    """Aggregates a span stream into per-loop device-wait vs host-gap
+    time. Feed it `Tracer.records()` (or span-jsonl dicts); `report()`
+    returns per-loop totals and fractions and stamps the
+    `device_busy_fraction{loop}` gauge."""
+
+    def __init__(self, *, loops=LOOP_SPANS, device_span: str = DEVICE_SPAN,
+                 registry: mreg.MetricsRegistry | None = None):
+        self.loops = tuple(loops)
+        self.device_span = device_span
+        self._registry = registry
+        self._wall: dict[str, float] = {}
+        self._count: dict[str, int] = {}
+        self._device: dict[str, float] = {}
+
+    def consume(self, records) -> "DeviceTimeline":
+        spans = [r for r in records
+                 if r.get("event", "span") == "span"
+                 and isinstance(r.get("dur_ms"), (int, float))]
+        # span ids are unique within ONE tracer but restart per
+        # process, and append-mode run logs can hold several runs — a
+        # repeated id starts a new SEGMENT, and parent links never
+        # cross segments (joining by raw id across the whole input
+        # would walk one run's device.sync into another run's spans)
+        segments: list[list[dict]] = []
+        seen: set = set()
+        for r in spans:
+            rid = r.get("id")
+            if not segments or (rid is not None and rid in seen):
+                segments.append([])
+                seen = set()
+            if rid is not None:
+                seen.add(rid)
+            segments[-1].append(r)
+        for seg in segments:
+            self._consume_segment(seg)
+        return self
+
+    def _consume_segment(self, spans: list) -> None:
+        by_id = {r["id"]: r for r in spans if r.get("id") is not None}
+        loop_set = set(self.loops)
+        for r in spans:
+            if r.get("name") in loop_set:
+                name = r["name"]
+                self._wall[name] = self._wall.get(name, 0.0) + r["dur_ms"]
+                self._count[name] = self._count.get(name, 0) + 1
+        for r in spans:
+            if r.get("name") != self.device_span:
+                continue
+            # nearest loop ancestor (bounded walk guards a cyclic file)
+            parent, hops = r.get("parent"), 0
+            while parent is not None and hops < 64:
+                anc = by_id.get(parent)
+                if anc is None:
+                    break
+                if anc.get("name") in loop_set:
+                    nm = anc["name"]
+                    self._device[nm] = (self._device.get(nm, 0.0)
+                                        + r["dur_ms"])
+                    break
+                parent, hops = anc.get("parent"), hops + 1
+
+    def report(self) -> dict:
+        """{loop: {steps, wall_ms, device_ms, host_gap_ms,
+        device_busy_fraction, host_gap_fraction, step_ms_mean}} —
+        fractions sum to 1 by construction (device clamped to wall)."""
+        out = {}
+        reg = (self._registry if self._registry is not None
+               else mreg.REGISTRY)
+        gauge = reg.gauge(
+            "device_busy_fraction",
+            "fraction of a loop span's wall the host spent blocked on "
+            "device results (device-busy floor; the rest is host gap)",
+            labels=("loop",))
+        for name, wall in sorted(self._wall.items()):
+            dev = min(self._device.get(name, 0.0), wall)
+            n = self._count[name]
+            frac = dev / wall if wall > 0 else 0.0
+            out[name] = {
+                "steps": n,
+                "wall_ms": round(wall, 3),
+                "device_ms": round(dev, 3),
+                "host_gap_ms": round(wall - dev, 3),
+                "device_busy_fraction": round(frac, 4),
+                "host_gap_fraction": round(1.0 - frac, 4),
+                "step_ms_mean": round(wall / n, 4) if n else None,
+            }
+            gauge.set(frac, loop=name)
+        return out
+
+    def format_report(self, report: dict | None = None) -> str:
+        """Human lines for a `report()` dict — pass one in when the
+        caller already computed it (report() re-stamps the gauges)."""
+        lines = []
+        if report is None:
+            report = self.report()
+        for name, st in report.items():
+            lines.append(
+                f"  {name:14s} {st['steps']:>5d} steps  mean "
+                f"{st['step_ms_mean']:.3f} ms — device "
+                f"{st['device_busy_fraction']:.1%} / host-gap "
+                f"{st['host_gap_fraction']:.1%} "
+                f"({st['host_gap_ms']:.1f} ms bubble)")
+        return "\n".join(lines) if lines else "  (no loop spans seen)"
+
+
+def trace_mark(tracer) -> float:
+    """Monotonic offset (ms) into `tracer`'s epoch right now — pair
+    with `records_since` so a timeline covers only a measured region
+    (build/warmup spans would otherwise read as one huge host gap)."""
+    if tracer is None:
+        return 0.0
+    return (tracer._clock() - tracer.mono_t0) * 1e3
+
+
+def records_since(tracer, mark_ms: float) -> list[dict]:
+    """The tracer's span records that STARTED at or after `mark_ms`."""
+    if tracer is None:
+        return []
+    return [r for r in tracer.records() if r["t_ms"] >= mark_ms]
+
+
+# ---------------------------------------------------------------------------
+# 3. roofline registry + verdicts
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RooflineSpec:
+    """One backend's nominal roof: dense bf16 TFLOP/s and HBM GB/s per
+    chip (public spec-sheet numbers)."""
+
+    key: str
+    peak_tflops: float
+    peak_hbm_gbps: float
+
+    @property
+    def ridge_intensity(self) -> float:
+        """flops/byte where the compute and bandwidth roofs cross —
+        programs below it are bandwidth-bound at best."""
+        return self.peak_tflops * 1e12 / (self.peak_hbm_gbps * 1e9)
+
+
+# device_kind substring -> roof; longest matching key wins. Seeded from
+# the tables bench.py (_PEAK_BF16_TFLOPS) and
+# experiments/backbone_mfu.py (_PEAK_HBM_GBPS) measured against — both
+# now read THIS table.
+BACKEND_ROOFS: dict[str, RooflineSpec] = {
+    k: RooflineSpec(k, tf, bw) for k, tf, bw in (
+        ("v2", 46.0, 700.0),
+        ("v3", 123.0, 900.0),
+        ("v4", 275.0, 1228.0),
+        ("v5 lite", 197.0, 819.0),
+        ("v5e", 197.0, 819.0),
+        ("v5p", 459.0, 2765.0),
+        ("v6 lite", 918.0, 1640.0),
+        ("v6e", 918.0, 1640.0),
+    )
+}
+
+
+def register_roof(key: str, peak_tflops: float,
+                  peak_hbm_gbps: float) -> RooflineSpec:
+    """Add/override a backend roof (e.g. the CLI's --peak-tflops /
+    --peak-gbps escape hatch for kinds the table does not know)."""
+    if peak_tflops <= 0 or peak_hbm_gbps <= 0:
+        raise ValueError(f"roof peaks must be > 0, got "
+                         f"({peak_tflops}, {peak_hbm_gbps})")
+    spec = RooflineSpec(key.lower(), float(peak_tflops),
+                        float(peak_hbm_gbps))
+    BACKEND_ROOFS[spec.key] = spec
+    return spec
+
+
+def roofline_for(device) -> RooflineSpec | None:
+    """The roof for a jax device (or device_kind string): longest
+    substring match over `BACKEND_ROOFS`, None when unknown."""
+    kind = getattr(device, "device_kind", device)
+    kind = str(kind).lower()
+    best = None
+    for key, spec in BACKEND_ROOFS.items():
+        if key in kind and (best is None or len(key) > len(best.key)):
+            best = spec
+    return best
+
+
+def roofline_verdict(cost: ProgramCost, step_seconds: float | None,
+                     device=None, *, spec: RooflineSpec | None = None,
+                     n_dev: int = 1) -> dict:
+    """Combine a program's cost account with its measured per-step wall
+    into a roofline verdict. `cost_analysis` FLOPs/bytes cover the
+    whole (multi-device) program, so `n_dev` divides them back to
+    per-chip before comparing against the per-chip roofs.
+
+    Returns {verdict, achieved_tflops, achieved_hbm_gbps, mfu,
+    hbm_utilization, bound_fraction, ridge_intensity, peak_tflops,
+    peak_hbm_gbps} with None where inputs were unavailable; verdict is
+    "compute-bound" / "bandwidth-bound" / "unknown"."""
+    spec = spec if spec is not None else roofline_for(device)
+    achieved_tf = achieved_bw = None
+    if step_seconds and step_seconds > 0:
+        if cost.flops:
+            achieved_tf = cost.flops / n_dev / step_seconds / 1e12
+        if cost.bytes_accessed:
+            achieved_bw = cost.bytes_accessed / n_dev / step_seconds / 1e9
+    out = {
+        "verdict": "unknown",
+        "achieved_tflops": (round(achieved_tf, 4)
+                            if achieved_tf is not None else None),
+        "achieved_hbm_gbps": (round(achieved_bw, 3)
+                              if achieved_bw is not None else None),
+        "mfu": None, "hbm_utilization": None, "bound_fraction": None,
+        "ridge_intensity": None, "peak_tflops": None,
+        "peak_hbm_gbps": None,
+    }
+    if spec is None:
+        return out
+    out["peak_tflops"] = spec.peak_tflops
+    out["peak_hbm_gbps"] = spec.peak_hbm_gbps
+    out["ridge_intensity"] = round(spec.ridge_intensity, 2)
+    if achieved_tf is not None:
+        out["mfu"] = round(achieved_tf / spec.peak_tflops, 4)
+    if achieved_bw is not None:
+        out["hbm_utilization"] = round(achieved_bw / spec.peak_hbm_gbps,
+                                       4)
+    if cost.arithmetic_intensity is not None:
+        compute_bound = (cost.arithmetic_intensity
+                         >= spec.ridge_intensity)
+        out["verdict"] = ("compute-bound" if compute_bound
+                          else "bandwidth-bound")
+        out["bound_fraction"] = (out["mfu"] if compute_bound
+                                 else out["hbm_utilization"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 4. compile-churn watchdog
+# ---------------------------------------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_SUPPRESS = object()          # compiling(None): accounting, not churn
+UNNAMED = "<unnamed>"
+_tls = threading.local()
+
+
+class _NullCtx:
+    """Shared no-op context — `naming_compiles` when no watchdog is
+    armed costs one module-global read, same discipline as the
+    disabled tracer span."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+_NULL_CTX = _NullCtx()
+
+
+class _CompileName:
+    """Reentrant thread-local program-name context for compile events
+    (the jax.monitoring listener carries no identity of its own)."""
+
+    __slots__ = ("name", "_prev")
+
+    def __init__(self, name):
+        self.name = _SUPPRESS if name is None else name
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "program", None)
+        _tls.program = self.name
+        return self
+
+    def __exit__(self, *exc):
+        _tls.program = self._prev
+        return None
+
+
+def compiling(name: str | None) -> _CompileName:
+    """Name every compile observed inside the block (`None` suppresses
+    recording — accounting copies must not read as churn)."""
+    return _CompileName(name)
+
+
+def naming_compiles(name: str):
+    """Hot-path form of `compiling`: the shared no-op handle unless a
+    watchdog is armed (the serve scheduler wraps its admission section
+    with this every tick)."""
+    return _CompileName(name) if _WATCHDOG is not None else _NULL_CTX
+
+
+class CompileWatchdog:
+    """Records every observed compile (program name, seconds, flops
+    when a registration supplied them) and flags CHURN: any program
+    compiled more than `limit` times — the recompile-loop failure mode
+    where a shape/dtype varies per call and every "cached" dispatch
+    silently recompiles."""
+
+    def __init__(self, *, limit: int = 5,
+                 registry: mreg.MetricsRegistry | None = None):
+        if limit < 1:
+            raise ValueError(f"churn limit must be >= 1, got {limit}")
+        self.limit = int(limit)
+        self._lock = threading.Lock()
+        self.programs: dict[str, dict] = {}
+        self.flagged: list[str] = []
+        reg = registry if registry is not None else mreg.REGISTRY
+        self._m_compiles = reg.counter(
+            "compiles_total", "XLA backend compiles observed "
+            "process-wide while the watchdog is armed",
+            labels=("program",))
+        self._m_seconds = reg.counter(
+            "compile_seconds_total", "wall seconds spent in observed "
+            "XLA backend compiles")
+        self._m_churn = reg.counter(
+            "compile_churn_flagged_total", "programs flagged for "
+            "compile churn (compiled more than the configured limit)",
+            labels=("program",))
+
+    def on_compile(self, program: str, seconds: float = 0.0) -> None:
+        with self._lock:
+            st = self.programs.setdefault(
+                program, {"count": 0, "seconds": 0.0, "flops": None})
+            st["count"] += 1
+            st["seconds"] += seconds
+            # churn only fires for NAMED programs: the unnamed bucket
+            # aggregates unrelated one-shot compiles (model inits,
+            # data placement, digests) whose combined count says
+            # nothing about any one program recompiling — flagging it
+            # would false-positive on every cold start
+            fire = (program != UNNAMED
+                    and st["count"] > self.limit
+                    and program not in self.flagged)
+            if fire:
+                self.flagged.append(program)
+            count = st["count"]
+        self._m_compiles.inc(program=program)
+        self._m_seconds.inc(max(seconds, 0.0))
+        trace.point("compile", program=program,
+                    seconds=round(seconds, 6))
+        if fire:
+            self._m_churn.inc(program=program)
+            warnings.warn(
+                f"compile churn: program {program!r} compiled {count} "
+                f"times (> limit {self.limit}) — some shape/dtype is "
+                f"varying per call, so every dispatch pays a fresh XLA "
+                f"compile instead of the cache (bucket the shape, pin "
+                f"the dtype, or raise the limit if this growth is "
+                f"expected)", RuntimeWarning, stacklevel=3)
+
+    def note_flops(self, program: str, flops: float) -> None:
+        with self._lock:
+            st = self.programs.setdefault(
+                program, {"count": 0, "seconds": 0.0, "flops": None})
+            st["flops"] = flops
+
+    def report(self) -> dict:
+        with self._lock:
+            programs = {k: dict(v) for k, v in self.programs.items()}
+            flagged = list(self.flagged)
+        return {
+            "limit": self.limit,
+            "total_compiles": sum(v["count"] for v in programs.values()),
+            "compile_seconds_total": round(
+                sum(v["seconds"] for v in programs.values()), 4),
+            "programs": programs,
+            "flagged": flagged,
+        }
+
+
+_WATCHDOG: CompileWatchdog | None = None
+_listener_registered = False
+_arm_lock = threading.Lock()
+
+
+def _compile_listener(event, duration, **kw) -> None:
+    wd = _WATCHDOG
+    if wd is None or event != _COMPILE_EVENT:
+        return
+    name = getattr(_tls, "program", None)
+    if name is _SUPPRESS:
+        return
+    if name is None:
+        tr = trace.get_tracer()
+        if tr is not None:
+            stack = tr._stack()
+            if stack:
+                name = stack[-1].name
+    wd.on_compile(name or UNNAMED, seconds=float(duration))
+
+
+def arm_watchdog(*, limit: int = 5,
+                 registry: mreg.MetricsRegistry | None = None
+                 ) -> CompileWatchdog:
+    """Install a process-wide `CompileWatchdog`. The jax.monitoring
+    listener is registered exactly once per process (the API has no
+    unregister); when no watchdog is armed it is a two-comparison
+    no-op. Returns the armed watchdog; `disarm_watchdog()` ends the
+    observation window."""
+    global _WATCHDOG, _listener_registered
+    wd = CompileWatchdog(limit=limit, registry=registry)
+    with _arm_lock:
+        if not _listener_registered:
+            try:
+                import jax.monitoring
+
+                jax.monitoring.register_event_duration_secs_listener(
+                    _compile_listener)
+                _listener_registered = True
+            except (ImportError, AttributeError) as e:
+                warnings.warn(
+                    f"jax.monitoring unavailable ({e}); the compile "
+                    f"watchdog will only see compiles reported "
+                    f"explicitly via on_compile()", RuntimeWarning,
+                    stacklevel=2)
+        _WATCHDOG = wd
+    return wd
+
+
+def disarm_watchdog() -> None:
+    global _WATCHDOG
+    _WATCHDOG = None
+
+
+def watchdog() -> CompileWatchdog | None:
+    return _WATCHDOG
+
+
+# ---------------------------------------------------------------------------
+# frozen jsonl record shapes (profile_program / profile_step)
+# ---------------------------------------------------------------------------
+
+def program_record(cost: ProgramCost, roofline: dict | None = None,
+                   step_ms: float | None = None,
+                   device_kind: str | None = None) -> dict:
+    """The `profile_program` jsonl payload (minus ts/event, which the
+    JsonlLogger owns) — ONE construction site so the frozen schema in
+    tests/test_observability.py is enforced everywhere."""
+    rl = roofline or {}
+    return {
+        "program": cost.program,
+        "flops": cost.flops,
+        "bytes_accessed": cost.bytes_accessed,
+        "arithmetic_intensity": (round(cost.arithmetic_intensity, 4)
+                                 if cost.arithmetic_intensity is not None
+                                 else None),
+        "argument_bytes": cost.argument_bytes,
+        "output_bytes": cost.output_bytes,
+        "temp_bytes": cost.temp_bytes,
+        "peak_hbm_bytes": cost.peak_hbm_bytes,
+        "generated_code_bytes": cost.generated_code_bytes,
+        "available": cost.available,
+        "step_ms": round(step_ms, 4) if step_ms is not None else None,
+        "verdict": rl.get("verdict", "unknown"),
+        "achieved_tflops": rl.get("achieved_tflops"),
+        "achieved_hbm_gbps": rl.get("achieved_hbm_gbps"),
+        "mfu": rl.get("mfu"),
+        "hbm_utilization": rl.get("hbm_utilization"),
+        "bound_fraction": rl.get("bound_fraction"),
+        "ridge_intensity": rl.get("ridge_intensity"),
+        "peak_tflops": rl.get("peak_tflops"),
+        "peak_hbm_gbps": rl.get("peak_hbm_gbps"),
+        "device_kind": device_kind,
+    }
+
+
+def step_record(loop: str, stats: dict) -> dict:
+    """The `profile_step` jsonl payload from one `DeviceTimeline`
+    report row — same one-construction-site discipline."""
+    return {
+        "loop": loop,
+        "steps": stats["steps"],
+        "wall_ms": stats["wall_ms"],
+        "device_ms": stats["device_ms"],
+        "host_gap_ms": stats["host_gap_ms"],
+        "device_busy_fraction": stats["device_busy_fraction"],
+        "host_gap_fraction": stats["host_gap_fraction"],
+        "step_ms_mean": stats["step_ms_mean"],
+    }
+
+
+def format_program(rec: dict) -> str:
+    """One human line per profile_program record (CLI + stats share
+    it)."""
+    bits = [f"  {rec['program']:14s}"]
+    if rec.get("flops"):
+        bits.append(f"{rec['flops'] / 1e9:8.2f} GFLOP")
+    if rec.get("bytes_accessed"):
+        bits.append(f"{rec['bytes_accessed'] / 1e9:7.3f} GB moved")
+    if rec.get("arithmetic_intensity") is not None:
+        bits.append(f"intensity {rec['arithmetic_intensity']:.1f}")
+    if rec.get("peak_hbm_bytes"):
+        bits.append(f"peak {rec['peak_hbm_bytes'] / 2**30:.2f} GiB")
+    if not rec.get("available", True):
+        bits.append("(backend reported no analysis)")
+    v = rec.get("verdict", "unknown")
+    if v != "unknown":
+        frac = rec.get("bound_fraction")
+        roof = ("peak FLOP/s" if v == "compute-bound"
+                else "peak HBM bytes/s")
+        at = f" at {frac:.2f} of {roof}" if frac is not None else ""
+        extra = ""
+        if rec.get("mfu") is not None:
+            extra = (f" (mfu {rec['mfu']:.3f}, hbm "
+                     f"{rec.get('hbm_utilization')})")
+        bits.append(f"-> {v}{at}{extra}")
+    elif rec.get("step_ms") is not None:
+        bits.append("-> unknown roof (pass --peak-tflops/--peak-gbps "
+                    "or register_roof)")
+    return " ".join(bits)

@@ -52,6 +52,8 @@ def summarize_jsonl(path) -> dict:
     by_event: dict[str, dict] = {}
     timers: dict[str, list[float]] = {}
     spans: dict[str, list[float]] = {}
+    programs: list[dict] = []
+    profile_steps: list[dict] = []
     last_snapshot = None
     ts = [r["ts"] for r in records
           if isinstance(r.get("ts"), (int, float))]
@@ -75,6 +77,12 @@ def summarize_jsonl(path) -> dict:
                 float(r["dur_ms"]))
         if event == "metrics_snapshot":
             last_snapshot = r.get("metrics")
+        if event == "profile_program":
+            programs.append({k: v for k, v in r.items()
+                             if k not in ("ts", "event")})
+        if event == "profile_step":
+            profile_steps.append({k: v for k, v in r.items()
+                                  if k not in ("ts", "event")})
     events = {
         ev: {"count": slot["count"],
              "fields": {k: _num_stats(vs)
@@ -91,9 +99,61 @@ def summarize_jsonl(path) -> dict:
         "spans": {n: {**_num_stats(vs),
                       "total_ms": round(float(np.sum(vs)), 3)}
                   for n, vs in sorted(spans.items())},
+        "span_self": _span_self_times(records),
+        "programs": programs,
+        "profile_steps": profile_steps,
         "metrics": last_snapshot,
         "requests": _request_timelines(records),
     }
+
+
+def _span_self_times(records: list[dict]) -> dict:
+    """Per-span-name EXCLUSIVE time: each span's duration minus the
+    durations of its direct children — the flame-graph "where does the
+    time actually go" answer, computable from any span jsonl export
+    (the `stats --top N` table). Inclusive totals double-count nested
+    work (serve.tick contains admit+collect+window); self time sums to
+    the traced wall instead."""
+    spans = [r for r in records
+             if r.get("event") == "span"
+             and isinstance(r.get("dur_ms"), (int, float))
+             and r.get("id") is not None]
+    # span ids are unique within ONE tracer but restart per process, and
+    # append-mode run logs can hold several runs — a repeated id marks a
+    # new run SEGMENT, and parent links never cross segments, so child
+    # sums are computed per segment (joining by raw id across the whole
+    # file would subtract one run's children from another run's parents)
+    segments: list[list[dict]] = []
+    seen: set = set()
+    for r in spans:
+        if not segments or r["id"] in seen:
+            segments.append([])
+            seen = set()
+        seen.add(r["id"])
+        segments[-1].append(r)
+    out: dict[str, dict] = {}
+    for seg in segments:
+        child_sum: dict[object, float] = {}
+        for r in seg:
+            p = r.get("parent")
+            if p is not None:
+                child_sum[p] = child_sum.get(p, 0.0) + r["dur_ms"]
+        for r in seg:
+            name = str(r.get("name"))
+            self_ms = max(r["dur_ms"] - child_sum.get(r["id"], 0.0),
+                          0.0)
+            slot = out.setdefault(name, {"count": 0, "total_ms": 0.0,
+                                         "self_ms": 0.0})
+            slot["count"] += 1
+            slot["total_ms"] += r["dur_ms"]
+            slot["self_ms"] += self_ms
+    grand = sum(s["self_ms"] for s in out.values())
+    for slot in out.values():
+        slot["total_ms"] = round(slot["total_ms"], 3)
+        slot["self_ms"] = round(slot["self_ms"], 3)
+        slot["self_pct"] = (round(100.0 * slot["self_ms"] / grand, 2)
+                            if grand > 0 else 0.0)
+    return out
 
 
 def _request_timelines(records: list[dict]) -> dict:
@@ -134,8 +194,9 @@ def _request_timelines(records: list[dict]) -> dict:
     return reqs
 
 
-def format_summary(s: dict) -> str:
-    """Human terminal rendering of `summarize_jsonl`'s dict."""
+def format_summary(s: dict, *, top: int = 15) -> str:
+    """Human terminal rendering of `summarize_jsonl`'s dict. `top`
+    bounds the span self-time table (stats --top N)."""
     out = [f"{s['path']}: {s['records']} records"
            + (f" ({s['unparseable_lines']} unparseable)"
               if s["unparseable_lines"] else "")
@@ -162,6 +223,33 @@ def format_summary(s: dict) -> str:
             out.append(f"  {name:28s} x{st['count']} "
                        f"total={st['total_ms']} mean={st['mean']} "
                        f"p50={st['p50']} p95={st['p95']}")
+    if s.get("span_self"):
+        ranked = sorted(s["span_self"].items(),
+                        key=lambda kv: kv[1]["self_ms"], reverse=True)
+        shown = ranked[:max(int(top), 1)]
+        out.append("")
+        out.append(f"span self-time (exclusive, top {len(shown)} of "
+                   f"{len(ranked)}):")
+        for name, st in shown:
+            out.append(f"  {name:28s} x{st['count']} "
+                       f"self={st['self_ms']}ms ({st['self_pct']}%) "
+                       f"total={st['total_ms']}ms")
+    if s.get("programs"):
+        from idc_models_tpu.observe.profile import format_program
+
+        out.append("")
+        out.append("programs (performance attribution):")
+        for rec in s["programs"]:
+            out.append(format_program(rec))
+    if s.get("profile_steps"):
+        out.append("")
+        out.append("step-time attribution:")
+        for rec in s["profile_steps"]:
+            out.append(
+                f"  {rec['loop']:14s} {rec['steps']:>5} steps — device "
+                f"{rec['device_busy_fraction']:.1%} / host-gap "
+                f"{rec['host_gap_fraction']:.1%} "
+                f"(mean {rec['step_ms_mean']} ms/step)")
     if s.get("requests"):
         out.append("")
         out.append(f"requests: {len(s['requests'])} with per-request "

@@ -687,7 +687,13 @@ class SlotEngine:
             return {}
         toks, (rem_before, occupied, eos_h) = self._pending
         self._pending = None
-        toks = np.asarray(toks)                 # the ONE host transfer
+        # the ONE host transfer — and the point where the serve loop
+        # BLOCKS on the in-flight window's device execution, so it is
+        # bracketed as device.sync for step-time attribution
+        # (observe/profile.py DeviceTimeline; no-op span when no
+        # tracer is armed)
+        with trace.span("device.sync"):
+            toks = np.asarray(toks)
         out = {}
         for s in range(self.n_slots):
             if not occupied[s]:
@@ -761,6 +767,43 @@ class SlotEngine:
                "health": self._efns.health._cache_size()}
         if self.prefill_chunk is not None:
             out["prefill_chunk"] = self._sfns.prefill_chunk._cache_size()
+        return out
+
+    def program_costs(self, window: int) -> dict:
+        """Cost/memory accounts of the engine's compiled programs
+        (observe/profile.py ProgramCost): the fused masked decode
+        window at `window` steps and the admission prefill (the chunk
+        program when chunked, else the full-bucket monolithic shape).
+        Lowers ACCOUNTING copies against the live state shapes —
+        suppressed from the compile watchdog, registered in the
+        process PROGRAMS table. The profile CLI verb's serve mode
+        feeds these into its roofline verdicts."""
+        from idc_models_tpu.observe import profile as prof
+
+        out = {}
+        with prof.compiling(None):
+            out["serve.window"] = prof.register_program(
+                "serve.window",
+                self._efns.window.lower(
+                    self._params, self._caches, self._logits, self._kd,
+                    self._pos, self._rem, self._eos, self._scales,
+                    window).compile())
+            if self.prefill_chunk is not None:
+                c = self.prefill_chunk
+                caches1 = self._sfns.init_caches(1)
+                out["serve.prefill_chunk"] = prof.register_program(
+                    "serve.prefill_chunk",
+                    self._sfns.prefill_chunk.lower(
+                        self._params, caches1,
+                        np.zeros((1, c), np.int32), np.int32(0),
+                        np.int32(c)).compile())
+            else:
+                out["serve.prefill"] = prof.register_program(
+                    "serve.prefill",
+                    self._sfns.prefill.lower(
+                        self._params,
+                        np.zeros((1, self.t_max), np.int32),
+                        np.int32(self.t_max)).compile())
         return out
 
     def warmup(self, n_steps: int) -> None:

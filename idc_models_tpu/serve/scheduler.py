@@ -44,6 +44,7 @@ import os
 import time
 from collections import deque
 
+from idc_models_tpu.observe import profile as prof
 from idc_models_tpu.observe import trace
 from idc_models_tpu.serve.engine import HEALTH_KINDS
 from idc_models_tpu.serve.faults import (
@@ -571,7 +572,14 @@ class Scheduler:
         #    would leave _prefilling populated (with caches already
         #    donated to the dead dispatch) and wedge every later tick
         t_pf = self.clock()
-        with trace.span("serve.admit") as _sp:
+        # naming_compiles: when the compile watchdog (observe/profile)
+        # is armed, any XLA compile the admission path triggers — the
+        # no-recompile contract says NONE after warmup — is recorded
+        # under this name; with no watchdog it is the shared no-op
+        # handle (one module-global read, same cost class as a
+        # disabled trace span; charged in bench_profile_overhead)
+        with trace.span("serve.admit") as _sp, \
+                prof.naming_compiles("serve.admit"):
             try:
                 admitted = self._admit_free_slots()
                 chunk_steps = (self._step_prefills(done) if self._chunked

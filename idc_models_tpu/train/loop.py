@@ -37,6 +37,7 @@ from idc_models_tpu.data.pipeline import (
 from idc_models_tpu.models import core, registry
 from idc_models_tpu.observe import Timer, plot_history
 from idc_models_tpu.observe import metrics_registry as mreg
+from idc_models_tpu.observe import profile as prof
 from idc_models_tpu.observe import trace
 from idc_models_tpu.train import metrics as metrics_lib
 from idc_models_tpu.train.state import TrainState, create_train_state, rmsprop
@@ -245,6 +246,11 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
                                      "epochs completed")
     m_loss = mreg.REGISTRY.gauge("train_loss",
                                  "last completed epoch's train loss")
+    # program accounting only when a profile driver armed it (it costs
+    # one extra compile of the step); central_storage's step_fn is a
+    # host wrapper around base_step, so base_step is registered either
+    # way — same executable, honest account
+    accounted = not prof.accounting_enabled()
     for epoch in range(start_epoch, epochs):
         # epoch folded into the seed (not a running split) so a resumed
         # run reproduces the straight-through rng stream
@@ -258,13 +264,24 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
                 # fetch below, inside train.epoch
                 with trace.span("train.step"):
                     state, m = step_fn(state, x, y, sub)
+                if not accounted:
+                    # opt-in program accounting (profile.py): one
+                    # AOT accounting compile, named in PROGRAMS +
+                    # program_* gauges; never on by default
+                    accounted = True
+                    prof.register_jit("train.step", base_step, state,
+                                      x, y, sub)
                 losses.append(m["loss"])
                 accs.append(m["accuracy"])
             m_steps.inc(len(losses))
-            ep = {
-                "loss": float(jnp.mean(jnp.stack(losses))),
-                "accuracy": float(jnp.mean(jnp.stack(accs))),
-            }
+            # the epoch-mean fetch is where this loop BLOCKS on the
+            # device — bracketed as device.sync so a DeviceTimeline
+            # can split train.epoch into device-wait vs host gap
+            with trace.span("device.sync"):
+                ep = {
+                    "loss": float(jnp.mean(jnp.stack(losses))),
+                    "accuracy": float(jnp.mean(jnp.stack(accs))),
+                }
             ep_span.set(steps=len(losses), loss=ep["loss"])
         if not np.isfinite(ep["loss"]):
             # fail FAST and loudly: a NaN here would silently poison

@@ -519,6 +519,94 @@ def test_cli_stats_covers_train_and_fed_jsonl(tmp_path, capsys):
     assert s["requests"] == {}      # nothing serve-shaped in this log
 
 
+def test_cli_profile_train_and_stats(tmp_path, capsys):
+    """ISSUE-9 acceptance from the product surface: `profile` over a
+    train step emits a program cost account with a roofline verdict
+    (declared roof — CPU is not in the backend table), a device-vs-
+    host step-time split whose fractions sum to ~1, and frozen-schema
+    profile_program/profile_step jsonl the `stats` verb renders; the
+    compile-churn watchdog stays SILENT on the clean run and fires on
+    the injected shape-varying recompile loop (--churn-drill).
+    Attribution/verdict math is owned by tests/test_profile.py; this
+    drives the CLI wiring end to end."""
+    import json
+
+    out = _run(["profile", "--model", "small", "--host-devices", "8",
+                "--steps", "3", "--peak-tflops", "1.0",
+                "--peak-gbps", "50.0", "--path", str(tmp_path)], capsys)
+    assert "profile: train.step (small_cnn" in out
+    assert "programs (performance attribution):" in out
+    assert "train.step" in out
+    assert "-bound at" in out            # a real verdict, not unknown
+    assert "step-time attribution" in out and "profile.step" in out
+    assert "churn: none" in out          # clean warm run stays silent
+    jsonl = tmp_path / "logs" / "profile.jsonl"
+    recs = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    progs = [r for r in recs if r["event"] == "profile_program"]
+    steps = [r for r in recs if r["event"] == "profile_step"]
+    assert progs[0]["program"] == "train.step"
+    assert progs[0]["verdict"] in ("compute-bound", "bandwidth-bound")
+    assert progs[0]["flops"] > 0 and progs[0]["mfu"] is not None
+    fr = [r for r in steps if r["loop"] == "profile.step"][0]
+    assert fr["steps"] == 3
+    assert (fr["device_busy_fraction"] + fr["host_gap_fraction"]
+            == pytest.approx(1.0))
+    assert any(r["event"] == "metrics_snapshot" for r in recs)
+
+    # the injected recompile loop trips the watchdog (named program
+    # fed a different shape every call past --compile-limit)
+    out = _run(["profile", "--model", "small", "--host-devices", "8",
+                "--steps", "2", "--compile-limit", "3",
+                "--churn-drill"], capsys)
+    assert "CHURN flagged: churn.drill" in out
+
+    # stats renders the profile events + the self-time table
+    out = _run(["stats", str(jsonl)], capsys)
+    assert "programs (performance attribution):" in out
+    assert "step-time attribution:" in out
+    out = _run(["stats", str(jsonl), "--json"], capsys)
+    s = json.loads(out)
+    assert s["events"]["profile_program"]["count"] == len(progs)
+    assert s["programs"][0]["program"] == "train.step"
+
+    # usage errors die cleanly: half a roofline, bad steps/limit/top
+    with pytest.raises(SystemExit):
+        cli.main(["profile", "--model", "small", "--host-devices", "8",
+                  "--peak-tflops", "1.0"])
+    with pytest.raises(SystemExit):
+        cli.main(["profile", "--model", "small", "--host-devices", "8",
+                  "--steps", "0"])
+    with pytest.raises(SystemExit):
+        cli.main(["profile", "--model", "small", "--host-devices", "8",
+                  "--compile-limit", "0"])
+    with pytest.raises(SystemExit):
+        cli.main(["stats", str(jsonl), "--top", "0"])
+
+
+def test_cli_profile_serve(tmp_path, capsys):
+    """The `profile` verb's serve mode: engine program accounts
+    (window + prefill) and the serve.tick device-vs-host split from a
+    saturated decode loop, through the CLI."""
+    import json
+
+    out = _run(["profile", "--model", "serve", "--host-devices", "8",
+                "--steps", "5", "--path", str(tmp_path)], capsys)
+    assert "profile: serve decode loop" in out
+    assert "serve.window" in out and "serve.prefill" in out
+    assert "serve.tick" in out
+    recs = [json.loads(l) for l in
+            (tmp_path / "logs" / "profile.jsonl").read_text()
+            .splitlines()]
+    progs = {r["program"] for r in recs
+             if r["event"] == "profile_program"}
+    assert {"serve.window", "serve.prefill"} <= progs
+    steps = [r for r in recs if r["event"] == "profile_step"]
+    tick = [r for r in steps if r["loop"] == "serve.tick"][0]
+    assert tick["steps"] >= 1
+    assert (tick["device_busy_fraction"] + tick["host_gap_fraction"]
+            == pytest.approx(1.0))
+
+
 def test_cli_lm(tmp_path, capsys):
     """The causal-LM workload from the product surface: the CLI wiring
     only (mesh line, metric line, generate line, jsonl artifact, ring

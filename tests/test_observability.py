@@ -509,6 +509,136 @@ def test_bench_compare_flags_directional_regressions(tmp_path):
             f"docs/BENCHMARKS.md")
 
 
+def test_profile_program_jsonl_schema_frozen(tmp_path, devices):
+    """ISSUE-9: the `profile_program` event's key set is frozen from
+    day one (NEW event; the ten historical event schemas are gated
+    above/by their own tests). The record is built through the ONE
+    construction site (profile.program_record) the CLI uses."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu.observe import profile as prof
+
+    compiled = jax.jit(lambda x: x @ x).lower(
+        jnp.ones((8, 8), jnp.float32)).compile()
+    cost = prof.program_report(compiled, name="sch.prog")
+    roofline = prof.roofline_verdict(
+        cost, 0.001, spec=prof.RooflineSpec("x", 100.0, 1000.0))
+    log = tmp_path / "profile.jsonl"
+    with JsonlLogger(log) as logger:
+        logger.log(event="profile_program",
+                   **prof.program_record(cost, roofline, step_ms=1.0,
+                                         device_kind="cpu"))
+    rec = json.loads(log.read_text().splitlines()[0])
+    assert set(rec) == {
+        "ts", "event", "program", "flops", "bytes_accessed",
+        "arithmetic_intensity", "argument_bytes", "output_bytes",
+        "temp_bytes", "peak_hbm_bytes", "generated_code_bytes",
+        "available", "step_ms", "verdict", "achieved_tflops",
+        "achieved_hbm_gbps", "mfu", "hbm_utilization",
+        "bound_fraction", "ridge_intensity", "peak_tflops",
+        "peak_hbm_gbps", "device_kind"}
+    assert rec["event"] == "profile_program"
+    assert rec["program"] == "sch.prog" and rec["available"] is True
+    assert rec["verdict"] in ("compute-bound", "bandwidth-bound")
+    # a verdict-less (unknown-backend) record keeps the SAME keys
+    with JsonlLogger(log) as logger:
+        logger.log(event="profile_program",
+                   **prof.program_record(cost))
+    rec2 = json.loads(log.read_text().splitlines()[-1])
+    assert set(rec2) == set(rec)
+    assert rec2["verdict"] == "unknown" and rec2["mfu"] is None
+
+
+def test_profile_step_jsonl_schema_frozen(tmp_path):
+    """ISSUE-9: the `profile_step` event's key set is frozen, built
+    through profile.step_record from a real DeviceTimeline report."""
+    from idc_models_tpu.observe import MetricsRegistry
+    from idc_models_tpu.observe import profile as prof
+
+    records = [
+        {"event": "span", "name": "profile.step", "id": 1,
+         "parent": None, "tid": 1, "t_ms": 0.0, "dur_ms": 10.0,
+         "wall": 0.0, "attrs": {}},
+        {"event": "span", "name": "device.sync", "id": 2, "parent": 1,
+         "tid": 1, "t_ms": 1.0, "dur_ms": 6.0, "wall": 0.0,
+         "attrs": {}},
+    ]
+    tl = prof.DeviceTimeline(registry=MetricsRegistry()).consume(records)
+    log = tmp_path / "profile.jsonl"
+    with JsonlLogger(log) as logger:
+        for loop, st in tl.report().items():
+            logger.log(event="profile_step",
+                       **prof.step_record(loop, st))
+    rec = json.loads(log.read_text().splitlines()[0])
+    assert set(rec) == {"ts", "event", "loop", "steps", "wall_ms",
+                        "device_ms", "host_gap_ms",
+                        "device_busy_fraction", "host_gap_fraction",
+                        "step_ms_mean"}
+    assert rec["loop"] == "profile.step"
+    assert rec["device_busy_fraction"] == pytest.approx(0.6)
+    assert (rec["device_busy_fraction"] + rec["host_gap_fraction"]
+            == pytest.approx(1.0))
+
+
+def test_stats_span_self_time_table(tmp_path):
+    """ISSUE-9 satellite: per-span-name EXCLUSIVE time from any span
+    export — parent self-time excludes direct children; --top bounds
+    the rendered table."""
+    from idc_models_tpu.observe import format_summary
+
+    recs = [
+        {"event": "span", "name": "tick", "id": 1, "parent": None,
+         "tid": 1, "t_ms": 0.0, "dur_ms": 10.0, "wall": 1.0,
+         "attrs": {}},
+        {"event": "span", "name": "collect", "id": 2, "parent": 1,
+         "tid": 1, "t_ms": 1.0, "dur_ms": 4.0, "wall": 1.0,
+         "attrs": {}},
+        {"event": "span", "name": "window", "id": 3, "parent": 1,
+         "tid": 1, "t_ms": 6.0, "dur_ms": 3.0, "wall": 1.0,
+         "attrs": {}},
+        {"event": "span", "name": "tick", "id": 4, "parent": None,
+         "tid": 1, "t_ms": 11.0, "dur_ms": 5.0, "wall": 1.0,
+         "attrs": {}},
+    ]
+    log = tmp_path / "spans.jsonl"
+    log.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    s = summarize_jsonl(log)
+    self_t = s["span_self"]
+    # tick inclusive 15, children 7 -> self 8; leaves keep their dur
+    assert self_t["tick"]["count"] == 2
+    assert self_t["tick"]["total_ms"] == 15.0
+    assert self_t["tick"]["self_ms"] == 8.0
+    assert self_t["collect"]["self_ms"] == 4.0
+    assert self_t["window"]["self_ms"] == 3.0
+    assert self_t["tick"]["self_pct"] == pytest.approx(
+        100.0 * 8 / 15, abs=0.01)
+    text = format_summary(s, top=1)
+    assert "span self-time (exclusive, top 1 of 3):" in text
+    assert "tick" in text.split("span self-time")[1]
+    # negative-self clamping: a child longer than its parent
+    recs2 = [
+        {"event": "span", "name": "p", "id": 1, "parent": None,
+         "tid": 1, "t_ms": 0.0, "dur_ms": 2.0, "wall": 1.0,
+         "attrs": {}},
+        {"event": "span", "name": "c", "id": 2, "parent": 1, "tid": 1,
+         "t_ms": 0.0, "dur_ms": 3.0, "wall": 1.0, "attrs": {}},
+    ]
+    log2 = tmp_path / "spans2.jsonl"
+    log2.write_text("\n".join(json.dumps(r) for r in recs2) + "\n")
+    assert summarize_jsonl(log2)["span_self"]["p"]["self_ms"] == 0.0
+    # append-mode logs hold MULTIPLE runs whose span ids restart per
+    # process — a repeated id starts a new segment, so run 2's children
+    # must not subtract from run 1's same-id parents
+    two_runs = recs + recs          # same ids twice = two runs appended
+    log3 = tmp_path / "spans3.jsonl"
+    log3.write_text("\n".join(json.dumps(r) for r in two_runs) + "\n")
+    st = summarize_jsonl(log3)["span_self"]
+    assert st["tick"]["count"] == 4
+    assert st["tick"]["self_ms"] == 16.0      # 2x the single-run 8.0
+    assert st["collect"]["self_ms"] == 8.0
+
+
 def test_fit_epoch_jsonl_schema_unchanged(tmp_path, devices):
     import jax.numpy as jnp
 

@@ -1,0 +1,376 @@
+"""ISSUE 9 performance-attribution layer (observe/profile.py): the
+single program_report extraction point, program registration, the
+DeviceTimeline device-vs-host split, roofline verdicts, and the
+compile-churn watchdog — plus the armed hooks in fit/run_rounds and
+the Generator/SlotEngine program accounts.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from idc_models_tpu.observe import MetricsRegistry
+from idc_models_tpu.observe import profile as prof
+
+
+# -- program accounting ------------------------------------------------------
+
+
+def test_program_report_real_executable(devices):
+    import jax
+    import jax.numpy as jnp
+
+    compiled = jax.jit(lambda x: x @ x).lower(
+        jnp.ones((16, 16), jnp.float32)).compile()
+    rep = prof.program_report(compiled, name="matmul")
+    assert rep.program == "matmul" and rep.available
+    assert rep.flops and rep.flops > 0
+    assert rep.bytes_accessed and rep.bytes_accessed > 0
+    assert rep.arithmetic_intensity == pytest.approx(
+        rep.flops / rep.bytes_accessed)
+    assert rep.argument_bytes == 16 * 16 * 4
+    assert rep.peak_hbm_bytes is not None and rep.peak_hbm_bytes >= 0
+    assert rep.missing == ()
+
+
+class _DeadCompiled:
+    """A backend that reports nothing (cost None, memory raises)."""
+
+    def cost_analysis(self):
+        return None
+
+    def memory_analysis(self):
+        raise NotImplementedError("backend does not expose it")
+
+
+def test_program_report_degrades_loudly_but_gracefully():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rep = prof.program_report(_DeadCompiled(), name="dead-prog")
+    assert not rep.available
+    assert rep.flops is None and rep.bytes_accessed is None
+    assert rep.peak_hbm_bytes is None
+    assert "flops" in rep.missing and "temp_bytes" in rep.missing
+    assert any("dead-prog" in str(x.message) for x in w)
+    # the roofline verdict for a degraded record is honest: unknown
+    v = prof.roofline_verdict(rep, 0.01,
+                              spec=prof.BACKEND_ROOFS["v5e"])
+    assert v["verdict"] == "unknown" and v["mfu"] is None
+
+
+def test_register_program_files_table_and_gauges(devices):
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    compiled = jax.jit(lambda x: jnp.sum(x * 2.0)).lower(
+        jnp.ones((64,), jnp.float32)).compile()
+    cost = prof.register_program("test.reg_prog", compiled,
+                                 registry=reg)
+    assert prof.registered_programs()["test.reg_prog"] is cost
+    g = reg.get("program_flops")
+    assert g is not None
+    assert g.value(program="test.reg_prog") == cost.flops
+
+
+def test_register_jit_best_effort(devices):
+    import jax.numpy as jnp
+
+    cost = prof.register_jit("test.jit_prog",
+                             lambda x: jnp.sum(x ** 2),
+                             jnp.ones((8,), jnp.float32))
+    assert cost is not None and cost.flops
+    assert "test.jit_prog" in prof.registered_programs()
+
+    # a host-side wrapper cannot be lowered: warn + None, never raise
+    def hostish(x):
+        return float(np.asarray(x).sum())
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = prof.register_jit("test.host_prog", hostish,
+                                jnp.ones((4,)))
+    assert out is None
+    assert any("test.host_prog" in str(x.message) for x in w)
+    assert "test.host_prog" not in prof.registered_programs()
+
+
+# -- DeviceTimeline ----------------------------------------------------------
+
+
+def _span(name, sid, parent, dur):
+    return {"event": "span", "name": name, "id": sid, "parent": parent,
+            "tid": 1, "t_ms": float(sid), "dur_ms": float(dur),
+            "wall": 0.0, "attrs": {}}
+
+
+def test_device_timeline_nearest_ancestor_attribution():
+    # serve.tick > serve.collect > device.sync: the sync attributes to
+    # the tick through the intermediate span; an orphan sync is
+    # ignored; fractions sum to 1
+    records = [
+        _span("serve.tick", 1, None, 10.0),
+        _span("serve.collect", 2, 1, 4.0),
+        _span("device.sync", 3, 2, 3.0),
+        _span("serve.tick", 4, None, 10.0),
+        _span("device.sync", 5, 4, 5.0),
+        _span("device.sync", 6, None, 99.0),     # no loop ancestor
+        _span("train.step", 7, None, 2.0),       # loop without sync
+    ]
+    reg = MetricsRegistry()
+    tl = prof.DeviceTimeline(registry=reg).consume(records)
+    rep = tl.report()
+    tick = rep["serve.tick"]
+    assert tick["steps"] == 2 and tick["wall_ms"] == 20.0
+    assert tick["device_ms"] == 8.0 and tick["host_gap_ms"] == 12.0
+    assert tick["device_busy_fraction"] == pytest.approx(0.4)
+    assert (tick["device_busy_fraction"] + tick["host_gap_fraction"]
+            == pytest.approx(1.0))
+    assert rep["train.step"]["device_busy_fraction"] == 0.0
+    g = reg.get("device_busy_fraction")
+    assert g.value(loop="serve.tick") == pytest.approx(0.4)
+
+
+def test_device_timeline_segments_appended_multi_run_logs():
+    """Append-mode logs hold several runs whose span ids restart per
+    process — a repeated id starts a new segment, so one run's
+    device.sync must never walk parent links into another run's
+    spans."""
+    run = [
+        _span("serve.tick", 1, None, 10.0),
+        _span("device.sync", 2, 1, 4.0),
+    ]
+    # second run reuses ids 1/2 but id 1 is now a NON-loop span: naive
+    # whole-file joining would resolve run 1's sync against it
+    run2 = [
+        _span("other", 1, None, 100.0),
+        _span("device.sync", 2, 1, 50.0),
+    ]
+    rep = prof.DeviceTimeline(registry=MetricsRegistry()).consume(
+        run + run2).report()
+    tick = rep["serve.tick"]
+    assert tick["steps"] == 1 and tick["wall_ms"] == 10.0
+    assert tick["device_ms"] == 4.0       # run 2's sync not attributed
+    assert tick["device_busy_fraction"] == pytest.approx(0.4)
+
+
+def test_device_timeline_clamps_device_to_wall():
+    # clock jitter can make a child's dur exceed the parent's — the
+    # fraction must stay in [0, 1]
+    records = [
+        _span("fed.round", 1, None, 5.0),
+        _span("device.sync", 2, 1, 7.5),
+    ]
+    rep = prof.DeviceTimeline(registry=MetricsRegistry()).consume(
+        records).report()
+    assert rep["fed.round"]["device_busy_fraction"] == 1.0
+    assert rep["fed.round"]["host_gap_fraction"] == 0.0
+
+
+# -- roofline ----------------------------------------------------------------
+
+
+def test_roofline_for_longest_substring_match():
+    assert prof.roofline_for("TPU v5 lite").peak_tflops == 197.0
+    assert prof.roofline_for("TPU v5p chip").peak_tflops == 459.0
+    assert prof.roofline_for("cpu") is None
+    spec = prof.register_roof("TestChip9000", 100.0, 1000.0)
+    try:
+        assert prof.roofline_for("testchip9000 rev2") is spec
+    finally:
+        del prof.BACKEND_ROOFS[spec.key]
+    with pytest.raises(ValueError):
+        prof.register_roof("bad", -1.0, 10.0)
+
+
+def test_roofline_verdict_compute_vs_bandwidth_bound():
+    spec = prof.RooflineSpec("x", 100.0, 1000.0)     # ridge = 100 f/B
+    hi = prof.ProgramCost(program="hi", flops=1e12, bytes_accessed=1e9,
+                          arithmetic_intensity=1000.0)
+    lo = prof.ProgramCost(program="lo", flops=1e10, bytes_accessed=1e9,
+                          arithmetic_intensity=10.0)
+    v = prof.roofline_verdict(hi, 0.1, spec=spec)
+    assert v["verdict"] == "compute-bound"
+    assert v["achieved_tflops"] == pytest.approx(10.0)
+    assert v["mfu"] == pytest.approx(0.1)
+    assert v["bound_fraction"] == v["mfu"]
+    v = prof.roofline_verdict(lo, 0.01, spec=spec)
+    assert v["verdict"] == "bandwidth-bound"
+    assert v["achieved_hbm_gbps"] == pytest.approx(100.0)
+    assert v["hbm_utilization"] == pytest.approx(0.1)
+    assert v["bound_fraction"] == v["hbm_utilization"]
+    # n_dev divides whole-program flops back to per-chip
+    v2 = prof.roofline_verdict(hi, 0.1, spec=spec, n_dev=2)
+    assert v2["achieved_tflops"] == pytest.approx(5.0)
+    # unknown backend: verdict unknown, achieved numbers still there
+    v3 = prof.roofline_verdict(hi, 0.1, device="cpu")
+    assert v3["verdict"] == "unknown"
+    assert v3["achieved_tflops"] == pytest.approx(10.0)
+
+
+# -- compile watchdog --------------------------------------------------------
+
+
+def test_watchdog_fires_on_shape_varying_recompile_loop(devices):
+    """The acceptance drill: a jitted program fed a DIFFERENT shape
+    every call recompiles every call — the watchdog flags it past the
+    limit. A clean warm run (same shape repeatedly) stays silent."""
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    wd = prof.arm_watchdog(limit=3, registry=reg)
+    try:
+        f = jax.jit(lambda t: jnp.sum(t * 2.0))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with prof.compiling("drill.varying"):
+                for n in range(6):           # 6 shapes -> 6 compiles
+                    float(f(jnp.zeros((n + 1,), jnp.float32)))
+        churn = [x for x in w if "compile churn" in str(x.message)]
+        assert len(churn) == 1               # flags ONCE, not per call
+        assert "drill.varying" in str(churn[0].message)
+        rep = wd.report()
+        assert rep["flagged"] == ["drill.varying"]
+        assert rep["programs"]["drill.varying"]["count"] > 3
+        assert rep["compile_seconds_total"] > 0
+        assert reg.get("compiles_total").value(
+            program="drill.varying") > 3
+        assert reg.get("compile_churn_flagged_total").value(
+            program="drill.varying") == 1
+    finally:
+        prof.disarm_watchdog()
+
+    # clean warm run: one compile, then cache hits — silent
+    wd2 = prof.arm_watchdog(limit=3, registry=MetricsRegistry())
+    try:
+        g = jax.jit(lambda t: jnp.sum(t + 1.0))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with prof.compiling("drill.warm"):
+                for _ in range(10):
+                    float(g(jnp.zeros((4,), jnp.float32)))
+        assert not [x for x in w if "compile churn" in str(x.message)]
+        rep = wd2.report()
+        assert rep["flagged"] == []
+        assert rep["programs"]["drill.warm"]["count"] <= 3
+    finally:
+        prof.disarm_watchdog()
+
+
+def test_watchdog_unnamed_bucket_exempt_and_suppression(devices):
+    """The unnamed bucket (unrelated one-shot setup compiles) never
+    flags; compiling(None) suppresses recording entirely (accounting
+    copies are not churn); disarm stops observation."""
+    import jax
+    import jax.numpy as jnp
+
+    wd = prof.arm_watchdog(limit=2, registry=MetricsRegistry())
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for n in range(5):               # unnamed: counted, exempt
+                float(jax.jit(lambda t: jnp.sum(t - 1.0))(
+                    jnp.zeros((n + 10,), jnp.float32)))
+        assert not [x for x in w if "compile churn" in str(x.message)]
+        rep = wd.report()
+        assert rep["flagged"] == []
+        assert rep["programs"][prof.UNNAMED]["count"] >= 5
+        before = wd.report()["total_compiles"]
+        with prof.compiling(None):           # suppressed
+            jax.jit(lambda t: t * 3.0).lower(
+                jnp.zeros((7,), jnp.float32)).compile()
+        assert wd.report()["total_compiles"] == before
+    finally:
+        prof.disarm_watchdog()
+    # disarmed: nothing recorded, naming_compiles is the no-op handle
+    after = wd.report()["total_compiles"]
+    float(jax.jit(lambda t: jnp.sum(t * 5.0))(
+        jnp.zeros((123,), jnp.float32)))
+    assert wd.report()["total_compiles"] == after
+    assert prof.naming_compiles("x") is prof.naming_compiles("y")
+
+
+# -- armed hooks in the loops ------------------------------------------------
+
+
+def test_fit_registers_train_step_when_accounting_armed(devices):
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.models import small_cnn
+    from idc_models_tpu.train import TrainState, fit, rmsprop
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    rng = np.random.default_rng(0)
+    ds = ArrayDataset(rng.random((16, 10, 10, 3)).astype(np.float32),
+                      (rng.random(16) > 0.5).astype(np.int32))
+    model = small_cnn(10, 3, 1)
+    opt = rmsprop(1e-3)
+    variables = model.init(jax.random.key(0))
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    prof.PROGRAMS.pop("train.step", None)
+    prof.enable_accounting()
+    try:
+        fit(model, opt, binary_cross_entropy, state, ds, None,
+            meshlib.data_mesh(), epochs=1, batch_size=8, verbose=False)
+    finally:
+        prof.enable_accounting(False)
+    cost = prof.registered_programs().get("train.step")
+    assert cost is not None and cost.flops
+
+
+def test_run_rounds_registers_fed_round_when_armed(devices):
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu.federated.driver import DriverConfig, run_rounds
+    from idc_models_tpu.federated.fedavg import ServerState
+
+    def round_fn(server, images, labels, weights, rng):
+        new = ServerState(round=server.round + 1,
+                          params={"w": server.params["w"] * 0.9},
+                          model_state={})
+        return new, {"loss": jnp.sum(new.params["w"] ** 2),
+                     "accuracy": jnp.float32(0.9)}
+
+    server = ServerState(round=jnp.zeros((), jnp.int32),
+                         params={"w": jnp.ones((4,))}, model_state={})
+    prof.PROGRAMS.pop("fed.round", None)
+    prof.enable_accounting()
+    try:
+        res = run_rounds(round_fn, server, None, None,
+                         np.ones(3, np.float32),
+                         config=DriverConfig(rounds=2))
+    finally:
+        prof.enable_accounting(False)
+    assert len(res.history) == 2
+    cost = prof.registered_programs().get("fed.round")
+    assert cost is not None and cost.available
+
+
+def test_generator_program_costs(devices):
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import Generator, attention_lm
+
+    mesh = meshlib.seq_mesh(1)
+    model = attention_lm(16, 32, embed_dim=16, num_heads=2, mlp_dim=32,
+                         num_blocks=1, mesh=mesh)
+    params = model.init(jax.random.key(0)).params
+    gen = Generator(params, embed_dim=16, num_heads=2, num_blocks=1,
+                    t_max=32, mesh=mesh, cache_dtype=jnp.float32)
+    costs = gen.program_costs(steps=4)
+    assert set(costs) == {"lm.prefill", "lm.decode"}
+    for cost in costs.values():
+        assert cost.available and cost.flops
+    assert prof.registered_programs()["lm.prefill"].flops \
+        == costs["lm.prefill"].flops

@@ -376,6 +376,78 @@ def _scan_serve_handlers(path: Path):
     return violations, live
 
 
+# -- single cost-extraction point (ISSUE 9 satellite) -----------------------
+#
+# XLA cost/memory accounting goes through ONE normalizing extraction
+# point — observe.profile.program_report — which handles the backend
+# quirks (list-vs-dict cost_analysis returns, absent memory_analysis)
+# and degrades loudly-but-gracefully. Before this PR the parsing was
+# copy-pasted across bench.py, two experiments files, and a test; this
+# scan keeps the invariant from regressing: a direct
+# `.cost_analysis()` / `.memory_analysis()` attribute call anywhere in
+# the repo's python (package, bench.py, experiments/, tests/) outside
+# the documented allowlist fails.
+
+REPO = Path(__file__).parent.parent
+
+_XLA_ANALYSIS_CALLS = {"cost_analysis", "memory_analysis"}
+
+# (path relative to the repo root, enclosing function) -> why a direct
+# call is correct there
+COST_ANALYSIS_ALLOWLIST = {
+    ("idc_models_tpu/observe/profile.py", "program_report"):
+        "THE extraction point: the one site allowed to touch the raw "
+        "XLA analyses, normalizing their quirks for everyone else",
+}
+
+
+def _scan_xla_analysis_calls(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(REPO)).replace("\\", "/")
+    violations, live = [], set()
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _XLA_ANALYSIS_CALLS):
+                key = (rel, _enclosing_function(stack))
+                live.add(key)
+                if key not in COST_ANALYSIS_ALLOWLIST:
+                    violations.append((rel, child.lineno,
+                                       child.func.attr, key[1]))
+            walk(child, stack + [child])
+
+    walk(tree, [])
+    return violations, live
+
+
+def _xla_analysis_files():
+    files = [REPO / "bench.py"]
+    for sub in ("idc_models_tpu", "experiments", "tests"):
+        files.extend(sorted((REPO / sub).rglob("*.py")))
+    me = Path(__file__).resolve()
+    return [f for f in files if f.resolve() != me]
+
+
+def test_single_cost_analysis_extraction_point():
+    violations, live = [], set()
+    for f in _xla_analysis_files():
+        v, l = _scan_xla_analysis_calls(f)
+        violations.extend(v)
+        live.update(l)
+    assert not violations, (
+        "direct .cost_analysis()/.memory_analysis() calls outside "
+        "observe.profile.program_report (route through "
+        "program_report/register_program — it normalizes backend "
+        "quirks and keeps the accounting schema in one place; extend "
+        "the documented COST_ANALYSIS_ALLOWLIST only for the "
+        f"extraction point itself): {violations}")
+    stale = set(COST_ANALYSIS_ALLOWLIST) - live
+    assert not stale, (
+        f"cost-analysis allowlist entries match no code: {stale}")
+
+
 def test_serve_handlers_quarantine_or_reraise():
     violations, live = [], set()
     for f in sorted((PACKAGE / "serve").rglob("*.py")):

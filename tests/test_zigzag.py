@@ -170,10 +170,15 @@ def test_unrolled_ring_matches_full(devices, layout, block_impl):
 
 
 def _compiled_flops(fn, *args):
-    cost = jax.jit(fn).lower(*args).compile().cost_analysis()
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0]
-    return float(cost["flops"])
+    # the one XLA cost-extraction point (ISSUE 9): program_report
+    # normalizes the list-vs-dict cost_analysis() return this helper
+    # used to hand-roll
+    from idc_models_tpu.observe.profile import program_report
+
+    rep = program_report(jax.jit(fn).lower(*args).compile(),
+                         name="zigzag.flop_gate")
+    assert rep.flops is not None, "backend reported no FLOPs"
+    return rep.flops
 
 
 def test_zigzag_flop_ratio_gate(devices):
