@@ -338,6 +338,25 @@ def _parse(argv):
                          "slot (per-(slot,head) scales, ~2x slots per "
                          "budget) at the cost of bounded logit drift — "
                          "leave bf16 when exact parity matters")
+    sp.add_argument("--spec-decode", action="store_true",
+                    help="speculative decoding (models/draft.py + the "
+                         "engine's fixed-k verify program): an n-gram "
+                         "prompt-lookup drafter proposes --draft-k "
+                         "continuation tokens per slot from the "
+                         "slot's own stream, ONE batched verify "
+                         "dispatch accepts the prefix the model "
+                         "itself would have emitted (+ its own pick "
+                         "at the first miss) — up to k+1 tokens per "
+                         "dispatch on repetitive/templated traffic, "
+                         "token-identical to plain decode")
+    sp.add_argument("--draft-k", type=int, default=8,
+                    help="draft tokens per slot per verify dispatch "
+                         "(the verify program's ONE compiled shape)")
+    sp.add_argument("--ngram-order", type=int, default=3,
+                    help="longest trailing n-gram the prompt-lookup "
+                         "drafter matches against the stream's "
+                         "history (falls back to shorter n-grams "
+                         "down to 1)")
     sp.add_argument("--metrics-port", type=int, default=None,
                     help="serve GET /metrics (Prometheus text "
                          "exposition of the live registry) and GET "
@@ -875,10 +894,20 @@ def _profile_serve(ns, on_accel):
     # they stay in the unnamed bucket, which the churn detector
     # exempts for exactly this reason (one bucket of one-shot
     # compiles is not one program recompiling)
+    class _NoDraft:
+        # arms the engine's fixed-k verify program so lm.verify is
+        # ACCOUNTED (cost/roofline), while never proposing — the
+        # measured loop stays pure fused windows, so window_s times
+        # exactly the program the serve.window verdict is paired with
+        def propose(self, history):
+            return None
+
     server = LMServer(params, embed_dim=e, num_heads=heads,
                       num_blocks=blocks, t_max=t_max, n_slots=n_slots,
                       window=window, mesh=mesh,
-                      cache_dtype=jnp.bfloat16)
+                      cache_dtype=jnp.bfloat16,
+                      spec_decode=True, draft_k=min(8, window),
+                      drafter=_NoDraft())
     budget = t_max - 8
     for i in range(n_slots):
         server.submit(Request(id=f"p{i}", prompt=(1, 2, 3, 4),
@@ -901,8 +930,12 @@ def _profile_serve(ns, on_accel):
     roofline = prof.roofline_verdict(wcost, window_s, dev)
     progs = {"serve.window": (wcost, roofline, window_s * 1e3)}
     for name, c in costs.items():
-        if name != "serve.window":
-            progs[name] = (c, {}, None)
+        if name == "serve.window":
+            continue
+        # untimed programs (admission prefill, the speculative verify)
+        # still get an intensity-based compute-vs-bandwidth verdict —
+        # achieved fractions need a measured step and stay None
+        progs[name] = (c, prof.roofline_verdict(c, None, dev), None)
     print(f"profile: serve decode loop ({n_slots} slots x {window} "
           f"tokens/window, {n} measured windows)")
     print(f"  {window_s * 1e3:.2f} ms/window, "
@@ -1353,6 +1386,12 @@ def _run_serve(ns):
     if ns.prefix_cache_mb > 0 and not ns.prefill_chunk:
         sys.exit("--prefix-cache-mb needs --prefill-chunk (snapshots "
                  "live on chunk boundaries)")
+    if ns.spec_decode and not 1 <= ns.draft_k <= ns.t_max - 2:
+        sys.exit(f"--draft-k {ns.draft_k} must be in [1, t_max - 2] "
+                 f"(a verify needs room for k drafts + the bonus "
+                 f"token inside the {ns.t_max}-slot cache)")
+    if ns.spec_decode and ns.ngram_order < 1:
+        sys.exit(f"--ngram-order {ns.ngram_order} must be >= 1")
     if ns.slo_ttft_p95_ms is not None and ns.slo_ttft_p95_ms <= 0:
         sys.exit(f"--slo-ttft-p95-ms {ns.slo_ttft_p95_ms} must be > 0")
     if (ns.slo_error_rate is not None
@@ -1511,7 +1550,9 @@ def _serve_body(ns, mesh, params, logger) -> None:
         prefix_cache_mb=ns.prefix_cache_mb,
         kv_dtype=("int8" if ns.kv_dtype == "int8" else None), slo=slo,
         retry=retry, fault_plan=ns.serve_fault_plan,
-        journal=ns.journal, brownout=brownout)
+        journal=ns.journal, brownout=brownout,
+        spec_decode=ns.spec_decode, draft_k=ns.draft_k,
+        draft_order=ns.ngram_order)
     if n_pending:
         readmitted = server.resubmit_pending(ns.journal)
         line = (f"journal: re-admitted {len(readmitted)} in-flight "
@@ -1574,6 +1615,18 @@ def _serve_body(ns, mesh, params, logger) -> None:
               f"({summary['serve_prefix_hits']} hits, "
               f"{summary['serve_prefix_evictions']} evictions, "
               f"{summary['serve_prefix_bytes']} bytes)")
+    if ns.spec_decode:
+        # what speculation actually bought: accept rate over drafted
+        # tokens and emitted tokens per slot per verify (1.0 would
+        # mean plain decode did just as well)
+        print(f"speculative: drafted={summary['serve_spec_drafted']} "
+              f"accepted={summary['serve_spec_accepted']} "
+              f"accept_rate={summary['serve_spec_accept_rate']} "
+              f"tokens/dispatch="
+              f"{summary['serve_spec_tokens_per_dispatch']} "
+              f"({summary['serve_spec_verify_dispatches']} verify + "
+              f"{summary['serve_decode_dispatches'] - summary['serve_spec_verify_dispatches']}"
+              f" window dispatches)")
     if slo is not None:
         names = sorted({a["slo"] for a in slo.alerts})
         print(f"slo: {len(slo.alerts)} alert(s)"

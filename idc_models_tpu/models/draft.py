@@ -1,0 +1,94 @@
+"""Token drafters for speculative decoding: cheap host-side proposal
+of the next k tokens of a slot's stream, verified (and corrected) by
+the target model's batched verify program (models/lm.py verify forward,
+serve/engine.py verify dispatch).
+
+The drafter contract is deliberately tiny so a small draft LM can slot
+in later:
+
+    drafter.propose(history) -> np.ndarray [k] int32, or None
+
+`history` is the slot's ENTIRE token stream so far — prompt plus every
+emitted token — as a 1-D int array; the return is exactly `k` proposed
+continuation tokens, or None when the drafter has nothing worth
+verifying. A proposal is never trusted: the verify program accepts only
+the prefix the target model itself would have emitted (greedy argmax,
+or the seeded sample, per position), so a BAD drafter costs acceptance
+rate, never correctness — any `propose` implementation is sound.
+
+`NGramDrafter` is prompt-lookup / n-gram drafting (Saxena 2023;
+PLD in vLLM): find the most recent earlier occurrence of the stream's
+trailing n-gram and propose the tokens that followed it. No second
+model, no device work — ideal for the repetitive, templated traffic
+(shared system prompts, retrieval echoes, code) where the continuation
+usually HAS appeared before. On adversarially random streams it simply
+stops proposing (None) and serving falls back to the plain fused
+window (docs/LONG_CONTEXT.md owns the when-it-loses story).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NGramDrafter:
+    """Longest-suffix n-gram lookup over the slot's own stream.
+
+    For n from `order` down to `min_order`, find the LAST position
+    before the end where the stream's trailing n tokens occurred, and
+    propose the `k` tokens that followed that occurrence (recency wins
+    because templated streams drift: the latest occurrence is the best
+    predictor of what follows now). A match whose continuation runs
+    past the end of the history pads by repeating the final history
+    token — padding is verified like any other draft token, so it
+    costs only acceptance. Returns None when no n-gram down to
+    `min_order` recurs (nothing to verify beats verifying noise).
+
+    `lookback` bounds the scan to the stream's most recent N tokens —
+    the drafting pass runs on the serving host's critical path once
+    per scheduler cycle per slot, so it must stay O(lookback), not
+    O(stream). Recency preference makes the truncation cheap: a match
+    only reachable beyond the lookback costs acceptance rate, never
+    correctness. None scans everything."""
+
+    def __init__(self, k: int, *, order: int = 3, min_order: int = 1,
+                 lookback: int | None = 512):
+        if k < 1:
+            raise ValueError(f"need k >= 1 draft tokens, got {k}")
+        if not 1 <= min_order <= order:
+            raise ValueError(f"need 1 <= min_order <= order, got "
+                             f"min_order {min_order}, order {order}")
+        if lookback is not None and lookback < order + 1:
+            raise ValueError(f"lookback {lookback} cannot even hold "
+                             f"one order-{order} match")
+        self.k = int(k)
+        self.order = int(order)
+        self.min_order = int(min_order)
+        self.lookback = None if lookback is None else int(lookback)
+
+    def propose(self, history) -> np.ndarray | None:
+        h = np.asarray(history, np.int64).ravel()
+        if self.lookback is not None and h.shape[0] > self.lookback:
+            h = h[-self.lookback:]
+        length = h.shape[0]
+        for n in range(min(self.order, length - 1), self.min_order - 1,
+                       -1):
+            suffix = h[length - n:]
+            # every window over h[:L-1] starts at i <= L-1-n < L-n, so
+            # the suffix's self-match at L-n (whose "continuation" is
+            # the future being drafted) is excluded by the slice
+            windows = np.lib.stride_tricks.sliding_window_view(
+                h[:length - 1], n)
+            hits = np.flatnonzero((windows == suffix).all(axis=1))
+            if not hits.size:
+                continue
+            i = int(hits[-1])
+            cont = h[i + n:i + n + self.k]
+            if not cont.size:
+                continue
+            if cont.shape[0] < self.k:
+                cont = np.concatenate([
+                    cont, np.full(self.k - cont.shape[0], h[-1],
+                                  np.int64)])
+            return cont.astype(np.int32)
+        return None

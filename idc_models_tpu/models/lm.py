@@ -350,6 +350,38 @@ def _token_forward(cfg: _ServeConfig, ln, params, caches, tok, pos, fold):
     return logits, tuple(new_caches)
 
 
+def _chunk_batch_forward(cfg: _ServeConfig, ln, params, caches, toks,
+                         pos, fold):
+    """C tokens per row through every block — `_token_forward` WIDENED
+    to C positions with PER-ROW start positions: the model half of the
+    speculative verify program. Row b's tokens occupy global positions
+    [pos[b], pos[b] + C); embedding gathers each row's slice of the
+    position table, then per block [pre-LN -> q/k/v projection of the
+    C tokens -> chunk cache fold -> out-projection residual -> pre-LN
+    MLP residual], final LN, vocab head at EVERY position (the verify
+    needs all C next-token distributions, not just the last).
+    `fold(block_idx, kc, vc, q, k, v) -> (o [B,C,H,D], kc, vc)`
+    supplies the cache fold (the batched chunk fold, with liveness and
+    positions closed over by the caller), so this shares every other
+    op with `_token_forward`/`chunk_body` bit-for-bit — the
+    speculative parity contract hinges on that sharing."""
+    b, c = toks.shape
+    idx = jnp.clip(pos[:, None] + jnp.arange(c, dtype=jnp.int32),
+                   0, params["pos"].shape[0] - 1)
+    h = jnp.take(params["embed"], toks, axis=0) + params["pos"][idx]
+    new_caches = []
+    for i in range(cfg.num_blocks):
+        p = params[f"block{i}"]
+        kc, vc = caches[i]
+        q, k, v = _project_qkv(cfg, ln, p, h, (c,))
+        o, kc, vc = fold(i, kc, vc, q, k, v)
+        h = _attn_residual(p, h, o.reshape(b, c, cfg.embed_dim))
+        h = _mlp_residual(ln, p, h)
+        new_caches.append((kc, vc))
+    logits = _final_logits(ln, params, h)                # [B, C, V]
+    return logits, tuple(new_caches)
+
+
 @functools.lru_cache(maxsize=16)
 def _serving_fns(cfg: _ServeConfig) -> _ServeFns:
     """The compile-once serving programs for one decode configuration.

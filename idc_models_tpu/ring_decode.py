@@ -353,6 +353,163 @@ def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
     return jax.jit(checked, donate_argnums=(0, 1))
 
 
+def make_batched_chunk_ring_decode(mesh: Mesh, *,
+                                   axis: str = meshlib.SEQ_AXIS,
+                                   scale: float | None = None,
+                                   jit: bool = False,
+                                   quantized: bool = False):
+    """Per-slot chunk fold for SPECULATIVE VERIFICATION
+    (serve/engine.py): ``fn(k_cache, v_cache, q, k, v, pos, live)
+    -> (out, k_cache, v_cache)`` runs C draft tokens per batch row
+    against the row's ring cache in ONE dispatch, each row an
+    independent sequence at its OWN position — the chunk-query algebra
+    of `make_chunk_ring_decode` crossed with the per-row masking of
+    `make_batched_ring_decode`.
+
+    q/k/v are [B, C, H, D] (replicated over `axis`); `pos` is int32 [B]
+    (row b's chunk occupies global positions [pos[b], pos[b] + C)) and
+    `live` is bool [B]: rows with live=False append NOTHING — their
+    cache shard is bit-untouched, exactly like the one-token batched
+    fold's dead rows, which is what lets non-speculating slots ride
+    through a verify dispatch as bit-level no-ops. Per live row:
+
+    1. splice the chunk's K/V into the row's resident shard slots
+       (positions outside [pos_b, pos_b + C), and every slot of a dead
+       row, keep their stored value);
+    2. attend every chunk query against the row's WHOLE updated shard
+       with per-query causal visibility (cache position <= query
+       position — covers the cached history AND causality inside the
+       chunk, since the chunk's own K/V landed in step 1);
+    3. merge across the ring with the same stable (m, l, acc) softmax
+       algebra as every other fold — two collectives per CHUNK.
+
+    A live row's per-query outputs are therefore exactly what C
+    successive one-token decode folds would produce IF every query's
+    preceding chunk tokens were the tokens actually decoded — which is
+    precisely the speculative accept rule's job to check. Callers own
+    the bound pos[b] + C <= t_max for live rows (an out-of-range splice
+    slot silently drops, the same contract as the scalar fold's traced
+    positions).
+
+    With ``quantized=True`` the caches hold int8 K/V and the signature
+    grows per-(row, head) float32 [B, H] dequant scales, factored out
+    of the contractions exactly as in `make_batched_ring_decode`;
+    appends quantize with the row's frozen insert-time scale. Defaults
+    to ``jit=False`` for tracing into the engine's verify program,
+    whose top-level jit owns donation."""
+    n = mesh.shape[axis]
+
+    def per_device(kc, vc, q, kt, vt, pos, live, k_scale=None,
+                   v_scale=None):
+        b, t_shard, h, d = kc.shape
+        c = q.shape[1]
+        i = collectives.axis_index(axis)
+        scale_ = scale if scale is not None else d ** -0.5
+        pos = jnp.asarray(pos, jnp.int32)
+        live = jnp.asarray(live, jnp.bool_)
+        # finished/riding rows may sit at pos == t_max; clamp keeps the
+        # slot arithmetic in range (the splice is gated on `live`)
+        posc = jnp.clip(pos, 0, n * t_shard - 1)
+        g = i * t_shard + jnp.arange(t_shard, dtype=jnp.int32)
+
+        if quantized:
+            kt = jnp.clip(jnp.round(
+                kt.astype(jnp.float32) / k_scale[:, None, :, None]),
+                -127, 127)
+            vt = jnp.clip(jnp.round(
+                vt.astype(jnp.float32) / v_scale[:, None, :, None]),
+                -127, 127)
+
+        # 1. per-row splice: this shard's slots inside the row's
+        # [pos_b, pos_b + C) span take the chunk row at (g - pos_b);
+        # everything else — including every slot of a dead row —
+        # rewrites itself with itself, bit-untouched
+        take_new = ((g[None, :] >= posc[:, None])
+                    & (g[None, :] < posc[:, None] + c)
+                    & live[:, None])                      # [B, t_shard]
+        src = jnp.clip(g[None, :] - posc[:, None], 0, c - 1)
+
+        def splice(cache, tok):
+            gathered = jnp.take_along_axis(
+                tok, src[:, :, None, None], axis=1).astype(cache.dtype)
+            return jnp.where(take_new[:, :, None, None], gathered,
+                             cache)
+
+        kc = splice(kc, kt)
+        vc = splice(vc, vt)
+        # 2. per-row, per-query local attend against the resident shard
+        qpos = posc[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+        s = jnp.einsum("bchd,bkhd->bhck", q, kc,
+                       preferred_element_type=jnp.float32) * scale_
+        if quantized:
+            s = s * k_scale[:, :, None, None]
+        visible = g[None, None, :] <= qpos[:, :, None]  # [B, C, t_shard]
+        s = jnp.where(visible[:, None], s, _MASKED)
+        m_loc = jnp.max(s, axis=-1)                       # [B, H, C]
+        p = jnp.exp(s - m_loc[..., None])
+        p = jnp.where(visible[:, None], p, 0.0)
+        l_loc = jnp.sum(p, axis=-1)
+        acc_loc = jnp.einsum("bhck,bkhd->bhcd", p, vc,
+                             preferred_element_type=jnp.float32)
+        if quantized:
+            acc_loc = acc_loc * v_scale[:, :, None, None]
+        # 3. one stable softmax merge across the ring (per chunk)
+        m_glob = lax.pmax(m_loc, axis)
+        corr = jnp.exp(m_loc - m_glob)
+        l_glob = collectives.psum(l_loc * corr, axis)
+        acc_glob = collectives.psum(acc_loc * corr[..., None], axis)
+        out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]
+        return jnp.moveaxis(out, 1, 2).astype(q.dtype), kc, vc
+
+    others = tuple(a for a in mesh.axis_names if a != axis)
+    bo = others if others else None
+    cache_spec = P(bo, axis, None, None)
+    tok_spec = P(bo, None, None, None)
+    scale_specs = (P(bo, None), P(bo, None)) if quantized else ()
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(cache_spec, cache_spec, tok_spec, tok_spec, tok_spec,
+                  P(), P()) + scale_specs,
+        out_specs=(tok_spec, cache_spec, cache_spec),
+        check_vma=False,
+    )
+
+    def checked(kc, vc, q, k, v, pos, live, *scales):
+        if quantized and len(scales) != 2:
+            raise ValueError("quantized fold needs (k_scale, v_scale)")
+        if not quantized and scales:
+            raise ValueError("scales passed to a non-quantized fold")
+        if q.ndim != 4 or q.shape[1] < 1:
+            raise ValueError(f"batched chunk fold expects [B, C, H, D] "
+                             f"queries, got shape {jnp.shape(q)}")
+        if kc.shape[1] % n:
+            raise ValueError(
+                f"cache length {kc.shape[1]} not divisible by the ring "
+                f"size {n} over mesh axis {axis!r}")
+        if jnp.shape(pos) != (kc.shape[0],):
+            raise ValueError(
+                f"pos must be one position per row, shape "
+                f"({kc.shape[0]},); got {jnp.shape(pos)}")
+        # reject concrete out-of-range LIVE chunk spans, same contract
+        # as every other fold (a silent dropped splice is the failure)
+        if (isinstance(pos, (np.ndarray, list, tuple))
+                and isinstance(live, (np.ndarray, list, tuple))):
+            p_arr = np.asarray(pos)
+            bad = p_arr[(np.asarray(live))
+                        & ((p_arr < 0)
+                           | (p_arr + q.shape[1] > kc.shape[1]))]
+            if bad.size:
+                raise ValueError(
+                    f"live chunk start {bad.tolist()} + chunk "
+                    f"{q.shape[1]} outside the cache "
+                    f"(t_max {kc.shape[1]})")
+        return mapped(kc, vc, q, k, v, pos, live, *scales)
+
+    if not jit:
+        return checked
+    return jax.jit(checked, donate_argnums=(0, 1))
+
+
 def make_chunk_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
                            scale: float | None = None,
                            jit: bool = False):

@@ -10,6 +10,7 @@ from idc_models_tpu.serve.faults import (  # noqa: F401
 from idc_models_tpu.serve.journal import (  # noqa: F401
     RequestJournal, load_journal, pending_requests,
 )
+from idc_models_tpu.models.draft import NGramDrafter  # noqa: F401
 from idc_models_tpu.serve.metrics import ServingMetrics  # noqa: F401
 from idc_models_tpu.serve.prefix_cache import PrefixCache  # noqa: F401
 from idc_models_tpu.serve.scheduler import (  # noqa: F401

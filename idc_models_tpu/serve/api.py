@@ -100,7 +100,9 @@ class LMServer:
                  kv_dtype: str | None = None, slo=None,
                  retry=None, fault_plan=None,
                  health_checks: bool | None = None, journal=None,
-                 brownout=None, prefix_cache=None):
+                 brownout=None, prefix_cache=None,
+                 spec_decode: bool = False, draft_k: int = 8,
+                 draft_order: int = 3, drafter=None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
@@ -124,6 +126,18 @@ class LMServer:
             prefix_cache = PrefixCache(
                 prefill_chunk, int(prefix_cache_mb * 1024 * 1024),
                 logger=logger)
+        # speculative decoding (ISSUE 10): spec_decode compiles the
+        # fixed-k verify program into the engine and arms the
+        # scheduler's draft-and-verify window mode. The default
+        # drafter is n-gram prompt-lookup (models/draft.py) — no
+        # second model; pass `drafter` (any object with
+        # propose(history) -> k tokens | None) to plug in a draft LM
+        if drafter is not None and not spec_decode:
+            raise ValueError("a custom drafter needs spec_decode=True")
+        if spec_decode and drafter is None:
+            from idc_models_tpu.models.draft import NGramDrafter
+
+            drafter = NGramDrafter(draft_k, order=draft_order)
         self.engine = SlotEngine(
             params, embed_dim=embed_dim, num_heads=num_heads,
             num_blocks=num_blocks, t_max=t_max, n_slots=n_slots,
@@ -132,7 +146,8 @@ class LMServer:
                          else cache_dtype),
             block_impl=block_impl, temperature=temperature, top_k=top_k,
             pad_id=pad_id, eos_id=eos_id, prefill_chunk=prefill_chunk,
-            prefix_cache=prefix_cache, kv_dtype=kv_dtype)
+            prefix_cache=prefix_cache, kv_dtype=kv_dtype,
+            draft_k=draft_k if spec_decode else None)
         # slo: an optional observe.slo.SLOEngine — the metrics hooks
         # feed its declared objectives (ttft/queue_wait/error_rate) and
         # evaluate burn rates once per scheduler cycle
@@ -159,7 +174,7 @@ class LMServer:
             admit_after_collect=admit_after_collect,
             metrics=self.metrics, clock=clock, retry=retry,
             fault_plan=fault_plan, health_checks=health_checks,
-            journal=journal, brownout=brownout)
+            journal=journal, brownout=brownout, drafter=drafter)
         self._results: dict[str, Result] = {}
         self._inflight: set[str] = set()
         if warmup:

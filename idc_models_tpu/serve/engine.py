@@ -68,10 +68,13 @@ from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
 from idc_models_tpu.observe import trace
 from idc_models_tpu.models.lm import (
-    _make_pick, _place_params, _serve_config, _serving_fns,
-    _token_forward, check_prefill_chunk, prefill_bucket, prefill_buckets,
+    _chunk_batch_forward, _make_pick, _place_params, _serve_config,
+    _serving_fns, _token_forward, check_prefill_chunk, prefill_bucket,
+    prefill_buckets,
 )
-from idc_models_tpu.ring_decode import make_batched_ring_decode
+from idc_models_tpu.ring_decode import (
+    make_batched_chunk_ring_decode, make_batched_ring_decode,
+)
 
 
 def _key_data(rng) -> np.ndarray:
@@ -128,6 +131,9 @@ class _EngineFns(NamedTuple):
     #                    kscales, vscales, W)
     insert: object    # (state..., new_caches, new_logits, slot, ...)
     health: object    # (logits) -> [S] int32 fault code
+    verify: object    # (params, state..., drafts, vlive) ->
+    #                   (toks, n_emit, n_acc, state...); None unless
+    #                   the engine was built with draft_k
 
 
 # a last-token logit past this magnitude is corruption, not a model
@@ -139,7 +145,8 @@ HEALTH_KINDS = {1: "nonfinite_logits", 2: "logit_magnitude"}
 
 
 @functools.lru_cache(maxsize=16)
-def _engine_fns(cfg, pad_id: int, quant: bool = False) -> _EngineFns:
+def _engine_fns(cfg, pad_id: int, quant: bool = False,
+                draft_k: int | None = None) -> _EngineFns:
     """Compile-once engine programs per decode configuration — the same
     process-wide sharing discipline as `models/lm._serving_fns`: params
     are explicit arguments, so two engines with one config share every
@@ -319,7 +326,136 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False) -> _EngineFns:
                          jnp.where(huge, 2, 0)).astype(jnp.int32)
 
     health = jax.jit(health_body)
-    return _EngineFns(init_caches, init_scales, window, insert, health)
+
+    verify = None
+    if draft_k is not None:
+        K = int(draft_k)
+        chunk_fold = make_batched_chunk_ring_decode(mesh, jit=False,
+                                                    quantized=quant)
+
+        def verify_body(params, caches, logits, kd, pos, remaining,
+                        eos, scales, drafts, vlive):
+            # SPECULATIVE VERIFY — one dispatch turns K drafted tokens
+            # per slot into between 1 and K+1 EMITTED tokens per
+            # participating slot:
+            #   1. run all K drafts through the per-token forward
+            #      widened to K positions (the batched chunk fold
+            #      appends their K/V and attends with per-query
+            #      causality), yielding the model's next-token logits
+            #      after each draft prefix;
+            #   2. accept the longest draft prefix the model itself
+            #      would have emitted (the pick rule per position —
+            #      greedy argmax, or the seeded sample along the
+            #      request's exact key chain), then take the model's
+            #      OWN pick at the first disagreement as a bonus
+            #      token — so even a total draft miss emits exactly
+            #      the token a 1-step window would, bit-identically;
+            #   3. run ONE masked token step for the bonus (its K/V
+            #      lands at pos + accepted, overwriting the rejected
+            #      draft's row) — the logits every slot decodes from
+            #      next, restoring the window invariant exactly.
+            # Rejected-suffix cache rows beyond each slot's new
+            # frontier hold dead draft K/V, masked out of every later
+            # attend by the positional visibility rule and overwritten
+            # before they ever become visible — the same discipline as
+            # the batched decode path's dead rows. All accept/budget/
+            # EOS bookkeeping happens ON DEVICE; the host learns the
+            # outcome from the fetched (toks, n_emit, n_acc) rows.
+            s_rows = drafts.shape[0]
+            live = jnp.asarray(vlive, jnp.bool_) & (remaining > 0)
+
+            def block_chunk_fold(i, kc, vc, q, k, v):
+                extra = (scales[i] if quant else ())
+                return chunk_fold(kc, vc, q, k, v, pos, live, *extra)
+
+            L, caches = _chunk_batch_forward(cfg, ln, params, caches,
+                                             drafts, pos,
+                                             block_chunk_fold)
+            # K+1 candidate distributions along the accepted path:
+            # cand[:, 0] is the slot's incoming logits (predicting the
+            # first draft position), cand[:, j] the logits after
+            # drafts[:, :j]
+            cand = jnp.concatenate(
+                [logits.astype(L.dtype)[:, None], L], axis=1)
+            if cfg.temperature == 0.0:
+                flat = cand.reshape(-1, cand.shape[-1])
+                g = jax.vmap(lambda lg: pick(lg[None, :], None)[0])(
+                    flat).reshape(s_rows, K + 1).astype(jnp.int32)
+                kd_chain = None
+            else:
+                # the request's exact serial key chain: one split per
+                # candidate step, token j sampled with split j's sub —
+                # identical math and order to the fused window's
+                # per-step vmapped split + pick
+                def samp(kd_c, lg_j):
+                    pair = jax.vmap(jax.random.split)(
+                        jax.random.wrap_key_data(kd_c))
+                    t = jax.vmap(
+                        lambda lg, kk: pick(lg[None, :], kk)[0])(
+                        lg_j, pair[:, 1])
+                    kd_n = jax.random.key_data(pair[:, 0])
+                    return kd_n, (t, kd_n)
+
+                _, (g_t, chain) = lax.scan(samp, kd,
+                                           jnp.moveaxis(cand, 0, 1))
+                g = jnp.moveaxis(g_t, 0, 1).astype(jnp.int32)
+                kd_chain = jnp.moveaxis(chain, 0, 1)     # [S, K+1, 2]
+            # accepted prefix length m, the bonus pick g[m], and the
+            # emitted count n_f after budget + EOS truncation
+            matches = drafts.astype(jnp.int32) == g[:, :K]
+            m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1),
+                        axis=1)
+            b = jnp.take_along_axis(g, m[:, None], axis=1)[:, 0]
+            cand_n = jnp.where(live,
+                               jnp.minimum(m + 1, remaining), 0)
+            ar = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+            drafts_ext = jnp.concatenate(
+                [drafts.astype(jnp.int32),
+                 jnp.zeros((s_rows, 1), jnp.int32)], axis=1)
+            emitted = jnp.where(
+                ar < m[:, None], drafts_ext,
+                jnp.where(ar == m[:, None], b[:, None], pad_id))
+            is_eos = ((eos[:, None] >= 0) & (emitted == eos[:, None])
+                      & (ar < cand_n[:, None]))
+            any_eos = jnp.any(is_eos, axis=1)
+            first = jnp.argmax(is_eos, axis=1).astype(cand_n.dtype)
+            n_f = jnp.where(any_eos, first + 1, cand_n)
+            n_acc = jnp.minimum(m, n_f)
+            toks = jnp.where(ar < n_f[:, None], emitted,
+                             pad_id).astype(jnp.int32)
+            # the bonus token's own masked step (appends at pos + m)
+            bonus_live = live & (n_f == m + 1)
+            bpos = jnp.clip(pos + m, 0, t_max - 1)
+
+            def block_tok_fold(i, kc, vc, q, k, v):
+                extra = (scales[i] if quant else ())
+                return fold(kc, vc, q, k, v, bpos, bonus_live, *extra)
+
+            b_logits, caches = _token_forward(cfg, ln, params, caches,
+                                              b, bpos, block_tok_fold)
+            after = jnp.take_along_axis(
+                cand, jnp.clip(n_f, 0, K)[:, None, None], axis=1)[:, 0]
+            new_logits = jnp.where(bonus_live[:, None],
+                                   b_logits.astype(logits.dtype),
+                                   after.astype(logits.dtype))
+            logits = jnp.where(live[:, None], new_logits, logits)
+            pos = jnp.where(live, pos + n_f, pos)
+            remaining = jnp.where(
+                live, jnp.where(any_eos, 0, remaining - n_f), remaining)
+            if kd_chain is not None:
+                kd_take = jnp.take_along_axis(
+                    kd_chain, jnp.clip(n_f - 1, 0, K)[:, None, None],
+                    axis=1)[:, 0]
+                kd = jnp.where(live[:, None], kd_take, kd)
+            caches, logits = pin_state(caches, logits)
+            return (toks, n_f.astype(jnp.int32),
+                    n_acc.astype(jnp.int32), caches, logits, kd, pos,
+                    remaining)
+
+        verify = jax.jit(verify_body, donate_argnums=(1, 2, 3, 4, 5))
+
+    return _EngineFns(init_caches, init_scales, window, insert, health,
+                      verify)
 
 
 class SlotEngine:
@@ -346,9 +482,22 @@ class SlotEngine:
                  top_k: int | None = None, pad_id: int = 0,
                  eos_id: int | None = None,
                  prefill_chunk: int | None = None,
-                 prefix_cache=None, kv_dtype: str | None = None):
+                 prefix_cache=None, kv_dtype: str | None = None,
+                 draft_k: int | None = None):
         if n_slots < 1:
             raise ValueError(f"need n_slots >= 1, got {n_slots}")
+        # draft_k arms speculative decoding: the engine compiles ONE
+        # extra fixed-shape program (verify at exactly K draft tokens
+        # per slot) and exposes begin_verify as an alternative window
+        # dispatch; None keeps the historical engine bit-for-bit
+        if draft_k is not None:
+            draft_k = int(draft_k)
+            if not 1 <= draft_k <= t_max - 2:
+                raise ValueError(
+                    f"draft_k {draft_k} outside [1, t_max - 2]: a "
+                    f"verify needs room for K drafts + the bonus "
+                    f"token inside the {t_max}-slot cache")
+        self.draft_k = draft_k
         # kv_dtype: None/"bf16" keeps the float ring cache rows
         # (cache_dtype, the historical path bit-for-bit); "int8" stores
         # quantized rows + per-(slot, head) scales — ~2x the slots per
@@ -412,7 +561,8 @@ class SlotEngine:
                 f"a time ([1, P] batches cannot shard over axes "
                 f"{non_seq}); build the engine on mesh.seq_mesh(n)")
         self._sfns = _serving_fns(self._cfg)
-        self._efns = _engine_fns(self._cfg, int(pad_id), self.kv_int8)
+        self._efns = _engine_fns(self._cfg, int(pad_id), self.kv_int8,
+                                 self.draft_k)
         self._params = _place_params(params, self._cfg.mesh)
         self._n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
         self.t_max = t_max
@@ -446,6 +596,10 @@ class SlotEngine:
         self._eos_h = np.full(n_slots, -1, np.int64)
         self._occupied = np.zeros(n_slots, bool)
         self._pending = None     # (toks_dev, rem_snapshot, occ_snapshot)
+        # rollup of the most recently COLLECTED verify dispatch
+        # ({drafted, accepted, emitted, slots}); None after a plain
+        # window — the scheduler's metrics hook reads it per collect
+        self.last_spec = None
         # in-progress chunked prefills: slot -> _PendingPrefill. These
         # slots are RESERVED (excluded from free_slots, not yet decoded
         # by windows) until the final chunk lands and insert scatters
@@ -667,6 +821,75 @@ class SlotEngine:
                               self._scales, n_steps))
         self._pending = (toks, snapshot)
 
+    def spec_room(self, slot: int) -> bool:
+        """True when `slot` has cache room for a full verify — K draft
+        appends plus the bonus token's append all land inside t_max.
+        Slots without room (within draft_k tokens of the cache edge,
+        hence within draft_k + 1 of finishing) must decode through
+        plain windows instead; the scheduler's policy falls back for
+        the whole batch so no slot starves behind its speculating
+        neighbors."""
+        if self.draft_k is None:
+            return False
+        return bool(self._pos_h[slot] + self.draft_k + 1 <= self.t_max)
+
+    def begin_verify(self, drafts, vlive, proposed=None) -> None:
+        """Dispatch ONE speculative verify (async, collected like a
+        window): `drafts` is int32 [n_slots, draft_k] and `vlive` bool
+        [n_slots] marks the participating rows. Every vlive row must
+        be occupied, have budget left, and satisfy `spec_room`;
+        non-participating rows ride along bit-untouched. Each vlive
+        row emits between 1 and draft_k + 1 tokens — the accepted
+        draft prefix plus the model's own pick at the first
+        disagreement — so a row whose drafts all miss still advances
+        exactly one (bit-identical) token.
+
+        `proposed` (bool [n_slots], default = vlive, must be a subset
+        of it) marks the rows whose drafts came from a REAL drafter
+        proposal rather than the scheduler's ride-along placeholder —
+        only those rows enter the `last_spec` drafted/accepted ledger,
+        so acceptance rate and tokens-per-dispatch score speculation
+        itself, undiluted by slots that merely rode along for their
+        one window-equivalent token."""
+        if self.draft_k is None:
+            raise RuntimeError("engine built without draft_k — "
+                               "speculative decoding is not armed")
+        if self._pending is not None:
+            raise RuntimeError("a window is already in flight — "
+                               "collect() it first")
+        drafts = np.asarray(drafts, np.int32)
+        vlive = np.asarray(vlive, bool)
+        if drafts.shape != (self.n_slots, self.draft_k):
+            raise ValueError(
+                f"drafts must be [{self.n_slots}, {self.draft_k}], "
+                f"got {drafts.shape}")
+        if vlive.shape != (self.n_slots,):
+            raise ValueError(f"vlive must be [{self.n_slots}], got "
+                             f"{vlive.shape}")
+        proposed = (vlive if proposed is None
+                    else np.asarray(proposed, bool))
+        if proposed.shape != vlive.shape or (proposed & ~vlive).any():
+            raise ValueError("proposed must be a [n_slots] subset of "
+                             "vlive")
+        for s in np.flatnonzero(vlive):
+            if not self._occupied[s] or self._rem_h[s] < 1:
+                raise ValueError(f"verify slot {int(s)} is not "
+                                 f"occupied with budget left")
+            if not self.spec_room(int(s)):
+                raise ValueError(
+                    f"verify slot {int(s)} at pos {self._pos_h[s]} "
+                    f"lacks room for {self.draft_k} drafts + the "
+                    f"bonus before t_max {self.t_max}")
+        snapshot = (self._rem_h.copy(), self._occupied.copy(),
+                    self._eos_h.copy())
+        (toks, n_emit, n_acc, self._caches, self._logits, self._kd,
+         self._pos, self._rem) = self._efns.verify(
+            self._params, self._caches, self._logits, self._kd,
+            self._pos, self._rem, self._eos, self._scales, drafts,
+            vlive)
+        self._pending = (toks, snapshot, (n_emit, n_acc, vlive,
+                                          proposed))
+
     def abort_window(self) -> None:
         """Discard an in-flight window without collecting it — the
         failure-cleanup hook (scheduler._abort_running): after an
@@ -683,9 +906,14 @@ class SlotEngine:
         an EOS hit zeroes the remaining budget after emitting. Returns
         {slot: tokens emitted} for slots occupied when the window was
         dispatched."""
+        # reset FIRST: a no-op collect (or a window's) must not leave a
+        # previous verify's rollup answering for it — warmup's dead
+        # verify would otherwise leak a zero-slot record into the
+        # first real cycle's metrics
+        self.last_spec = None
         if self._pending is None:
             return {}
-        toks, (rem_before, occupied, eos_h) = self._pending
+        toks, (rem_before, occupied, eos_h), *spec = self._pending
         self._pending = None
         # the ONE host transfer — and the point where the serve loop
         # BLOCKS on the in-flight window's device execution, so it is
@@ -694,7 +922,39 @@ class SlotEngine:
         # tracer is armed)
         with trace.span("device.sync"):
             toks = np.asarray(toks)
+            if spec:
+                n_emit = np.asarray(spec[0][0])
+                n_acc = np.asarray(spec[0][1])
         out = {}
+        if spec:
+            # verify collect: the device already applied budget + EOS
+            # truncation (n_emit is the exact emitted count, EOS
+            # inclusive); the host replays the same retirement rule on
+            # its shadows from the fetched counts
+            vlive, proposed = spec[0][2], spec[0][3]
+            # ledger over PROPOSED rows only: ride-along placeholders
+            # would dilute the acceptance figures operators tune by
+            self.last_spec = {
+                "drafted": int(proposed.sum()) * self.draft_k,
+                "accepted": int(n_acc[proposed].sum()),
+                "emitted": int(n_emit[proposed].sum()),
+                "slots": int(proposed.sum()),
+            }
+            for s in range(self.n_slots):
+                if not occupied[s]:
+                    continue
+                if not vlive[s]:
+                    out[s] = []          # rode along bit-untouched
+                    continue
+                n = int(n_emit[s])
+                row = [int(t) for t in toks[s, :n]]
+                if eos_h[s] >= 0 and eos_h[s] in row:
+                    self._rem_h[s] = 0
+                else:
+                    self._rem_h[s] = rem_before[s] - n
+                self._pos_h[s] += n
+                out[s] = row
+            return out
         for s in range(self.n_slots):
             if not occupied[s]:
                 continue
@@ -767,6 +1027,8 @@ class SlotEngine:
                "health": self._efns.health._cache_size()}
         if self.prefill_chunk is not None:
             out["prefill_chunk"] = self._sfns.prefill_chunk._cache_size()
+        if self.draft_k is not None:
+            out["verify"] = self._efns.verify._cache_size()
         return out
 
     def program_costs(self, window: int) -> dict:
@@ -804,6 +1066,20 @@ class SlotEngine:
                         self._params,
                         np.zeros((1, self.t_max), np.int32),
                         np.int32(self.t_max)).compile())
+            if self.draft_k is not None:
+                # the speculative verify — the model-level draft-check
+                # forward (models/lm._chunk_batch_forward + the bonus
+                # token step), named alongside lm.prefill/lm.decode so
+                # the profile verb's roofline verdicts cover it
+                out["lm.verify"] = prof.register_program(
+                    "lm.verify",
+                    self._efns.verify.lower(
+                        self._params, self._caches, self._logits,
+                        self._kd, self._pos, self._rem, self._eos,
+                        self._scales,
+                        np.zeros((self.n_slots, self.draft_k),
+                                 np.int32),
+                        np.zeros(self.n_slots, bool)).compile())
         return out
 
     def warmup(self, n_steps: int) -> None:
@@ -845,6 +1121,15 @@ class SlotEngine:
                 np.int32(0), np.int32(1), np.int32(0), np.int32(-1),
                 np.zeros(2, np.uint32))
             self.step_window(n_steps)
+            if self.draft_k is not None:
+                # the verify program at its ONE fixed shape, chained
+                # off both the insert's and the window's (pinned)
+                # outputs; every row dead, so the dispatch is a
+                # bit-level no-op like the warmup windows
+                self.begin_verify(
+                    np.zeros((self.n_slots, self.draft_k), np.int32),
+                    np.zeros(self.n_slots, bool))
+                self.collect()
         # the health reduce is part of the armed serve loop's steady
         # state (one dispatch per cycle) — warm it with everything else
         self.slot_health()

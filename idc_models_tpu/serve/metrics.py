@@ -101,8 +101,31 @@ class ServingMetrics:
             "serve_faults_injected_total",
             "declarative serve faults fired by an armed ServeFaultPlan,"
             " by kind", labels=("kind",))
+        # speculative decoding (ISSUE 10): decode dispatches by kind
+        # (window vs verify) and the drafted/accepted token ledger —
+        # acceptance rate and tokens-per-dispatch derive from these
+        self._m_dispatches = reg.counter(
+            "serve_decode_dispatches_total",
+            "decode dispatches by kind: 'window' (fused one-token-per-"
+            "step scan) or 'verify' (speculative draft-and-verify)",
+            labels=("kind",))
+        self._m_spec_drafted = reg.counter(
+            "serve_spec_drafted_tokens_total",
+            "draft tokens submitted to speculative verify dispatches")
+        self._m_spec_accepted = reg.counter(
+            "serve_spec_accepted_tokens_total",
+            "draft tokens the verify accepted (emitted as-is)")
         self._jit_cache_seen: int | None = None
         self.compiles_observed = 0
+        # speculative rollup: dispatch counts by kind plus the draft
+        # ledger (slot_verifies = per-slot participations, the
+        # denominator of the per-slot tokens-per-dispatch figure)
+        self.window_dispatches = 0
+        self.verify_dispatches = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_slot_verifies = 0
         self.submitted = 0
         self.rejected = 0
         self.timed_out = 0
@@ -235,6 +258,43 @@ class ServingMetrics:
         self._m_faults_injected.inc(kind=kind)
         self._log(event="serve_fault_injected", kind=kind, tick=tick)
 
+    # -- speculative decoding --------------------------------------------
+
+    def on_dispatch(self, kind: str) -> None:
+        """One decode dispatch was COLLECTED: kind is 'window' (the
+        fused one-token-per-step scan) or 'verify' (speculative
+        draft-and-verify). Counted at collect, not at dispatch, so an
+        aborted in-flight dispatch (engine failure mid-drill) whose
+        tokens never land does not skew the denominator. The shared
+        tokens-per-dispatch definition (summary) divides emitted
+        tokens by this count, so spec-on and spec-off runs compare on
+        one denominator."""
+        if kind == "verify":
+            self.verify_dispatches += 1
+        else:
+            self.window_dispatches += 1
+        self._m_dispatches.inc(kind=kind)
+
+    def on_spec(self, *, drafted: int, accepted: int, emitted: int,
+                slots: int) -> None:
+        """A verify dispatch was collected: `drafted` tokens proposed
+        across `slots` genuinely PROPOSING rows (ride-along slots the
+        drafter declined are excluded — they would dilute the rates
+        operators tune by), `accepted` of them emitted as-is,
+        `emitted` those rows' total tokens out (accepted + one bonus
+        pick per row that had budget for it). New event type only —
+        the frozen serve.jsonl schemas are untouched."""
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+        self.spec_emitted += emitted
+        self.spec_slot_verifies += slots
+        if drafted:
+            self._m_spec_drafted.inc(drafted)
+        if accepted:
+            self._m_spec_accepted.inc(accepted)
+        self._log(event="serve_spec_verify", drafted=drafted,
+                  accepted=accepted, emitted=emitted, slots=slots)
+
     # -- engine cycle ----------------------------------------------------
 
     def on_cycle(self, *, queue_depth: int, occupancy: float,
@@ -323,6 +383,31 @@ class ServingMetrics:
             "serve_shed": self.shed,
             "serve_clamped": self.clamped,
             "serve_faults_injected": self.faults_injected,
+            # speculative rollup (additive, ISSUE 10). The SHARED
+            # tokens-per-dispatch definition — emitted tokens over
+            # decode dispatches of EITHER kind — so spec-on and
+            # spec-off runs compare on one denominator; the spec-only
+            # figures isolate the verify path: accept rate over
+            # drafted tokens, and emitted tokens per participating
+            # SLOT per verify (>1 means speculation beat one-token-
+            # per-step decode for the slots that ran it)
+            "serve_decode_dispatches": (self.window_dispatches
+                                        + self.verify_dispatches),
+            "serve_tokens_per_dispatch": (
+                round(self.tokens_out
+                      / (self.window_dispatches
+                         + self.verify_dispatches), 3)
+                if self.window_dispatches + self.verify_dispatches
+                else None),
+            "serve_spec_verify_dispatches": self.verify_dispatches,
+            "serve_spec_drafted": self.spec_drafted,
+            "serve_spec_accepted": self.spec_accepted,
+            "serve_spec_accept_rate": (
+                round(self.spec_accepted / self.spec_drafted, 4)
+                if self.spec_drafted else None),
+            "serve_spec_tokens_per_dispatch": (
+                round(self.spec_emitted / self.spec_slot_verifies, 3)
+                if self.spec_slot_verifies else None),
         }
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.summary())

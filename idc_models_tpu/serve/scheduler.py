@@ -44,6 +44,8 @@ import os
 import time
 from collections import deque
 
+import numpy as np
+
 from idc_models_tpu.observe import profile as prof
 from idc_models_tpu.observe import trace
 from idc_models_tpu.serve.engine import HEALTH_KINDS
@@ -184,11 +186,23 @@ class Scheduler:
                  admit_after_collect: bool = True, clock=time.monotonic,
                  retry=None, fault_plan=None,
                  health_checks: bool | None = None, journal=None,
-                 brownout=None):
+                 brownout=None, drafter=None):
         if window < 1:
             raise ValueError(f"need window >= 1, got {window}")
         self.engine = engine
         self.window = window
+        # speculative window mode (ISSUE 10): with a drafter AND an
+        # engine built with draft_k, each cycle's decode dispatch may
+        # be a VERIFY (k drafted tokens + the model's own correction
+        # per slot, one dispatch) instead of the one-token-per-step
+        # fused window — the policy lives in _propose_drafts
+        self.drafter = drafter
+        self._spec = (drafter is not None
+                      and getattr(engine, "draft_k", None) is not None)
+        if drafter is not None and not self._spec:
+            raise ValueError(
+                "a drafter needs an engine built with draft_k — the "
+                "verify program is compiled at that fixed draft length")
         self.queue = AdmissionQueue(max_queue_depth)
         self.max_prefills_per_cycle = max(int(max_prefills_per_cycle), 1)
         self.metrics = metrics
@@ -610,6 +624,20 @@ class Scheduler:
                 raise
             _sp.set(slots=len(out),
                     tokens=sum(len(t) for t in out.values()))
+            # dispatch accounting happens HERE, at collect, not at
+            # dispatch: a dispatch aborted mid-flight (engine failure,
+            # crash drill) never lands tokens, so counting it would
+            # permanently skew tokens-per-dispatch and break the
+            # "spec events == verify dispatches" invariant. A window
+            # over a non-empty running set always returns rows, so
+            # `out or spec` detects exactly the collected dispatches.
+            spec = getattr(self.engine, "last_spec", None)
+            if (out or spec) and self.metrics:
+                self.metrics.on_dispatch("verify" if spec else "window")
+            # a collected VERIFY reports its accept bookkeeping
+            # (drafted/accepted/emitted, fetched with the tokens)
+            if spec and self.metrics:
+                self.metrics.on_spec(**spec)
         t_now = self.clock()
         got: list[tuple[Entry, list]] = []
         finished: list[Entry] = []
@@ -669,23 +697,42 @@ class Scheduler:
                 self._abort_running(e)
                 raise
             prefill_stall_s += self.clock() - t_pf2
-        # 6. dispatch the next window over every occupied slot
+        # 6. dispatch the next window over every occupied slot — the
+        #    plain fused window, or (speculative mode, when the
+        #    drafter proposed and every running slot has verify room)
+        #    ONE draft-and-verify dispatch emitting up to draft_k + 1
+        #    tokens per slot
         occupancy = len(self._running) / self.engine.n_slots
         if self._running:
             try:
-                # the span covers the (async) window DISPATCH — device
+                proposal = (self._propose_drafts(got) if self._spec
+                            else None)
+                # the spans cover the (async) DISPATCH — device
                 # execution overlaps the deferred bookkeeping below and
                 # is paid for inside the NEXT tick's serve.collect
-                with trace.span("serve.window", window=self.window,
-                                slots=len(self._running)) as _wsp:
-                    if trace.get_tracer() is not None:
-                        # the decode-window leg of each rid's lifecycle
-                        # chain — the list is built only when a tracer
-                        # is armed (disabled-path cost stays one global
-                        # read, gated by bench_tracer_overhead)
-                        _wsp.set(rids=[e.rid
-                                       for e in self._running.values()])
-                    self.engine.begin_window(self.window)
+                if proposal is not None:
+                    drafts, vlive, proposed = proposal
+                    with trace.span("serve.verify",
+                                    k=self.engine.draft_k,
+                                    slots=int(vlive.sum()),
+                                    hits=int(proposed.sum())) as _wsp:
+                        if trace.get_tracer() is not None:
+                            _wsp.set(rids=[e.rid for e
+                                           in self._running.values()])
+                        self.engine.begin_verify(drafts, vlive,
+                                                 proposed)
+                else:
+                    with trace.span("serve.window", window=self.window,
+                                    slots=len(self._running)) as _wsp:
+                        if trace.get_tracer() is not None:
+                            # the decode-window leg of each rid's
+                            # lifecycle chain — the list is built only
+                            # when a tracer is armed (disabled-path
+                            # cost stays one global read, gated by
+                            # bench_tracer_overhead)
+                            _wsp.set(rids=[e.rid for e
+                                           in self._running.values()])
+                        self.engine.begin_window(self.window)
             except Exception as e:
                 # entries the just-collected window COMPLETED (EOS/
                 # budget/deadline) are real results, not casualties:
@@ -721,6 +768,61 @@ class Scheduler:
             if on_jit is not None and sizes is not None:
                 on_jit(sum(sizes().values()))
         return done
+
+    def _propose_drafts(self, got):
+        """The speculative policy pass — pure host work in the
+        device-idle gap before the next dispatch. Builds each running
+        slot's FULL stream (prompt + bookkept tokens + this cycle's
+        just-collected window, exactly the device state the next
+        dispatch continues from), asks the drafter for k-token
+        proposals, and returns (drafts [S, k], vlive [S],
+        proposed [S]) for a verify dispatch — `proposed` marking the
+        rows with a REAL proposal, so the engine's accept ledger
+        scores speculation undiluted by ride-alongs — or None to fall
+        back to the plain fused window, bit-identically, when:
+
+        - no slot proposed (verifying nothing but bonus picks emits
+          one token per slot — strictly worse than a W-token window
+          on adversarially unpredictable traffic), or
+        - ANY running slot lacks verify room (`engine.spec_room`): it
+          would emit nothing while its neighbors speculate. Such a
+          slot is within draft_k + 1 tokens of its cache edge — and
+          admission bounds prompt + budget by t_max, so it is about
+          to finish; the fallback is brief by construction.
+
+        Slots that have room but no proposal still participate
+        (vlive) with zeroed drafts: a verify row whose drafts all
+        miss emits exactly the one token a window step would."""
+        eng = self.engine
+        k = eng.draft_k
+        # room check FIRST, across every slot: one slot without room
+        # vetoes the whole verify, so drafting before knowing that
+        # would throw completed history scans away
+        for slot in self._running:
+            if not eng.spec_room(slot):
+                return None
+        just = {id(e): t for e, t in got}
+        drafts = np.zeros((eng.n_slots, k), np.int32)
+        vlive = np.zeros(eng.n_slots, bool)
+        proposed = np.zeros(eng.n_slots, bool)
+        for slot, e in self._running.items():
+            vlive[slot] = True
+            hist = np.concatenate([
+                np.asarray(e.prompt, np.int64).ravel(),
+                np.asarray(e.tokens + just.get(id(e), []), np.int64)])
+            prop = self.drafter.propose(hist)
+            if prop is None:
+                continue
+            prop = np.asarray(prop, np.int32).ravel()
+            if prop.shape[0] != k:
+                raise ValueError(
+                    f"drafter proposed {prop.shape[0]} tokens; the "
+                    f"verify program is compiled at exactly {k}")
+            drafts[slot] = prop
+            proposed[slot] = True
+        if not proposed.any():
+            return None
+        return drafts, vlive, proposed
 
     def drain(self) -> list[Entry]:
         """Tick until every queued and running request has finished."""

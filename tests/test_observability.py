@@ -315,6 +315,11 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
         m.on_shed("r3")
         m.on_clamp("r4", asked=64, clamp=8)
         m.on_fault_injected("stall", tick=3)
+        # ISSUE-10 speculative hooks: one NEW event type, frozen from
+        # day one; dispatch counting logs nothing
+        m.on_dispatch("window")
+        m.on_dispatch("verify")
+        m.on_spec(drafted=8, accepted=5, emitted=7, slots=2)
     recs = [json.loads(l) for l in open(log)]
     by_event = {r["event"]: r for r in recs}
     # the historical event set + per-event keys, byte-for-byte names
@@ -322,7 +327,8 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
                              "serve_admit", "serve_first_token",
                              "serve_finish", "serve_slot_fault",
                              "serve_retry", "serve_shed",
-                             "serve_clamp", "serve_fault_injected"}
+                             "serve_clamp", "serve_fault_injected",
+                             "serve_spec_verify"}
     assert set(by_event["serve_submit"]) == {"ts", "event", "id"}
     assert set(by_event["serve_admit"]) == {"ts", "event", "id",
                                             "queue_wait_ms"}
@@ -341,6 +347,10 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
                                             "max_new_tokens", "asked"}
     assert set(by_event["serve_fault_injected"]) == {"ts", "event",
                                                      "kind", "tick"}
+    # the ISSUE-10 speculative event, frozen from day one
+    assert set(by_event["serve_spec_verify"]) == {"ts", "event",
+                                                  "drafted", "accepted",
+                                                  "emitted", "slots"}
     # the historical summary keys all still present
     s = m.summary()
     for k in ("serve_requests", "serve_rejected", "serve_timed_out",
@@ -355,10 +365,20 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
               "serve_prefill_stall_ms_max",
               # the ISSUE-8 additive resilience rollup
               "serve_slot_faults", "serve_retries", "serve_shed",
-              "serve_clamped", "serve_faults_injected"):
+              "serve_clamped", "serve_faults_injected",
+              # the ISSUE-10 additive speculative rollup (incl. the
+              # SHARED tokens-per-dispatch definition both modes use)
+              "serve_decode_dispatches", "serve_tokens_per_dispatch",
+              "serve_spec_verify_dispatches", "serve_spec_drafted",
+              "serve_spec_accepted", "serve_spec_accept_rate",
+              "serve_spec_tokens_per_dispatch"):
         assert k in s, k
     assert s["serve_slot_faults"] == 1 and s["serve_retries"] == 1
     assert s["serve_shed"] == 1 and s["serve_clamped"] == 1
+    assert s["serve_decode_dispatches"] == 2
+    assert s["serve_tokens_per_dispatch"] == 1.5   # 3 tokens / 2
+    assert s["serve_spec_accept_rate"] == 0.625    # 5 / 8 drafted
+    assert s["serve_spec_tokens_per_dispatch"] == 3.5  # 7 / 2 slots
 
 
 def test_fed_driver_round_health_schema_unchanged(tmp_path):

@@ -464,6 +464,44 @@ def test_int8_kv_capacity_and_bounded_drift(devices, params):
                                             r.max_new_tokens), r.id
 
 
+def test_spec_decode_token_parity_and_no_recompile(devices, params):
+    """The ISSUE-10 extension of the acceptance pair: with speculative
+    decoding armed (n-gram prompt-lookup drafter, fixed-k verify
+    program), greedy requests of VARYING prompt lengths — repetitive
+    prompts that draft-hit and random ones that mostly miss or fall
+    back to plain windows — must (a) emit tokens bit-identical to
+    serial Generator calls and (b) grow no jit cache entry (the verify
+    included) after the warmup + first admission wave."""
+    server = LMServer(params, n_slots=3, window=4, spec_decode=True,
+                      draft_k=4, **_kw())
+    rng = np.random.default_rng(29)
+    reqs = []
+    for i in range(8):
+        if i % 2:                       # repetitive: the drafter's food
+            pat = [int(x) for x in rng.integers(0, VOCAB, 2 + i % 3)]
+            prompt = tuple((pat * 6)[:5 + 2 * i])
+        else:                           # random: misses and fallbacks
+            prompt = tuple(int(x) for x in
+                           rng.integers(0, VOCAB, 3 + 2 * i))
+        reqs.append(Request(id=f"sp{i}", prompt=prompt,
+                            max_new_tokens=4 + (i % 5) * 2))
+    server.run([(0.0, r) for r in reqs[:2]])
+    sizes = server.engine.cache_sizes()
+    assert "verify" in sizes
+    server.run([(0.0, r) for r in reqs[2:]])
+    assert server.engine.cache_sizes() == sizes, (
+        server.engine.cache_sizes(), sizes)
+    gen = Generator(params, **_kw())
+    for r in reqs:
+        got = server.poll(r.id)
+        assert got is not None and got.status == "ok"
+        want = _serial_tokens(gen, r.prompt, r.max_new_tokens)
+        assert got.tokens == want, (r.id, got.tokens, want)
+    # speculation actually ran (the drafts proposed and verified);
+    # correctness above never depended on it
+    assert server.summary()["serve_spec_verify_dispatches"] > 0
+
+
 def test_engine_failure_releases_slots_and_surfaces_error(devices, params):
     """Satellite contract: if the engine fails mid-tick, the in-flight
     requests become status="error" Results (with the failure detail),
