@@ -1137,6 +1137,153 @@ def bench_serving_speculative(on_accelerator: bool):
     }
 
 
+def bench_serving_paged_kv(on_accelerator: bool):
+    """Paged KV (ISSUE 11) vs the contiguous per-slot ring rows at an
+    EQUAL HBM BUDGET — the tokens-resident-per-HBM-byte capacity claim.
+
+    Scenario 1 (capacity, MIXED-length burst): the contiguous engine
+    pre-reserves a full [t_max] row per slot, so a budget of B bytes
+    caps concurrency at S_c = B / bytes_per_slot REGARDLESS of request
+    lengths. The paged engine spends the SAME bytes as a page pool
+    (n_pages * page_bytes == S_c * bytes_per_slot, asserted) shared by
+    4*S_c slots; short requests hold only the pages their tokens
+    occupy, so under a mixed-length burst the peak number of requests
+    RESIDENT at once must reach >= 1.5x the contiguous cap (the
+    ROADMAP item-3 gate — asserted; measured ~3-4x here). Outputs are
+    asserted BIT-IDENTICAL per request between the two engines and
+    against the serial Generator (greedy; the paged fold presents the
+    same values in the same reduction order on a 1-device mesh).
+
+    Scenario 2 (the price, UNIFORM-length trace): same slot count both
+    sides, every request the same shape, so the only difference is the
+    page-table gather indirection inside the fused window — the
+    reported `serve_paged_overhead_pct` (interleaved pairs, best-of,
+    the bench_serving discipline). This is what you pay when paging
+    buys you nothing; docs/BENCHMARKS.md carries the figure."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import attention_lm
+    from idc_models_tpu.serve import LMServer, Request
+
+    if on_accelerator:
+        vocab, e, heads, blocks, mlp = 1024, 512, 8, 2, 2048
+        t_max, s_contig, window, chunk, ps = 2048, 8, 32, 256, 128
+        n_req, p_lens, budgets = 64, (32, 256), (32, 512)
+        uni_req, uni_p, uni_b = 16, 64, 192
+    else:
+        vocab, e, heads, blocks, mlp = 32, 32, 2, 2, 64
+        t_max, s_contig, window, chunk, ps = 128, 4, 4, 16, 16
+        n_req, p_lens, budgets = 24, (3, 16), (4, 24)
+        uni_req, uni_p, uni_b = 8, 8, 24
+    mesh = meshlib.seq_mesh(1)
+    model = attention_lm(vocab, t_max, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks, mesh=mesh)
+    params = model.init(jax.random.key(0)).params
+    kw = dict(embed_dim=e, num_heads=heads, num_blocks=blocks,
+              t_max=t_max, mesh=mesh, cache_dtype=jnp.bfloat16,
+              prefill_chunk=chunk, max_queue_depth=2 * n_req,
+              max_prefills_per_cycle=4, window=window)
+    s_paged = 4 * s_contig
+    n_pages = s_contig * (t_max // ps)      # the EQUAL-budget pool
+
+    rng = np.random.default_rng(11)
+    trace = []
+    for i in range(n_req):
+        p_len = int(rng.integers(*p_lens))
+        trace.append((0.0, Request(
+            id=f"r{i}",
+            prompt=tuple(int(x) for x in rng.integers(0, vocab, p_len)),
+            max_new_tokens=int(rng.integers(*budgets)))))
+
+    def run_mixed(paged: bool):
+        server = LMServer(
+            params, n_slots=s_paged if paged else s_contig,
+            kv_page_size=ps if paged else None,
+            kv_pages=n_pages if paged else None, **kw)
+        t0 = time.perf_counter()
+        results = server.run(trace)
+        dt = time.perf_counter() - t0
+        toks = {r.id: tuple(r.tokens) for r in results}      # fence
+        m = server.metrics
+        peak = max(m.occupancies) * server.engine.n_slots
+        if paged:
+            # the equal-HBM claim must be true by construction, not
+            # by narrative: pool bytes == the contiguous reservation
+            assert (server.engine.kv_pages
+                    * server.engine.kv_page_bytes()
+                    == s_contig * contig_slot_bytes), (
+                server.engine.kv_page_bytes(), contig_slot_bytes)
+        else:
+            assert peak <= s_contig + 1e-9
+        return toks, round(peak), server.summary(), dt
+
+    # contiguous per-slot bytes, for the equal-budget assertion
+    probe = LMServer(params, n_slots=1, **kw)
+    contig_slot_bytes = probe.engine.kv_bytes_per_slot()
+    probe.close()
+
+    run_mixed(True)                          # compile both paths
+    run_mixed(False)
+    tok_p, peak_p, sum_p, _ = run_mixed(True)
+    tok_c, peak_c, sum_c, _ = run_mixed(False)
+    assert tok_p == tok_c, "paged vs contiguous token streams differ"
+    residency_ratio = peak_p / peak_c
+    assert residency_ratio >= 1.5, (
+        f"paged engine held {peak_p} concurrent requests vs "
+        f"{peak_c} contiguous at equal HBM — below the 1.5x gate")
+
+    # scenario 2: uniform-length trace, same slots both sides — the
+    # indirection overhead in isolation
+    uni = [(0.0, Request(
+        id=f"u{i}",
+        prompt=tuple(int(x) for x in rng.integers(0, vocab, uni_p)),
+        max_new_tokens=uni_b)) for i in range(uni_req)]
+
+    def run_uniform(paged: bool):
+        server = LMServer(
+            params, n_slots=s_contig,
+            kv_page_size=ps if paged else None,
+            kv_pages=(s_contig * (t_max // ps)) if paged else None,
+            **kw)
+        t0 = time.perf_counter()
+        results = server.run(uni)
+        dt = time.perf_counter() - t0
+        n_tok = sum(len(r.tokens) for r in results)          # fence
+        assert n_tok
+        return n_tok / dt
+
+    run_uniform(True)                        # compile
+    run_uniform(False)
+    ratios = []
+    for _ in range(3):                       # interleaved pairs
+        tps_p = run_uniform(True)
+        tps_c = run_uniform(False)
+        ratios.append(tps_c / tps_p - 1.0)
+    overhead_pct = min(ratios) * 100.0
+
+    return {
+        "serve_paged_requests": n_req,
+        "serve_paged_pages": n_pages,
+        "serve_paged_page_size": ps,
+        "serve_paged_slots": s_paged,
+        "serve_contig_slots": s_contig,
+        "serve_paged_peak_resident": peak_p,
+        "serve_contig_peak_resident": peak_c,
+        "serve_paged_concurrent_residency_ratio": round(residency_ratio,
+                                                        3),
+        "serve_kv_pages_used_peak": sum_p["serve_kv_pages_used_peak"],
+        "serve_kv_tokens_per_hbm_byte":
+            sum_p["serve_kv_tokens_per_hbm_byte"],
+        "serve_paged_tokens_per_sec": round(
+            sum_p["serve_tokens_per_sec"] or 0.0, 1),
+        "serve_paged_overhead_pct": round(overhead_pct, 2),
+        "serve_paged_overhead_windows": [round(r * 100, 2)
+                                         for r in ratios],
+    }
+
+
 def bench_serving_resilience(on_accelerator: bool):
     """The ISSUE-8 resilience layer under load, two scenarios:
 
@@ -1565,6 +1712,8 @@ HIGHER_IS_BETTER = (
     "serve_prefix_hit_rate", "serve_int8_kv_slot_capacity_ratio",
     "serve_spec_tokens_per_sec", "serve_spec_speedup",
     "serve_spec_accept_rate", "serve_spec_tokens_per_dispatch",
+    "serve_paged_concurrent_residency_ratio",
+    "serve_kv_tokens_per_hbm_byte", "serve_paged_tokens_per_sec",
     "ring_fwd_speedup_vs_jnp", "ring_fwd_speedup_median",
     "zigzag_schedule_speedup", "fed_byz_robust_advantage",
 )
@@ -1576,6 +1725,7 @@ LOWER_IS_BETTER = (
     "serve_chunked_prefill_decode_stall_ms",
     "serve_resilience_ttft_ms_p95_brownout",
     "serve_resilience_overhead_pct",
+    "serve_paged_overhead_pct",
     "serve_trace_disabled_overhead_pct",
     "profile_armed_overhead_pct",
     "flash_fwd_bwd_ms", "model_step_ms",
@@ -1693,6 +1843,7 @@ def main() -> None:
     ring.update(bench_serving(on_accelerator))
     ring.update(bench_serving_shared_prefix(on_accelerator))
     ring.update(bench_serving_speculative(on_accelerator))
+    ring.update(bench_serving_paged_kv(on_accelerator))
     ring.update(bench_serving_resilience(on_accelerator))
     ring.update(bench_tracer_overhead(on_accelerator))
     ring.update(bench_profile_overhead(on_accelerator))

@@ -338,6 +338,25 @@ def _parse(argv):
                          "slot (per-(slot,head) scales, ~2x slots per "
                          "budget) at the cost of bounded logit drift — "
                          "leave bf16 when exact parity matters")
+    sp.add_argument("--kv-page-size", type=int, default=0,
+                    help="paged KV (0 = off, needs --kv-pages and "
+                         "--prefill-chunk): replace the per-slot "
+                         "[t_max] ring rows with fixed-size cache "
+                         "pages + per-slot page tables, so HBM holds "
+                         "tokens actually resident instead of every "
+                         "slot's worst case. Must divide "
+                         "--prefill-chunk (and t-max)")
+    sp.add_argument("--kv-pages", type=int, default=0,
+                    help="page-pool size for --kv-page-size: the HBM "
+                         "budget in pages, shared by slots and prefix-"
+                         "cache snapshots (pages*size must cover at "
+                         "least one t-max request)")
+    sp.add_argument("--kv-decode-reserve", type=int, default=0,
+                    help="decode tokens PRE-reserved per admission on "
+                         "the paged engine (0 = the full budget, "
+                         "never exhausts mid-decode; smaller admits "
+                         "optimistically and grows grants mid-decode, "
+                         "quarantining honestly on exhaustion)")
     sp.add_argument("--spec-decode", action="store_true",
                     help="speculative decoding (models/draft.py + the "
                          "engine's fixed-k verify program): an n-gram "
@@ -926,11 +945,41 @@ def _profile_serve(ns, on_accel):
         n += 1
     window_s = (time.perf_counter() - t0) / max(n, 1)
     server.close()
+    # the PAGED twin at the same decode configuration: saturate, time
+    # steady-state windows, and account serve.window_paged — so the
+    # report shows the page-table gather indirection's cost NEXT TO
+    # the contiguous serve.window figure (ISSUE 11)
+    page_size = max(t_max // 16, 1)
+    paged_server = LMServer(
+        params, embed_dim=e, num_heads=heads, num_blocks=blocks,
+        t_max=t_max, n_slots=n_slots, window=window, mesh=mesh,
+        cache_dtype=jnp.bfloat16, prefill_chunk=page_size,
+        kv_page_size=page_size,
+        kv_pages=n_slots * (t_max // page_size))
+    for i in range(n_slots):
+        paged_server.submit(Request(id=f"g{i}", prompt=(1, 2, 3, 4),
+                                    max_new_tokens=budget))
+    for _ in range(n_slots + 2):   # chunked admissions settle (one
+        paged_server.step()        # chunk dispatch per cycle)
+    paged_costs = paged_server.engine.program_costs(window)
+    t0 = time.perf_counter()
+    np_ = 0
+    for _ in range(steps):
+        if paged_server.scheduler.idle():
+            break
+        paged_server.step()
+        np_ += 1
+    paged_window_s = (time.perf_counter() - t0) / max(np_, 1)
+    paged_server.close()
     wcost = costs["serve.window"]
     roofline = prof.roofline_verdict(wcost, window_s, dev)
     progs = {"serve.window": (wcost, roofline, window_s * 1e3)}
-    for name, c in costs.items():
-        if name == "serve.window":
+    pw = paged_costs.pop("serve.window_paged")
+    progs["serve.window_paged"] = (
+        pw, prof.roofline_verdict(pw, paged_window_s, dev),
+        paged_window_s * 1e3)
+    for name, c in list(costs.items()) + list(paged_costs.items()):
+        if name in progs:
             continue
         # untimed programs (admission prefill, the speculative verify)
         # still get an intensity-based compute-vs-bandwidth verdict —
@@ -941,6 +990,10 @@ def _profile_serve(ns, on_accel):
     print(f"  {window_s * 1e3:.2f} ms/window, "
           f"{n_slots * window / window_s:.1f} tokens/sec at full "
           f"occupancy")
+    print(f"  paged: {paged_window_s * 1e3:.2f} ms/window "
+          f"({np_} measured) — indirection overhead "
+          f"{(paged_window_s / window_s - 1) * 100:+.1f}% vs "
+          f"contiguous")
     return progs, mark
 
 
@@ -1386,6 +1439,30 @@ def _run_serve(ns):
     if ns.prefix_cache_mb > 0 and not ns.prefill_chunk:
         sys.exit("--prefix-cache-mb needs --prefill-chunk (snapshots "
                  "live on chunk boundaries)")
+    if bool(ns.kv_page_size) != bool(ns.kv_pages):
+        sys.exit("paged KV needs BOTH --kv-page-size and --kv-pages "
+                 "(or neither for the contiguous per-slot rows)")
+    if ns.kv_page_size:
+        if not ns.prefill_chunk:
+            sys.exit("--kv-page-size needs --prefill-chunk: prompts "
+                     "stream straight into pool pages chunk by chunk")
+        if ns.kv_page_size < 1 or ns.t_max % ns.kv_page_size:
+            sys.exit(f"--kv-page-size {ns.kv_page_size} must be >= 1 "
+                     f"and divide --t-max {ns.t_max}")
+        if ns.prefill_chunk % ns.kv_page_size:
+            sys.exit(f"--prefill-chunk {ns.prefill_chunk} must be a "
+                     f"multiple of --kv-page-size {ns.kv_page_size} "
+                     f"(chunk boundaries must land on the page grid)")
+        if ns.kv_pages * ns.kv_page_size < ns.t_max:
+            sys.exit(f"--kv-pages {ns.kv_pages} x --kv-page-size "
+                     f"{ns.kv_page_size} < --t-max {ns.t_max}: one "
+                     f"full-length request could never be admitted")
+    if ns.kv_decode_reserve and not ns.kv_page_size:
+        sys.exit("--kv-decode-reserve needs paged KV "
+                 "(--kv-page-size/--kv-pages)")
+    if ns.kv_decode_reserve < 0:
+        sys.exit(f"--kv-decode-reserve {ns.kv_decode_reserve} must be "
+                 f">= 0 (0 = reserve the full budget)")
     if ns.spec_decode and not 1 <= ns.draft_k <= ns.t_max - 2:
         sys.exit(f"--draft-k {ns.draft_k} must be in [1, t_max - 2] "
                  f"(a verify needs room for k drafts + the bonus "
@@ -1552,7 +1629,10 @@ def _serve_body(ns, mesh, params, logger) -> None:
         retry=retry, fault_plan=ns.serve_fault_plan,
         journal=ns.journal, brownout=brownout,
         spec_decode=ns.spec_decode, draft_k=ns.draft_k,
-        draft_order=ns.ngram_order)
+        draft_order=ns.ngram_order,
+        kv_page_size=ns.kv_page_size or None,
+        kv_pages=ns.kv_pages or None,
+        kv_decode_reserve=ns.kv_decode_reserve or None)
     if n_pending:
         readmitted = server.resubmit_pending(ns.journal)
         line = (f"journal: re-admitted {len(readmitted)} in-flight "
@@ -1615,6 +1695,19 @@ def _serve_body(ns, mesh, params, logger) -> None:
               f"({summary['serve_prefix_hits']} hits, "
               f"{summary['serve_prefix_evictions']} evictions, "
               f"{summary['serve_prefix_bytes']} bytes)")
+    if ns.kv_page_size:
+        # what paging actually bought: peak pool occupancy vs the
+        # capacity the same HBM would hold as contiguous per-slot
+        # rows, and the tokens-per-HBM-byte the claim is stated in
+        print(f"paged kv: {summary['serve_kv_pages_used_peak']}/"
+              f"{summary['serve_kv_pages_total']} pages peak "
+              f"(page {ns.kv_page_size} tokens), resident peak "
+              f"{summary['serve_kv_resident_tokens_peak']} tokens / "
+              f"{summary['serve_kv_resident_bytes_peak']} bytes "
+              f"(tokens/HBM-byte "
+              f"{summary['serve_kv_tokens_per_hbm_byte']}), "
+              f"exhaustion backpressure "
+              f"{summary['serve_page_exhaustions']}")
     if ns.spec_decode:
         # what speculation actually bought: accept rate over drafted
         # tokens and emitted tokens per slot per verify (1.0 would

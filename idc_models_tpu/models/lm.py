@@ -333,7 +333,13 @@ def _token_forward(cfg: _ServeConfig, ln, params, caches, tok, pos, fold):
     positions) — the position-table gather broadcasts either way.
     `fold(block_idx, kc, vc, q, k, v) -> (o, kc, vc)` supplies the
     cache fold, so the serial scalar-pos path and the engine's masked
-    per-row path share every other op bit-for-bit."""
+    per-row path share every other op bit-for-bit. The fold contract
+    is deliberately cache-layout-agnostic: the PAGED engine passes
+    per-block (k_pool, v_pool) pairs and a page-table-indirect fold
+    (`ring_decode.make_paged_batched_ring_decode`, with the table
+    closed over) through the same signature — which is why paged token
+    streams are bit-identical to contiguous ones on a 1-device mesh:
+    everything outside the fold IS this one definition."""
     b = tok.shape[0]
     h = (jnp.take(params["embed"], tok, axis=0)
          + params["pos"][pos])                          # [B, E]
@@ -361,10 +367,11 @@ def _chunk_batch_forward(cfg: _ServeConfig, ln, params, caches, toks,
     MLP residual], final LN, vocab head at EVERY position (the verify
     needs all C next-token distributions, not just the last).
     `fold(block_idx, kc, vc, q, k, v) -> (o [B,C,H,D], kc, vc)`
-    supplies the cache fold (the batched chunk fold, with liveness and
-    positions closed over by the caller), so this shares every other
-    op with `_token_forward`/`chunk_body` bit-for-bit — the
-    speculative parity contract hinges on that sharing."""
+    supplies the cache fold (the batched chunk fold — contiguous or
+    page-table-indirect, with liveness and positions closed over by
+    the caller), so this shares every other op with
+    `_token_forward`/`chunk_body` bit-for-bit — the speculative parity
+    contract, paged and contiguous alike, hinges on that sharing."""
     b, c = toks.shape
     idx = jnp.clip(pos[:, None] + jnp.arange(c, dtype=jnp.int32),
                    0, params["pos"].shape[0] - 1)

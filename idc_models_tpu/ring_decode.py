@@ -510,6 +510,470 @@ def make_batched_chunk_ring_decode(mesh: Mesh, *,
     return jax.jit(checked, donate_argnums=(0, 1))
 
 
+def _paged_specs(mesh, axis, quantized):
+    """shard_map specs shared by the paged folds: pools shard over the
+    PHYSICAL page dim (device i owns pages [i*P/n, (i+1)*P/n)), page
+    tables and dequant scales replicate — a slot's logical pages may
+    land on any device, so the table must be readable everywhere and
+    the per-(page, head) scales are tiny."""
+    pool_spec = P(axis, None, None, None)
+    rep = P()
+    scale_specs = (rep, rep) if quantized else ()
+    return pool_spec, rep, scale_specs
+
+
+def _page_view(pool, pt, i, p_loc):
+    """Gather a pool shard into each slot's LOGICAL view: pt [S, L]
+    physical page ids (-1 = unallocated) -> [S, L*ps, H, D] laid out in
+    logical position order, plus the [S, L] this-shard ownership mask.
+    Rows gathered through a clamped foreign/unallocated id hold garbage
+    the caller's visibility mask discards — exactly like the contiguous
+    folds' beyond-pos cache slots."""
+    local = jnp.clip(pt - i * p_loc, 0, p_loc - 1)         # [S, L]
+    mine = (pt >= i * p_loc) & (pt < (i + 1) * p_loc)      # [S, L]
+    view = pool[local]                                     # [S,L,ps,H,D]
+    s, l, ps, h, d = view.shape
+    return view.reshape(s, l * ps, h, d), mine
+
+
+def make_paged_batched_ring_decode(mesh: Mesh, *, page_size: int,
+                                   axis: str = meshlib.SEQ_AXIS,
+                                   scale: float | None = None,
+                                   jit: bool = False,
+                                   quantized: bool = False):
+    """Page-table-indirect variant of `make_batched_ring_decode` — the
+    one-token-per-row fold of the PAGED serving engine:
+    ``fn(k_pool, v_pool, page_table, q_t, k_t, v_t, pos, live)
+    -> (out_t, k_pool, v_pool)``.
+
+    The caches are a POOL of fixed-size pages `[n_pages, page_size, H,
+    D]` shared by every slot (sharded over the page dim across the
+    ring) plus an int32 page table `[S, L]` mapping slot b's logical
+    page j to a physical page (-1 = unallocated). Per live row the fold
+
+    1. appends the new token into the ONE physical page owning its
+       position — a unique-index scatter; rows whose target page lives
+       on another device (or that are dead) are dropped outright, so a
+       dead row's pages are bit-untouched;
+    2. gathers the row's logical view from the resident shard and runs
+       the SAME per-row attend as the contiguous fold, with visibility
+       = (position <= pos) AND the page is physically here — pages on
+       other devices (and unallocated -1 entries) contribute nothing;
+    3. merges across the ring with the identical two-collective
+       (m, l, acc) softmax algebra.
+
+    On a 1-device mesh the gathered view presents exactly the
+    contiguous cache's values in the same reduction order, so a live
+    row's output is BIT-IDENTICAL to the contiguous batched fold
+    (gated by test); on a multi-device ring the per-device partition
+    differs (pages vs position ranges), so parity is fp-close +
+    argmax-equal — the same contract chunked prefill already carries.
+
+    With ``quantized=True`` pools hold int8 pages and the signature
+    grows PER-(PAGE, HEAD) float32 ``[n_pages, H]`` dequant scales
+    (replicated): scores and value accumulations dequantize through a
+    per-page gather of the scales (a scale varies along the position
+    axis here, so it multiplies the per-page score/probability blocks
+    instead of factoring fully out); appends quantize with the target
+    page's existing scale. Callers own the bound pos[b] < L*page_size
+    AND that the owning page is allocated for live rows — an
+    unallocated append drops silently, the same traced-position
+    contract as every other fold."""
+    n = mesh.shape[axis]
+
+    def per_device(kp, vp, pt, q, kt, vt, pos, live, k_scale=None,
+                   v_scale=None):
+        p_loc, ps, h, d = kp.shape
+        s_rows, l_pages = pt.shape
+        n_pages = p_loc * n
+        i = collectives.axis_index(axis)
+        scale_ = scale if scale is not None else d ** -0.5
+        pos = jnp.asarray(pos, jnp.int32)
+        live = jnp.asarray(live, jnp.bool_)
+        posc = jnp.clip(pos, 0, l_pages * ps - 1)
+        lpage = posc // ps
+        slot_in = posc % ps
+        phys = jnp.take_along_axis(pt, lpage[:, None], axis=1)[:, 0]
+        writer = (phys >= i * p_loc) & (phys < (i + 1) * p_loc) & live
+        if quantized:
+            ksr = k_scale[jnp.clip(phys, 0, n_pages - 1)]    # [S, H]
+            vsr = v_scale[jnp.clip(phys, 0, n_pages - 1)]
+            kt = jnp.clip(jnp.round(
+                kt.astype(jnp.float32) / ksr[:, None, :, None]),
+                -127, 127)
+            vt = jnp.clip(jnp.round(
+                vt.astype(jnp.float32) / vsr[:, None, :, None]),
+                -127, 127)
+        # append: one (page, slot) cell per live row. Non-writers are
+        # redirected past the shard and DROPPED — never a masked
+        # rewrite, so collisions with real writers are impossible and
+        # dead rows leave the pool bit-untouched. Pages are exclusively
+        # owned by one slot, hence unique indices.
+        pl = jnp.where(writer, phys - i * p_loc, p_loc)
+        kp = kp.at[pl, slot_in].set(kt[:, 0].astype(kp.dtype),
+                                    mode="drop", unique_indices=True)
+        vp = vp.at[pl, slot_in].set(vt[:, 0].astype(vp.dtype),
+                                    mode="drop", unique_indices=True)
+        # per-row attend over the gathered logical view — the same
+        # einsums/masking/merge as the contiguous batched fold
+        kv_view, mine = _page_view(kp, pt, i, p_loc)
+        vv_view, _ = _page_view(vp, pt, i, p_loc)
+        s = jnp.einsum("bhd,bkhd->bhk", q[:, 0], kv_view,
+                       preferred_element_type=jnp.float32) * scale_
+        if quantized:
+            ptc = jnp.clip(pt, 0, n_pages - 1)
+            ks_view = k_scale[ptc]                       # [S, L, H]
+            s = (s.reshape(s_rows, h, l_pages, ps)
+                 * jnp.moveaxis(ks_view, 2, 1)[..., None]
+                 ).reshape(s_rows, h, l_pages * ps)
+        g = (jnp.arange(l_pages, dtype=jnp.int32)[:, None] * ps
+             + jnp.arange(ps, dtype=jnp.int32)[None, :]).reshape(-1)
+        visible = (jnp.repeat(mine, ps, axis=1)
+                   & (g[None, :] <= posc[:, None]))       # [S, L*ps]
+        s = jnp.where(visible[:, None, :], s, _MASKED)
+        m_loc = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m_loc[..., None])
+        p = jnp.where(visible[:, None, :], p, 0.0)
+        l_loc = jnp.sum(p, axis=-1)
+        if quantized:
+            vs_view = v_scale[jnp.clip(pt, 0, n_pages - 1)]
+            p_v = (p.reshape(s_rows, h, l_pages, ps)
+                   * jnp.moveaxis(vs_view, 2, 1)[..., None]
+                   ).reshape(s_rows, h, l_pages * ps)
+        else:
+            p_v = p
+        acc_loc = jnp.einsum("bhk,bkhd->bhd", p_v, vv_view,
+                             preferred_element_type=jnp.float32)
+        m_glob = lax.pmax(m_loc, axis)
+        corr = jnp.exp(m_loc - m_glob)
+        l_glob = collectives.psum(l_loc * corr, axis)
+        acc_glob = collectives.psum(acc_loc * corr[..., None], axis)
+        out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]
+        return out[:, None].astype(q.dtype), kp, vp
+
+    pool_spec, rep, scale_specs = _paged_specs(mesh, axis, quantized)
+    tok_spec = P(tuple(a for a in mesh.axis_names if a != axis) or None,
+                 None, None, None)
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(pool_spec, pool_spec, rep, tok_spec, tok_spec,
+                  tok_spec, rep, rep) + scale_specs,
+        out_specs=(tok_spec, pool_spec, pool_spec),
+        check_vma=False,
+    )
+
+    def checked(kp, vp, pt, q_t, k_t, v_t, pos, live, *scales):
+        _check_paged_pool(kp, pt, n, page_size, quantized, scales)
+        if q_t.shape[1] != 1:
+            raise ValueError(
+                f"paged batched decode takes ONE token per row per "
+                f"step: q_t has sequence length {q_t.shape[1]}")
+        if jnp.shape(pos) != (pt.shape[0],):
+            raise ValueError(
+                f"pos must be one position per page-table row, shape "
+                f"({pt.shape[0]},); got {jnp.shape(pos)}")
+        return mapped(kp, vp, pt, q_t, k_t, v_t, pos, live, *scales)
+
+    if not jit:
+        return checked
+    return jax.jit(checked, donate_argnums=(0, 1))
+
+
+def _check_paged_pool(kp, pt, n, page_size, quantized, scales):
+    """The one pool/table contract shared by every paged fold."""
+    if quantized and len(scales) != 2:
+        raise ValueError("quantized paged fold needs (k_scale, v_scale)")
+    if not quantized and scales:
+        raise ValueError("scales passed to a non-quantized paged fold")
+    if kp.shape[1] != page_size:
+        raise ValueError(f"pool page dim {kp.shape[1]} != the fold's "
+                         f"page_size {page_size}")
+    if kp.shape[0] % n:
+        raise ValueError(
+            f"page pool size {kp.shape[0]} not divisible by the ring "
+            f"size {n}")
+    if pt.ndim != 2:
+        raise ValueError(f"page table must be [S, L] int32, got shape "
+                         f"{jnp.shape(pt)}")
+
+
+def make_paged_chunk_ring_decode(mesh: Mesh, *, page_size: int,
+                                 axis: str = meshlib.SEQ_AXIS,
+                                 scale: float | None = None,
+                                 jit: bool = False,
+                                 quantized: bool = False):
+    """Page-table-indirect variant of `make_chunk_ring_decode` — the
+    chunked-prefill fold of the paged engine: ``fn(k_pool, v_pool,
+    page_table, q, k, v, start, p_end) -> (out, k_pool, v_pool)``
+    runs C prompt tokens against the request's OWN pages, writing
+    positions [start, p_end) straight into the pool (no contiguous
+    single-request cache ever exists on the paged path).
+
+    `page_table` is the request's row(s), [B, L]; callers align chunks
+    to the page grid (page_size | chunk, enforced by the engine) so a
+    chunk fills whole pages and a completed chunk boundary's pages are
+    NEVER written again — the invariant that lets prefix-cache
+    snapshots share pages with live slots zero-copy.
+
+    With ``quantized=True`` the signature grows [n_pages, H] per-page
+    scale arrays which the fold UPDATES and returns: ``fn(..., start,
+    p_end, k_scale, v_scale) -> (out, k_pool, v_pool, k_scale,
+    v_scale)``. Each page this chunk fills gets a fresh per-head scale
+    (absmax of its REAL tokens / 127, floor 1e-8) before its content
+    quantizes with it — per-page scales are FINER than the contiguous
+    engine's per-slot ones, so int8 paged output is gated on bounded
+    drift + determinism rather than bit parity (docs/LONG_CONTEXT.md).
+    """
+    n = mesh.shape[axis]
+
+    def per_device(kp, vp, pt, q, kt, vt, start, p_end, k_scale=None,
+                   v_scale=None):
+        p_loc, ps, h, d = kp.shape
+        b, c = q.shape[:2]
+        l_pages = pt.shape[1]
+        n_pages = p_loc * n
+        i = collectives.axis_index(axis)
+        scale_ = scale if scale is not None else d ** -0.5
+        start = jnp.asarray(start, jnp.int32)
+        p_end = jnp.asarray(p_end, jnp.int32)
+        cpos = start + jnp.arange(c, dtype=jnp.int32)       # [C]
+        real = cpos < p_end                                  # [C]
+        lpage = jnp.clip(cpos // ps, 0, l_pages - 1)
+        phys = jnp.take_along_axis(
+            pt, jnp.broadcast_to(lpage[None, :], (b, c)), axis=1)
+
+        if quantized:
+            # fresh per-(page, head) scales for the pages this chunk
+            # fills: absmax over the page's REAL tokens. The update is
+            # identical on every device (the chunk K/V is replicated),
+            # so the replicated scale arrays stay consistent.
+            cpp = c // ps                                    # chunks are
+            #                       page-aligned: whole pages per chunk
+
+            def page_scales(t):
+                tf = jnp.abs(t.astype(jnp.float32))
+                tf = jnp.where(real[None, :, None, None], tf, 0.0)
+                m = jnp.max(tf.reshape(b, cpp, ps, h, d), axis=(0, 2, 4))
+                return jnp.maximum(m, 1e-8) / 127.0          # [cpp, H]
+
+            k_new, v_new = page_scales(kt), page_scales(vt)
+            page_real = jnp.max(real.reshape(cpp, ps), axis=1)
+            dst = jnp.take_along_axis(
+                pt[0], jnp.clip(start // ps, 0, l_pages - 1)
+                + jnp.arange(cpp, dtype=jnp.int32), axis=0)
+            dst = jnp.where(page_real & (dst >= 0), dst, n_pages)
+            k_scale = k_scale.at[dst].set(k_new, mode="drop",
+                                          unique_indices=True)
+            v_scale = v_scale.at[dst].set(v_new, mode="drop",
+                                          unique_indices=True)
+            ksc = jnp.repeat(k_new, ps, axis=0)              # [C, H]
+            vsc = jnp.repeat(v_new, ps, axis=0)
+            kt = jnp.clip(jnp.round(
+                kt.astype(jnp.float32) / ksc[None, :, :, None]),
+                -127, 127)
+            vt = jnp.clip(jnp.round(
+                vt.astype(jnp.float32) / vsc[None, :, :, None]),
+                -127, 127)
+
+        # splice: scatter each REAL chunk position into its page cell;
+        # non-real / not-resident positions redirect past the shard
+        # and DROP. Unique: one owner per (page, slot-in-page).
+        writer = real[None, :] & (phys >= i * p_loc) & (phys
+                                                        < (i + 1) * p_loc)
+        pl = jnp.where(writer, phys - i * p_loc, p_loc).reshape(-1)
+        sl = jnp.broadcast_to((cpos % ps)[None, :], (b, c)).reshape(-1)
+        kp = kp.at[pl, sl].set(
+            kt.reshape(-1, h, d).astype(kp.dtype), mode="drop",
+            unique_indices=True)
+        vp = vp.at[pl, sl].set(
+            vt.reshape(-1, h, d).astype(vp.dtype), mode="drop",
+            unique_indices=True)
+        # per-query attend over the gathered logical view(s)
+        out_rows = []
+        for rb in range(b):          # prefill runs B=1; keep it general
+            kv_view, mine = _page_view(kp, pt[rb:rb + 1], i, p_loc)
+            vv_view, _ = _page_view(vp, pt[rb:rb + 1], i, p_loc)
+            s = jnp.einsum("bchd,bkhd->bhck", q[rb:rb + 1], kv_view,
+                           preferred_element_type=jnp.float32) * scale_
+            if quantized:
+                ptc = jnp.clip(pt[rb:rb + 1], 0, n_pages - 1)
+                ks_view = k_scale[ptc]                    # [1, L, H]
+                s = (s.reshape(1, h, c, l_pages, ps)
+                     * jnp.moveaxis(ks_view, 2, 1)[:, :, None, :, None]
+                     ).reshape(1, h, c, l_pages * ps)
+            g = (jnp.arange(l_pages, dtype=jnp.int32)[:, None] * ps
+                 + jnp.arange(ps, dtype=jnp.int32)[None, :]).reshape(-1)
+            visible = (jnp.repeat(mine, ps, axis=1)[:, None, :]
+                       & (g[None, None, :] <= cpos[None, :, None]))
+            s = jnp.where(visible[:, None], s, _MASKED)
+            m_loc = jnp.max(s, axis=-1)                   # [1, H, C]
+            p = jnp.exp(s - m_loc[..., None])
+            p = jnp.where(visible[:, None], p, 0.0)
+            l_loc = jnp.sum(p, axis=-1)
+            if quantized:
+                vs_view = v_scale[jnp.clip(pt[rb:rb + 1], 0,
+                                           n_pages - 1)]
+                p_v = (p.reshape(1, h, c, l_pages, ps)
+                       * jnp.moveaxis(vs_view, 2, 1)[:, :, None, :,
+                                                     None]
+                       ).reshape(1, h, c, l_pages * ps)
+            else:
+                p_v = p
+            acc_loc = jnp.einsum("bhck,bkhd->bhcd", p_v, vv_view,
+                                 preferred_element_type=jnp.float32)
+            m_glob = lax.pmax(m_loc, axis)
+            corr = jnp.exp(m_loc - m_glob)
+            l_glob = collectives.psum(l_loc * corr, axis)
+            acc_glob = collectives.psum(acc_loc * corr[..., None], axis)
+            out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]
+            out_rows.append(jnp.moveaxis(out, 1, 2))
+        out = jnp.concatenate(out_rows, axis=0).astype(q.dtype)
+        if quantized:
+            return out, kp, vp, k_scale, v_scale
+        return out, kp, vp
+
+    pool_spec, rep, scale_specs = _paged_specs(mesh, axis, quantized)
+    tok_spec = P(tuple(a for a in mesh.axis_names if a != axis) or None,
+                 None, None, None)
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(pool_spec, pool_spec, rep, tok_spec, tok_spec,
+                  tok_spec, rep, rep) + scale_specs,
+        out_specs=((tok_spec, pool_spec, pool_spec) + scale_specs),
+        check_vma=False,
+    )
+
+    def checked(kp, vp, pt, q, k, v, start, p_end, *scales):
+        _check_paged_pool(kp, pt, n, page_size, quantized, scales)
+        if q.ndim != 4 or q.shape[1] < 1:
+            raise ValueError(f"paged chunk fold expects [B, C, H, D] "
+                             f"queries, got shape {jnp.shape(q)}")
+        if q.shape[1] % page_size:
+            raise ValueError(
+                f"chunk {q.shape[1]} must be a multiple of the page "
+                f"size {page_size} — chunk boundaries must land on the "
+                f"page grid so completed pages are never rewritten")
+        return mapped(kp, vp, pt, q, k, v, start, p_end, *scales)
+
+    if not jit:
+        return checked
+    return jax.jit(checked, donate_argnums=(0, 1))
+
+
+def make_paged_batched_chunk_ring_decode(mesh: Mesh, *, page_size: int,
+                                         axis: str = meshlib.SEQ_AXIS,
+                                         scale: float | None = None,
+                                         jit: bool = False,
+                                         quantized: bool = False):
+    """Page-table-indirect variant of `make_batched_chunk_ring_decode`
+    — the SPECULATIVE-VERIFY fold of the paged engine: ``fn(k_pool,
+    v_pool, page_table, q, k, v, pos, live) -> (out, k_pool,
+    v_pool)`` runs C draft tokens per slot against the slot's pages,
+    each row at its OWN position; rows with live=False append nothing
+    and their pages are bit-untouched. Callers (the engine's room
+    check) own the bound that live rows' pages cover [pos_b, pos_b+C).
+    With ``quantized=True`` appends quantize with the target pages'
+    EXISTING scales (decode-region pages are stamped at grant time)
+    and the signature grows the two replicated [n_pages, H] scale
+    reads — scales are NOT updated here."""
+    n = mesh.shape[axis]
+
+    def per_device(kp, vp, pt, q, kt, vt, pos, live, k_scale=None,
+                   v_scale=None):
+        p_loc, ps, h, d = kp.shape
+        s_rows, c = q.shape[:2]
+        l_pages = pt.shape[1]
+        n_pages = p_loc * n
+        i = collectives.axis_index(axis)
+        scale_ = scale if scale is not None else d ** -0.5
+        pos = jnp.asarray(pos, jnp.int32)
+        live = jnp.asarray(live, jnp.bool_)
+        posc = jnp.clip(pos, 0, l_pages * ps - 1)
+        qpos = jnp.clip(posc[:, None]
+                        + jnp.arange(c, dtype=jnp.int32)[None, :],
+                        0, l_pages * ps - 1)               # [S, C]
+        lpage = qpos // ps
+        phys = jnp.take_along_axis(pt, lpage, axis=1)      # [S, C]
+        if quantized:
+            ksr = k_scale[jnp.clip(phys, 0, n_pages - 1)]  # [S, C, H]
+            vsr = v_scale[jnp.clip(phys, 0, n_pages - 1)]
+            kt = jnp.clip(jnp.round(
+                kt.astype(jnp.float32) / ksr[..., None]), -127, 127)
+            vt = jnp.clip(jnp.round(
+                vt.astype(jnp.float32) / vsr[..., None]), -127, 127)
+        writer = (live[:, None] & (phys >= i * p_loc)
+                  & (phys < (i + 1) * p_loc))
+        pl = jnp.where(writer, phys - i * p_loc, p_loc).reshape(-1)
+        sl = (qpos % ps).reshape(-1)
+        kp = kp.at[pl, sl].set(
+            kt.reshape(-1, h, d).astype(kp.dtype), mode="drop",
+            unique_indices=True)
+        vp = vp.at[pl, sl].set(
+            vt.reshape(-1, h, d).astype(vp.dtype), mode="drop",
+            unique_indices=True)
+        kv_view, mine = _page_view(kp, pt, i, p_loc)
+        vv_view, _ = _page_view(vp, pt, i, p_loc)
+        s = jnp.einsum("bchd,bkhd->bhck", q, kv_view,
+                       preferred_element_type=jnp.float32) * scale_
+        if quantized:
+            ptc = jnp.clip(pt, 0, n_pages - 1)
+            ks_view = k_scale[ptc]                         # [S, L, H]
+            s = (s.reshape(s_rows, h, c, l_pages, ps)
+                 * jnp.moveaxis(ks_view, 2, 1)[:, :, None, :, None]
+                 ).reshape(s_rows, h, c, l_pages * ps)
+        g = (jnp.arange(l_pages, dtype=jnp.int32)[:, None] * ps
+             + jnp.arange(ps, dtype=jnp.int32)[None, :]).reshape(-1)
+        visible = (jnp.repeat(mine, ps, axis=1)[:, None, :]
+                   & (g[None, None, :] <= qpos[:, :, None]))
+        s = jnp.where(visible[:, None], s, _MASKED)
+        m_loc = jnp.max(s, axis=-1)                        # [S, H, C]
+        p = jnp.exp(s - m_loc[..., None])
+        p = jnp.where(visible[:, None], p, 0.0)
+        l_loc = jnp.sum(p, axis=-1)
+        if quantized:
+            vs_view = v_scale[jnp.clip(pt, 0, n_pages - 1)]
+            p_v = (p.reshape(s_rows, h, c, l_pages, ps)
+                   * jnp.moveaxis(vs_view, 2, 1)[:, :, None, :, None]
+                   ).reshape(s_rows, h, c, l_pages * ps)
+        else:
+            p_v = p
+        acc_loc = jnp.einsum("bhck,bkhd->bhcd", p_v, vv_view,
+                             preferred_element_type=jnp.float32)
+        m_glob = lax.pmax(m_loc, axis)
+        corr = jnp.exp(m_loc - m_glob)
+        l_glob = collectives.psum(l_loc * corr, axis)
+        acc_glob = collectives.psum(acc_loc * corr[..., None], axis)
+        out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]
+        return jnp.moveaxis(out, 1, 2).astype(q.dtype), kp, vp
+
+    pool_spec, rep, scale_specs = _paged_specs(mesh, axis, quantized)
+    tok_spec = P(tuple(a for a in mesh.axis_names if a != axis) or None,
+                 None, None, None)
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(pool_spec, pool_spec, rep, tok_spec, tok_spec,
+                  tok_spec, rep, rep) + scale_specs,
+        out_specs=(tok_spec, pool_spec, pool_spec),
+        check_vma=False,
+    )
+
+    def checked(kp, vp, pt, q, k, v, pos, live, *scales):
+        _check_paged_pool(kp, pt, n, page_size, quantized, scales)
+        if q.ndim != 4 or q.shape[1] < 1:
+            raise ValueError(f"paged batched chunk fold expects "
+                             f"[S, C, H, D] queries, got shape "
+                             f"{jnp.shape(q)}")
+        if jnp.shape(pos) != (pt.shape[0],):
+            raise ValueError(
+                f"pos must be one position per page-table row, shape "
+                f"({pt.shape[0]},); got {jnp.shape(pos)}")
+        return mapped(kp, vp, pt, q, k, v, pos, live, *scales)
+
+    if not jit:
+        return checked
+    return jax.jit(checked, donate_argnums=(0, 1))
+
+
 def make_chunk_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
                            scale: float | None = None,
                            jit: bool = False):

@@ -12,7 +12,12 @@ from idc_models_tpu.serve.journal import (  # noqa: F401
 )
 from idc_models_tpu.models.draft import NGramDrafter  # noqa: F401
 from idc_models_tpu.serve.metrics import ServingMetrics  # noqa: F401
-from idc_models_tpu.serve.prefix_cache import PrefixCache  # noqa: F401
+from idc_models_tpu.serve.pages import (  # noqa: F401
+    PageAllocator, PageExhausted,
+)
+from idc_models_tpu.serve.prefix_cache import (  # noqa: F401
+    PagedPrefixCache, PrefixCache,
+)
 from idc_models_tpu.serve.scheduler import (  # noqa: F401
     AdmissionQueue, RetryPolicy, Scheduler,
 )

@@ -102,12 +102,17 @@ class LMServer:
                  health_checks: bool | None = None, journal=None,
                  brownout=None, prefix_cache=None,
                  spec_decode: bool = False, draft_k: int = 8,
-                 draft_order: int = 3, drafter=None):
+                 draft_order: int = 3, drafter=None,
+                 kv_page_size: int | None = None,
+                 kv_pages: int | None = None,
+                 kv_decode_reserve: int | None = None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
         from idc_models_tpu.serve.metrics import ServingMetrics
-        from idc_models_tpu.serve.prefix_cache import PrefixCache
+        from idc_models_tpu.serve.prefix_cache import (
+            PagedPrefixCache, PrefixCache,
+        )
         from idc_models_tpu.serve.scheduler import Scheduler
 
         # prefix reuse rides the chunk grid: snapshots are taken at
@@ -116,16 +121,26 @@ class LMServer:
         # passed instead of a budget — the warm-restart path: a server
         # rebuilt after an engine crash reuses the dead engine's
         # snapshots and recovered requests re-prefill only their
-        # uncached suffix (gated by test).
+        # uncached suffix (gated by test). With paged KV
+        # (kv_page_size/kv_pages) the budget builds a PagedPrefixCache
+        # instead — snapshots are refcounted page lists in the pool,
+        # and the MB budget converts to pages when the engine binds
+        # its allocator.
+        paged = kv_page_size is not None or kv_pages is not None
         if prefix_cache is not None and prefix_cache_mb:
             raise ValueError("pass prefix_cache OR prefix_cache_mb, "
                              "not both")
         if prefix_cache is None and prefix_cache_mb and prefix_cache_mb > 0:
             if prefill_chunk is None:
                 raise ValueError("prefix_cache_mb needs prefill_chunk")
-            prefix_cache = PrefixCache(
-                prefill_chunk, int(prefix_cache_mb * 1024 * 1024),
-                logger=logger)
+            if paged:
+                prefix_cache = PagedPrefixCache(
+                    prefill_chunk, budget_mb=prefix_cache_mb,
+                    logger=logger)
+            else:
+                prefix_cache = PrefixCache(
+                    prefill_chunk, int(prefix_cache_mb * 1024 * 1024),
+                    logger=logger)
         # speculative decoding (ISSUE 10): spec_decode compiles the
         # fixed-k verify program into the engine and arms the
         # scheduler's draft-and-verify window mode. The default
@@ -147,7 +162,9 @@ class LMServer:
             block_impl=block_impl, temperature=temperature, top_k=top_k,
             pad_id=pad_id, eos_id=eos_id, prefill_chunk=prefill_chunk,
             prefix_cache=prefix_cache, kv_dtype=kv_dtype,
-            draft_k=draft_k if spec_decode else None)
+            draft_k=draft_k if spec_decode else None,
+            kv_page_size=kv_page_size, kv_pages=kv_pages,
+            kv_decode_reserve=kv_decode_reserve)
         # slo: an optional observe.slo.SLOEngine — the metrics hooks
         # feed its declared objectives (ttft/queue_wait/error_rate) and
         # evaluate burn rates once per scheduler cycle

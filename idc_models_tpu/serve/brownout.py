@@ -109,13 +109,21 @@ class BrownoutController:
             reasons.append(f"slo:{self.slo_name or 'any'}")
         return reasons
 
-    def evaluate(self, *, queue_depth: int = 0) -> int:
+    def evaluate(self, *, queue_depth: int = 0,
+                 pressure: bool = False) -> int:
         """One evaluation (the scheduler calls this once per cycle):
         escalate while the signal fires, start/extend the clear timer
         while it is fully clear, and step one stage back down per
-        sustained `clear_after_s`. Returns the current stage."""
+        sustained `clear_after_s`. Returns the current stage.
+        `pressure` is an extra caller-owned escalation signal — the
+        paged engine's page-exhaustion backpressure (ISSUE 11): a pool
+        running dry pauses cache writes (frees snapshot pages), then
+        clamps budgets (smaller reservations), then sheds — each stage
+        directly reduces page demand."""
         now = self.clock()
         reasons = self._burning()
+        if pressure:
+            reasons.append("pages")
         if (self.queue_high is not None
                 and queue_depth >= self.queue_high):
             reasons.append(f"queue:{queue_depth}")

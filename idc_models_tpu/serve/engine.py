@@ -68,13 +68,17 @@ from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
 from idc_models_tpu.observe import trace
 from idc_models_tpu.models.lm import (
-    _chunk_batch_forward, _make_pick, _place_params, _serve_config,
+    _attn_residual, _chunk_batch_forward, _final_logits, _make_pick,
+    _mlp_residual, _place_params, _project_qkv, _serve_config,
     _serving_fns, _token_forward, check_prefill_chunk, prefill_bucket,
     prefill_buckets,
 )
 from idc_models_tpu.ring_decode import (
     make_batched_chunk_ring_decode, make_batched_ring_decode,
+    make_paged_batched_chunk_ring_decode, make_paged_batched_ring_decode,
+    make_paged_chunk_ring_decode,
 )
+from idc_models_tpu.serve.pages import PageAllocator, PageExhausted
 
 
 def _key_data(rng) -> np.ndarray:
@@ -107,13 +111,18 @@ _key_data._checked = False
 class _PendingPrefill:
     """Host-side record of one chunked prefill in flight: the prompt,
     the single-request caches being extended chunk by chunk, and where
-    the next chunk starts (past any prefix-cache hit)."""
+    the next chunk starts (past any prefix-cache hit). On a PAGED
+    engine `caches` is None (chunks write the slot's granted pool
+    pages directly) and `pages` holds the grant, of which the first
+    `shared` ids are prefix-cache pages this request only references."""
 
     __slots__ = ("prompt", "budget", "rng", "eos_id", "caches", "logits",
-                 "next_start", "tag")
+                 "next_start", "tag", "pages", "shared")
 
     def __init__(self, *, prompt, budget, rng, eos_id, caches, logits,
-                 next_start, tag=None):
+                 next_start, tag=None, pages=None, shared=0):
+        self.pages = pages
+        self.shared = shared
         self.prompt = prompt
         self.budget = budget
         self.rng = rng
@@ -128,12 +137,22 @@ class _EngineFns(NamedTuple):
     init_caches: object
     init_scales: object
     window: object    # (params, caches, logits, kd, pos, rem, eos,
-    #                    kscales, vscales, W)
-    insert: object    # (state..., new_caches, new_logits, slot, ...)
+    #                    kscales, vscales, W); paged engines take the
+    #                    page table after the pools
+    insert: object    # (state..., new_caches, new_logits, slot, ...);
+    #                   paged engines scatter scalars/logits only (the
+    #                   prompt K/V is already in the pool)
     health: object    # (logits) -> [S] int32 fault code
     verify: object    # (params, state..., drafts, vlive) ->
     #                   (toks, n_emit, n_acc, state...); None unless
     #                   the engine was built with draft_k
+    # paged-mode programs (None on contiguous engines): rewrite one
+    # slot's page-table row, stamp granted decode pages' dequant
+    # scales from a source page (int8), and the direct-to-pool chunk
+    # prefill
+    page_row: object = None
+    stamp_scales: object = None
+    prefill_chunk: object = None
 
 
 # a last-token logit past this magnitude is corruption, not a model
@@ -142,6 +161,157 @@ class _EngineFns(NamedTuple):
 # matmul) is exactly what a pure isfinite check is blind to
 _HEALTH_LOGIT_LIMIT = 1e30
 HEALTH_KINDS = {1: "nonfinite_logits", 2: "logit_magnitude"}
+
+
+def _window_core(cfg, pick, pad_id, params, caches, logits, kd, pos,
+                 remaining, eos, n_steps, step_fn, pin_state):
+    """THE masked fused-window scan — sampling rule, rng advance,
+    budget/EOS retirement — shared verbatim by the contiguous and the
+    paged engines (only `step_fn`, the per-token forward + cache fold,
+    differs), so paged token streams are bit-identical to contiguous
+    ones by construction rather than by parallel maintenance."""
+    def body(carry, _):
+        caches, logits, kd, pos, remaining = carry
+        live = remaining > 0
+        if cfg.temperature == 0.0:
+            # greedy consumes NO randomness (serial pick ignores its
+            # key too) — skip the S per-slot threefry splits, which
+            # otherwise dominate the per-step cost at small batch
+            toks = jax.vmap(lambda lg: pick(lg[None, :], None)[0])(
+                logits)
+        else:
+            pair = jax.vmap(jax.random.split)(
+                jax.random.wrap_key_data(kd))        # [S, 2] keys
+            # per-slot sampling over a [1, V] row — the EXACT serial
+            # pick call shape, so seeded sampling matches bit-for-bit
+            toks = jax.vmap(lambda lg, k: pick(lg[None, :], k)[0])(
+                logits, pair[:, 1])
+        toks = jnp.where(live, toks, pad_id).astype(jnp.int32)
+        if cfg.temperature > 0.0:
+            # the stream advances once per EMITTED token, same as the
+            # serial decode loop's one split per step
+            kd = jnp.where(live[:, None],
+                           jax.random.key_data(pair[:, 0]), kd)
+        new_logits, caches = step_fn(params, caches, toks, pos, live)
+        logits = jnp.where(live[:, None], new_logits, logits)
+        pos = jnp.where(live, pos + 1, pos)
+        remaining = jnp.where(live, remaining - 1, remaining)
+        hit = live & (eos >= 0) & (toks == eos)
+        remaining = jnp.where(hit, 0, remaining)
+        return (caches, logits, kd, pos, remaining), toks
+
+    (caches, logits, kd, pos, remaining), toks = lax.scan(
+        body, (caches, logits, kd, pos, remaining), None,
+        length=n_steps)
+    caches, logits = pin_state(caches, logits)
+    return (jnp.moveaxis(toks, 0, 1), caches, logits, kd, pos,
+            remaining)
+
+
+def _verify_core(cfg, pick, pad_id, K, t_max, params, caches, logits,
+                 kd, pos, remaining, eos, drafts, vlive, chunk_forward,
+                 tok_forward, pin_state):
+    # SPECULATIVE VERIFY — one dispatch turns K drafted tokens per
+    # slot into between 1 and K+1 EMITTED tokens per participating
+    # slot:
+    #   1. run all K drafts through the per-token forward widened to
+    #      K positions (the batched chunk fold appends their K/V and
+    #      attends with per-query causality), yielding the model's
+    #      next-token logits after each draft prefix;
+    #   2. accept the longest draft prefix the model itself would
+    #      have emitted (the pick rule per position — greedy argmax,
+    #      or the seeded sample along the request's exact key chain),
+    #      then take the model's OWN pick at the first disagreement
+    #      as a bonus token — so even a total draft miss emits
+    #      exactly the token a 1-step window would, bit-identically;
+    #   3. run ONE masked token step for the bonus (its K/V lands at
+    #      pos + accepted, overwriting the rejected draft's row) —
+    #      the logits every slot decodes from next, restoring the
+    #      window invariant exactly.
+    # Rejected-suffix cache rows beyond each slot's new frontier hold
+    # dead draft K/V, masked out of every later attend by the
+    # positional visibility rule and overwritten before they ever
+    # become visible — the same discipline as the batched decode
+    # path's dead rows. All accept/budget/EOS bookkeeping happens ON
+    # DEVICE; the host learns the outcome from the fetched
+    # (toks, n_emit, n_acc) rows. Shared verbatim by the contiguous
+    # and paged engines — only the two forwards' cache folds differ.
+    s_rows = drafts.shape[0]
+    live = jnp.asarray(vlive, jnp.bool_) & (remaining > 0)
+    L, caches = chunk_forward(params, caches, drafts, pos, live)
+    # K+1 candidate distributions along the accepted path:
+    # cand[:, 0] is the slot's incoming logits (predicting the first
+    # draft position), cand[:, j] the logits after drafts[:, :j]
+    cand = jnp.concatenate(
+        [logits.astype(L.dtype)[:, None], L], axis=1)
+    if cfg.temperature == 0.0:
+        flat = cand.reshape(-1, cand.shape[-1])
+        g = jax.vmap(lambda lg: pick(lg[None, :], None)[0])(
+            flat).reshape(s_rows, K + 1).astype(jnp.int32)
+        kd_chain = None
+    else:
+        # the request's exact serial key chain: one split per
+        # candidate step, token j sampled with split j's sub —
+        # identical math and order to the fused window's per-step
+        # vmapped split + pick
+        def samp(kd_c, lg_j):
+            pair = jax.vmap(jax.random.split)(
+                jax.random.wrap_key_data(kd_c))
+            t = jax.vmap(
+                lambda lg, kk: pick(lg[None, :], kk)[0])(
+                lg_j, pair[:, 1])
+            kd_n = jax.random.key_data(pair[:, 0])
+            return kd_n, (t, kd_n)
+
+        _, (g_t, chain) = lax.scan(samp, kd,
+                                   jnp.moveaxis(cand, 0, 1))
+        g = jnp.moveaxis(g_t, 0, 1).astype(jnp.int32)
+        kd_chain = jnp.moveaxis(chain, 0, 1)     # [S, K+1, 2]
+    # accepted prefix length m, the bonus pick g[m], and the emitted
+    # count n_f after budget + EOS truncation
+    matches = drafts.astype(jnp.int32) == g[:, :K]
+    m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1),
+                axis=1)
+    b = jnp.take_along_axis(g, m[:, None], axis=1)[:, 0]
+    cand_n = jnp.where(live,
+                       jnp.minimum(m + 1, remaining), 0)
+    ar = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+    drafts_ext = jnp.concatenate(
+        [drafts.astype(jnp.int32),
+         jnp.zeros((s_rows, 1), jnp.int32)], axis=1)
+    emitted = jnp.where(
+        ar < m[:, None], drafts_ext,
+        jnp.where(ar == m[:, None], b[:, None], pad_id))
+    is_eos = ((eos[:, None] >= 0) & (emitted == eos[:, None])
+              & (ar < cand_n[:, None]))
+    any_eos = jnp.any(is_eos, axis=1)
+    first = jnp.argmax(is_eos, axis=1).astype(cand_n.dtype)
+    n_f = jnp.where(any_eos, first + 1, cand_n)
+    n_acc = jnp.minimum(m, n_f)
+    toks = jnp.where(ar < n_f[:, None], emitted,
+                     pad_id).astype(jnp.int32)
+    # the bonus token's own masked step (appends at pos + m)
+    bonus_live = live & (n_f == m + 1)
+    bpos = jnp.clip(pos + m, 0, t_max - 1)
+    b_logits, caches = tok_forward(params, caches, b, bpos,
+                                   bonus_live)
+    after = jnp.take_along_axis(
+        cand, jnp.clip(n_f, 0, K)[:, None, None], axis=1)[:, 0]
+    new_logits = jnp.where(bonus_live[:, None],
+                           b_logits.astype(logits.dtype),
+                           after.astype(logits.dtype))
+    logits = jnp.where(live[:, None], new_logits, logits)
+    pos = jnp.where(live, pos + n_f, pos)
+    remaining = jnp.where(
+        live, jnp.where(any_eos, 0, remaining - n_f), remaining)
+    if kd_chain is not None:
+        kd_take = jnp.take_along_axis(
+            kd_chain, jnp.clip(n_f - 1, 0, K)[:, None, None],
+            axis=1)[:, 0]
+        kd = jnp.where(live[:, None], kd_take, kd)
+    caches, logits = pin_state(caches, logits)
+    return (toks, n_f.astype(jnp.int32), n_acc.astype(jnp.int32),
+            caches, logits, kd, pos, remaining)
 
 
 @functools.lru_cache(maxsize=16)
@@ -220,44 +390,12 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
         # the whole window is ONE device program, like the serial fused
         # scan — but each slot carries its own position, budget, and rng
         # stream, and dead slots ride along as bit-level no-ops
-        def body(carry, _):
-            caches, logits, kd, pos, remaining = carry
-            live = remaining > 0
-            if cfg.temperature == 0.0:
-                # greedy consumes NO randomness (serial pick ignores its
-                # key too) — skip the S per-slot threefry splits, which
-                # otherwise dominate the per-step cost at small batch
-                toks = jax.vmap(lambda lg: pick(lg[None, :], None)[0])(
-                    logits)
-            else:
-                pair = jax.vmap(jax.random.split)(
-                    jax.random.wrap_key_data(kd))        # [S, 2] keys
-                # per-slot sampling over a [1, V] row — the EXACT serial
-                # pick call shape, so seeded sampling matches
-                # bit-for-bit
-                toks = jax.vmap(lambda lg, k: pick(lg[None, :], k)[0])(
-                    logits, pair[:, 1])
-            toks = jnp.where(live, toks, pad_id).astype(jnp.int32)
-            if cfg.temperature > 0.0:
-                # the stream advances once per EMITTED token, same as
-                # the serial decode loop's one split per step
-                kd = jnp.where(live[:, None],
-                               jax.random.key_data(pair[:, 0]), kd)
-            new_logits, caches = masked_step(params, caches, toks, pos,
-                                             live, scales)
-            logits = jnp.where(live[:, None], new_logits, logits)
-            pos = jnp.where(live, pos + 1, pos)
-            remaining = jnp.where(live, remaining - 1, remaining)
-            hit = live & (eos >= 0) & (toks == eos)
-            remaining = jnp.where(hit, 0, remaining)
-            return (caches, logits, kd, pos, remaining), toks
+        def step_fn(params, caches, toks, pos, live):
+            return masked_step(params, caches, toks, pos, live, scales)
 
-        (caches, logits, kd, pos, remaining), toks = lax.scan(
-            body, (caches, logits, kd, pos, remaining), None,
-            length=n_steps)
-        caches, logits = pin_state(caches, logits)
-        return (jnp.moveaxis(toks, 0, 1), caches, logits, kd, pos,
-                remaining)
+        return _window_core(cfg, pick, pad_id, params, caches, logits,
+                            kd, pos, remaining, eos, n_steps, step_fn,
+                            pin_state)
 
     # eos (argnum 6) and the dequant scales (argnum 7) are read-only
     # across windows and deliberately NOT donated — the same device
@@ -335,127 +473,269 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
 
         def verify_body(params, caches, logits, kd, pos, remaining,
                         eos, scales, drafts, vlive):
-            # SPECULATIVE VERIFY — one dispatch turns K drafted tokens
-            # per slot into between 1 and K+1 EMITTED tokens per
-            # participating slot:
-            #   1. run all K drafts through the per-token forward
-            #      widened to K positions (the batched chunk fold
-            #      appends their K/V and attends with per-query
-            #      causality), yielding the model's next-token logits
-            #      after each draft prefix;
-            #   2. accept the longest draft prefix the model itself
-            #      would have emitted (the pick rule per position —
-            #      greedy argmax, or the seeded sample along the
-            #      request's exact key chain), then take the model's
-            #      OWN pick at the first disagreement as a bonus
-            #      token — so even a total draft miss emits exactly
-            #      the token a 1-step window would, bit-identically;
-            #   3. run ONE masked token step for the bonus (its K/V
-            #      lands at pos + accepted, overwriting the rejected
-            #      draft's row) — the logits every slot decodes from
-            #      next, restoring the window invariant exactly.
-            # Rejected-suffix cache rows beyond each slot's new
-            # frontier hold dead draft K/V, masked out of every later
-            # attend by the positional visibility rule and overwritten
-            # before they ever become visible — the same discipline as
-            # the batched decode path's dead rows. All accept/budget/
-            # EOS bookkeeping happens ON DEVICE; the host learns the
-            # outcome from the fetched (toks, n_emit, n_acc) rows.
-            s_rows = drafts.shape[0]
-            live = jnp.asarray(vlive, jnp.bool_) & (remaining > 0)
+            def chunk_forward(params, caches, drafts, pos, live):
+                def block_chunk_fold(i, kc, vc, q, k, v):
+                    extra = (scales[i] if quant else ())
+                    return chunk_fold(kc, vc, q, k, v, pos, live,
+                                      *extra)
 
-            def block_chunk_fold(i, kc, vc, q, k, v):
-                extra = (scales[i] if quant else ())
-                return chunk_fold(kc, vc, q, k, v, pos, live, *extra)
+                return _chunk_batch_forward(cfg, ln, params, caches,
+                                            drafts, pos,
+                                            block_chunk_fold)
 
-            L, caches = _chunk_batch_forward(cfg, ln, params, caches,
-                                             drafts, pos,
-                                             block_chunk_fold)
-            # K+1 candidate distributions along the accepted path:
-            # cand[:, 0] is the slot's incoming logits (predicting the
-            # first draft position), cand[:, j] the logits after
-            # drafts[:, :j]
-            cand = jnp.concatenate(
-                [logits.astype(L.dtype)[:, None], L], axis=1)
-            if cfg.temperature == 0.0:
-                flat = cand.reshape(-1, cand.shape[-1])
-                g = jax.vmap(lambda lg: pick(lg[None, :], None)[0])(
-                    flat).reshape(s_rows, K + 1).astype(jnp.int32)
-                kd_chain = None
-            else:
-                # the request's exact serial key chain: one split per
-                # candidate step, token j sampled with split j's sub —
-                # identical math and order to the fused window's
-                # per-step vmapped split + pick
-                def samp(kd_c, lg_j):
-                    pair = jax.vmap(jax.random.split)(
-                        jax.random.wrap_key_data(kd_c))
-                    t = jax.vmap(
-                        lambda lg, kk: pick(lg[None, :], kk)[0])(
-                        lg_j, pair[:, 1])
-                    kd_n = jax.random.key_data(pair[:, 0])
-                    return kd_n, (t, kd_n)
+            def tok_forward(params, caches, b, bpos, bonus_live):
+                def block_tok_fold(i, kc, vc, q, k, v):
+                    extra = (scales[i] if quant else ())
+                    return fold(kc, vc, q, k, v, bpos, bonus_live,
+                                *extra)
 
-                _, (g_t, chain) = lax.scan(samp, kd,
-                                           jnp.moveaxis(cand, 0, 1))
-                g = jnp.moveaxis(g_t, 0, 1).astype(jnp.int32)
-                kd_chain = jnp.moveaxis(chain, 0, 1)     # [S, K+1, 2]
-            # accepted prefix length m, the bonus pick g[m], and the
-            # emitted count n_f after budget + EOS truncation
-            matches = drafts.astype(jnp.int32) == g[:, :K]
-            m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1),
-                        axis=1)
-            b = jnp.take_along_axis(g, m[:, None], axis=1)[:, 0]
-            cand_n = jnp.where(live,
-                               jnp.minimum(m + 1, remaining), 0)
-            ar = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
-            drafts_ext = jnp.concatenate(
-                [drafts.astype(jnp.int32),
-                 jnp.zeros((s_rows, 1), jnp.int32)], axis=1)
-            emitted = jnp.where(
-                ar < m[:, None], drafts_ext,
-                jnp.where(ar == m[:, None], b[:, None], pad_id))
-            is_eos = ((eos[:, None] >= 0) & (emitted == eos[:, None])
-                      & (ar < cand_n[:, None]))
-            any_eos = jnp.any(is_eos, axis=1)
-            first = jnp.argmax(is_eos, axis=1).astype(cand_n.dtype)
-            n_f = jnp.where(any_eos, first + 1, cand_n)
-            n_acc = jnp.minimum(m, n_f)
-            toks = jnp.where(ar < n_f[:, None], emitted,
-                             pad_id).astype(jnp.int32)
-            # the bonus token's own masked step (appends at pos + m)
-            bonus_live = live & (n_f == m + 1)
-            bpos = jnp.clip(pos + m, 0, t_max - 1)
+                return _token_forward(cfg, ln, params, caches, b,
+                                      bpos, block_tok_fold)
 
-            def block_tok_fold(i, kc, vc, q, k, v):
-                extra = (scales[i] if quant else ())
-                return fold(kc, vc, q, k, v, bpos, bonus_live, *extra)
-
-            b_logits, caches = _token_forward(cfg, ln, params, caches,
-                                              b, bpos, block_tok_fold)
-            after = jnp.take_along_axis(
-                cand, jnp.clip(n_f, 0, K)[:, None, None], axis=1)[:, 0]
-            new_logits = jnp.where(bonus_live[:, None],
-                                   b_logits.astype(logits.dtype),
-                                   after.astype(logits.dtype))
-            logits = jnp.where(live[:, None], new_logits, logits)
-            pos = jnp.where(live, pos + n_f, pos)
-            remaining = jnp.where(
-                live, jnp.where(any_eos, 0, remaining - n_f), remaining)
-            if kd_chain is not None:
-                kd_take = jnp.take_along_axis(
-                    kd_chain, jnp.clip(n_f - 1, 0, K)[:, None, None],
-                    axis=1)[:, 0]
-                kd = jnp.where(live[:, None], kd_take, kd)
-            caches, logits = pin_state(caches, logits)
-            return (toks, n_f.astype(jnp.int32),
-                    n_acc.astype(jnp.int32), caches, logits, kd, pos,
-                    remaining)
+            return _verify_core(cfg, pick, pad_id, K, t_max, params,
+                                caches, logits, kd, pos, remaining,
+                                eos, drafts, vlive, chunk_forward,
+                                tok_forward, pin_state)
 
         verify = jax.jit(verify_body, donate_argnums=(1, 2, 3, 4, 5))
 
     return _EngineFns(init_caches, init_scales, window, insert, health,
                       verify)
+
+
+@functools.lru_cache(maxsize=16)
+def _paged_engine_fns(cfg, pad_id: int, quant: bool, draft_k,
+                      page_size: int, n_pages: int,
+                      n_slots: int) -> _EngineFns:
+    """Compile-once programs for a PAGED engine configuration — the
+    paged twin of `_engine_fns`, same process-wide sharing discipline.
+    The cache state is a per-block page POOL `[n_pages, page_size, H,
+    D]` (K and V) shared by every slot plus ONE `[S, t_max/page_size]`
+    int32 page table; the window/verify/chunk programs resolve slot
+    positions through the table via gather (the page-table-indirect
+    folds in ring_decode.py), and the sampling/retirement/accept math
+    is the SAME `_window_core`/`_verify_core` the contiguous programs
+    run — paged outputs are bit-identical to contiguous ones on a
+    1-device mesh because only the cache indirection differs. With
+    ``quant`` the pools hold int8 pages with per-(page, head) float32
+    scales: finer-grained than the contiguous per-slot scales, so int8
+    parity is gated on determinism + bounded drift, not bits
+    (docs/LONG_CONTEXT.md "Paged KV")."""
+    mesh, t_max = cfg.mesh, cfg.t_max
+    head_dim = cfg.embed_dim // cfg.num_heads
+    l_pages = t_max // page_size
+    fold = make_paged_batched_ring_decode(mesh, page_size=page_size,
+                                          jit=False, quantized=quant)
+    pchunk_fold = make_paged_chunk_ring_decode(
+        mesh, page_size=page_size, jit=False, quantized=quant)
+    ln = core.layer_norm(cfg.embed_dim)
+    pick = _make_pick(cfg)
+    pool_sh = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(meshlib.SEQ_AXIS))
+    rep = meshlib.replicated(mesh)
+
+    def pin_state(pools, logits):
+        # one canonical sharding spelling for every program's outputs,
+        # same jit-cache-stability discipline as the contiguous
+        # pin_state
+        pools = tuple(
+            (lax.with_sharding_constraint(kp, pool_sh),
+             lax.with_sharding_constraint(vp, pool_sh))
+            for kp, vp in pools)
+        return pools, lax.with_sharding_constraint(logits, rep)
+
+    def pin_scales(scales):
+        return tuple((lax.with_sharding_constraint(ks, rep),
+                      lax.with_sharding_constraint(vs, rep))
+                     for ks, vs in scales)
+
+    def init_caches(_n_slots: int):
+        # the POOL replaces the per-slot rows: page count — not slot
+        # count — is what a fixed HBM budget buys, which is the whole
+        # capacity story
+        def mk():
+            return meshlib.put_with_sharding(
+                np.zeros((n_pages, page_size, cfg.num_heads, head_dim),
+                         jnp.int8 if quant
+                         else jnp.dtype(cfg.cache_dtype)), pool_sh)
+
+        return tuple((mk(), mk()) for _ in range(cfg.num_blocks))
+
+    def init_scales(_n_slots: int):
+        if not quant:
+            return ()
+
+        def mk():
+            return meshlib.put_with_sharding(
+                np.zeros((n_pages, cfg.num_heads), np.float32), rep)
+
+        return tuple((mk(), mk()) for _ in range(cfg.num_blocks))
+
+    def masked_step(params, pools, pt, tok, pos, live, scales):
+        def block_fold(i, kp, vp, q, k, v):
+            extra = (scales[i] if quant else ())
+            return fold(kp, vp, pt, q, k, v, pos, live, *extra)
+
+        return _token_forward(cfg, ln, params, pools, tok, pos,
+                              block_fold)
+
+    def window_body(params, pools, pt, logits, kd, pos, remaining,
+                    eos, scales, n_steps):
+        def step_fn(params, pools, toks, pos, live):
+            return masked_step(params, pools, pt, toks, pos, live,
+                               scales)
+
+        return _window_core(cfg, pick, pad_id, params, pools, logits,
+                            kd, pos, remaining, eos, n_steps, step_fn,
+                            pin_state)
+
+    # pt (argnum 2), eos and the scales are read-only across windows
+    # and NOT donated — page-table rewrites go through the page_row
+    # program at grant time only
+    window = jax.jit(window_body, static_argnums=(9,),
+                     donate_argnums=(1, 3, 4, 5, 6))
+
+    def insert_body(logits, kd, pos, rem, eos, new_logits, slot,
+                    p_len, budget, eos_id, kd_row):
+        # the paged admission scatter touches NO cache state: the
+        # prompt's K/V already sits in the slot's granted pages
+        # (written there by the direct-to-pool chunk program), so
+        # admitting a request is a handful of scalar/row updates
+        logits = lax.dynamic_update_slice(
+            logits, new_logits.astype(logits.dtype), (slot, 0))
+        kd = lax.dynamic_update_slice(kd, kd_row[None], (slot, 0))
+        pos = pos.at[slot].set(p_len)
+        rem = rem.at[slot].set(budget)
+        eos = eos.at[slot].set(eos_id)
+        return (lax.with_sharding_constraint(logits, rep), kd, pos,
+                rem, eos)
+
+    insert = jax.jit(insert_body, donate_argnums=(0, 1, 2, 3, 4))
+
+    def page_row_body(pt, slot, row, rem, kill):
+        # one program serves both grant-time rewrites (kill=0) and the
+        # release-time KILL (kill=1, row=-1s): a released slot's device
+        # budget must hit zero IN THE SAME dispatch its page-table row
+        # clears, because its freed pages may be re-granted before the
+        # row's leftover device budget runs out — a still-live zombie
+        # row appending through a stale table would corrupt the new
+        # owner's pages (the contiguous mode's harmless-ride-along
+        # contract does NOT transfer to a shared pool)
+        pt = lax.dynamic_update_slice(pt, row[None].astype(pt.dtype),
+                                      (slot, 0))
+        rem = jnp.where(kill > 0, rem.at[slot].set(0), rem)
+        return lax.with_sharding_constraint(pt, rep), rem
+
+    page_row = jax.jit(page_row_body, donate_argnums=(0, 3))
+
+    stamp_scales = None
+    if quant:
+        def stamp_body(scales, src, dst):
+            # copy the source page's per-head scale onto freshly
+            # granted decode pages (dst padded with n_pages = OOB,
+            # dropped): decode appends quantize with their page's
+            # scale, and a fresh page has no content to derive one
+            # from yet
+            out = []
+            for ks, vs in scales:
+                kv = jnp.broadcast_to(ks[src][None],
+                                      (dst.shape[0], ks.shape[1]))
+                vv = jnp.broadcast_to(vs[src][None],
+                                      (dst.shape[0], vs.shape[1]))
+                out.append((ks.at[dst].set(kv, mode="drop",
+                                           unique_indices=True),
+                            vs.at[dst].set(vv, mode="drop",
+                                           unique_indices=True)))
+            return pin_scales(tuple(out))
+
+        stamp_scales = jax.jit(stamp_body, donate_argnums=(0,))
+
+    def chunk_body(params, pools, pt, scales, slot, tokens, start,
+                   p_end):
+        # one prompt CHUNK through every block, written STRAIGHT into
+        # the slot's granted pool pages — the paged engine's admission
+        # path never materializes a contiguous [1, t_max] cache.
+        # Structure mirrors models/lm.chunk_body with the paged chunk
+        # fold (page splice + gathered per-query attend + ring merge)
+        # in place of the contiguous one; `slot` is traced, so one
+        # executable serves every slot and every chunk incl. the
+        # ragged tail.
+        b, c = tokens.shape
+        pt_row = lax.dynamic_slice(pt, (slot, 0), (1, l_pages))
+        pos_tab = lax.dynamic_slice_in_dim(params["pos"], start, c,
+                                           axis=0)
+        h = jnp.take(params["embed"], tokens, axis=0) + pos_tab
+        new_pools, new_scales = [], []
+        for i in range(cfg.num_blocks):
+            p = params[f"block{i}"]
+            kp, vp = pools[i]
+            q, k, v = _project_qkv(cfg, ln, p, h, (c,))
+            if quant:
+                ks, vs = scales[i]
+                o, kp, vp, ks, vs = pchunk_fold(kp, vp, pt_row, q, k,
+                                                v, start, p_end, ks,
+                                                vs)
+                new_scales.append((ks, vs))
+            else:
+                o, kp, vp = pchunk_fold(kp, vp, pt_row, q, k, v,
+                                        start, p_end)
+            h = _attn_residual(p, h, o.reshape(b, c, cfg.embed_dim))
+            h = _mlp_residual(ln, p, h)
+            new_pools.append((kp, vp))
+        h_last = lax.dynamic_slice_in_dim(h, p_end - start - 1, 1,
+                                          axis=1)[:, 0]
+        logits = _final_logits(ln, params, h_last)
+        pools, logits = pin_state(tuple(new_pools), logits)
+        return (logits, pools,
+                pin_scales(tuple(new_scales)) if quant else ())
+
+    prefill_chunk = jax.jit(chunk_body, donate_argnums=(1, 3))
+
+    def health_body(logits):
+        lf = logits.astype(jnp.float32)
+        nonfinite = jnp.any(~jnp.isfinite(lf), axis=1)
+        huge = jnp.any(jnp.abs(lf) > _HEALTH_LOGIT_LIMIT, axis=1)
+        return jnp.where(nonfinite, 1,
+                         jnp.where(huge, 2, 0)).astype(jnp.int32)
+
+    health = jax.jit(health_body)
+
+    verify = None
+    if draft_k is not None:
+        K = int(draft_k)
+        pbchunk_fold = make_paged_batched_chunk_ring_decode(
+            mesh, page_size=page_size, jit=False, quantized=quant)
+
+        def verify_body(params, pools, pt, logits, kd, pos, remaining,
+                        eos, scales, drafts, vlive):
+            def chunk_forward(params, pools, drafts, pos, live):
+                def block_chunk_fold(i, kp, vp, q, k, v):
+                    extra = (scales[i] if quant else ())
+                    return pbchunk_fold(kp, vp, pt, q, k, v, pos,
+                                        live, *extra)
+
+                return _chunk_batch_forward(cfg, ln, params, pools,
+                                            drafts, pos,
+                                            block_chunk_fold)
+
+            def tok_forward(params, pools, b, bpos, bonus_live):
+                def block_tok_fold(i, kp, vp, q, k, v):
+                    extra = (scales[i] if quant else ())
+                    return fold(kp, vp, pt, q, k, v, bpos, bonus_live,
+                                *extra)
+
+                return _token_forward(cfg, ln, params, pools, b, bpos,
+                                      block_tok_fold)
+
+            return _verify_core(cfg, pick, pad_id, K, t_max, params,
+                                pools, logits, kd, pos, remaining,
+                                eos, drafts, vlive, chunk_forward,
+                                tok_forward, pin_state)
+
+        verify = jax.jit(verify_body, donate_argnums=(1, 3, 4, 5, 6))
+
+    return _EngineFns(init_caches, init_scales, window, insert, health,
+                      verify, page_row, stamp_scales, prefill_chunk)
 
 
 class SlotEngine:
@@ -483,9 +763,59 @@ class SlotEngine:
                  eos_id: int | None = None,
                  prefill_chunk: int | None = None,
                  prefix_cache=None, kv_dtype: str | None = None,
-                 draft_k: int | None = None):
+                 draft_k: int | None = None,
+                 kv_page_size: int | None = None,
+                 kv_pages: int | None = None,
+                 kv_decode_reserve: int | None = None):
         if n_slots < 1:
             raise ValueError(f"need n_slots >= 1, got {n_slots}")
+        # paged KV mode (ISSUE 11): the per-slot [t_max, H, D] ring
+        # rows are replaced by a pool of kv_pages fixed-size pages plus
+        # per-slot page tables — HBM holds tokens actually resident,
+        # not slots' worst cases. kv_decode_reserve bounds how many
+        # decode tokens are PRE-reserved at admission (default: the
+        # full budget — never exhausts mid-decode); a smaller reserve
+        # admits more optimistically and grows grants mid-decode,
+        # which can exhaust honestly (scheduler quarantine).
+        if (kv_page_size is None) != (kv_pages is None):
+            raise ValueError(
+                "paged KV needs BOTH kv_page_size and kv_pages (or "
+                "neither for the contiguous per-slot ring rows)")
+        self.paged = kv_page_size is not None
+        if self.paged:
+            kv_page_size, kv_pages = int(kv_page_size), int(kv_pages)
+            if prefill_chunk is None:
+                raise ValueError(
+                    "paged KV needs chunked prefill (prefill_chunk=C):"
+                    " prompts stream straight into pool pages chunk by"
+                    " chunk — there is no monolithic [1, t_max] cache "
+                    "to insert from")
+            if kv_page_size < 1 or t_max % kv_page_size:
+                raise ValueError(
+                    f"kv_page_size {kv_page_size} must be >= 1 and "
+                    f"divide t_max {t_max} so logical pages tile the "
+                    f"position space")
+            if int(prefill_chunk) % kv_page_size:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} must be a multiple"
+                    f" of kv_page_size {kv_page_size}: chunk "
+                    f"boundaries must land on the page grid so "
+                    f"completed pages are never rewritten (the prefix-"
+                    f"cache sharing invariant)")
+            if kv_pages * kv_page_size < t_max:
+                raise ValueError(
+                    f"kv_pages {kv_pages} x kv_page_size "
+                    f"{kv_page_size} < t_max {t_max}: one full-length "
+                    f"request could never be admitted")
+            if kv_decode_reserve is not None and kv_decode_reserve < 1:
+                raise ValueError(f"need kv_decode_reserve >= 1, got "
+                                 f"{kv_decode_reserve}")
+        elif kv_decode_reserve is not None:
+            raise ValueError("kv_decode_reserve needs paged KV "
+                             "(kv_page_size/kv_pages)")
+        self.kv_page_size = kv_page_size
+        self.kv_pages = kv_pages
+        self.kv_decode_reserve = kv_decode_reserve
         # draft_k arms speculative decoding: the engine compiles ONE
         # extra fixed-shape program (verify at exactly K draft tokens
         # per slot) and exposes begin_verify as an alternative window
@@ -526,7 +856,14 @@ class SlotEngine:
             raise ValueError(
                 f"prefix cache chunk {prefix_cache.chunk} != engine "
                 f"prefill_chunk {self.prefill_chunk}")
-        if prefix_cache is not None:
+        cache_is_paged = bool(getattr(prefix_cache, "is_paged", False))
+        if prefix_cache is not None and cache_is_paged != self.paged:
+            raise ValueError(
+                "prefix-cache flavor must match the engine: a paged "
+                "engine shares pool pages with PagedPrefixCache "
+                "snapshots; a contiguous engine stores array snapshots "
+                "in PrefixCache")
+        if prefix_cache is not None and not cache_is_paged:
             # store snapshots TRUNCATED to the prefix length (positions
             # past it are zeros by construction — storing the full
             # [1, t_max] row would inflate every snapshot's budget cost
@@ -561,10 +898,20 @@ class SlotEngine:
                 f"a time ([1, P] batches cannot shard over axes "
                 f"{non_seq}); build the engine on mesh.seq_mesh(n)")
         self._sfns = _serving_fns(self._cfg)
-        self._efns = _engine_fns(self._cfg, int(pad_id), self.kv_int8,
-                                 self.draft_k)
-        self._params = _place_params(params, self._cfg.mesh)
         self._n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
+        if self.paged:
+            if self.kv_pages % self._n_ring:
+                raise ValueError(
+                    f"kv_pages {self.kv_pages} must divide by the ring"
+                    f" size {self._n_ring}: the pool shards over the "
+                    f"page dim")
+            self._efns = _paged_engine_fns(
+                self._cfg, int(pad_id), self.kv_int8, self.draft_k,
+                self.kv_page_size, self.kv_pages, n_slots)
+        else:
+            self._efns = _engine_fns(self._cfg, int(pad_id),
+                                     self.kv_int8, self.draft_k)
+        self._params = _place_params(params, self._cfg.mesh)
         self.t_max = t_max
         self.n_slots = n_slots
         self.pad_id = int(pad_id)
@@ -605,6 +952,20 @@ class SlotEngine:
         # by windows) until the final chunk lands and insert scatters
         # the request into the batch row.
         self._prefills: dict[int, _PendingPrefill] = {}
+        # paged-mode state: the host free-list allocator, the device
+        # page table ([S, t_max/page_size] int32, -1 = unallocated),
+        # and per-slot grant bookkeeping (page ids + token capacity)
+        self._alloc = None
+        if self.paged:
+            self._alloc = PageAllocator(self.kv_pages,
+                                        self.kv_page_size)
+            self._l_pages = t_max // self.kv_page_size
+            self._pt = meshlib.put_with_sharding(
+                np.full((n_slots, self._l_pages), -1, np.int32), rep)
+            self._slot_pages: dict[int, list[int]] = {}
+            self._alloc_tokens = np.zeros(n_slots, np.int64)
+            if prefix_cache is not None:
+                prefix_cache.bind(self._alloc, self.kv_page_bytes())
 
     # -- slot lifecycle -------------------------------------------------
 
@@ -631,9 +992,23 @@ class SlotEngine:
         row's device state is left as-is: a cancelled row at worst
         decodes its bounded remaining budget as a dead ride-along, and
         the next admit's insert overwrites the full row (dead rows never
-        append or influence live ones — gated by test)."""
+        append or influence live ones — gated by test). On a paged
+        engine the slot is first KILLED on device (page-table row
+        cleared + device budget zeroed in one dispatch) and only then
+        are its page references returned — the freed pages may be
+        re-granted immediately, and a cancelled row with leftover
+        device budget writing through a stale table would corrupt the
+        new owner (the contiguous ride-along contract does not
+        transfer to a shared pool; gated by test). Pages a
+        prefix-cache snapshot still holds survive via their
+        refcounts."""
         self._occupied[slot] = False
         self._rem_h[slot] = 0
+        if self.paged:
+            if slot in self._slot_pages:
+                self._set_page_row(slot, [], kill=True)
+            self._alloc.release(self._slot_pages.pop(slot, []))
+            self._alloc_tokens[slot] = 0
 
     def _validate_admit(self, slot, prompt, max_new_tokens, rng):
         """The one admission contract, shared by the monolithic and
@@ -674,12 +1049,21 @@ class SlotEngine:
         eos = -1 if eos is None else int(eos)
         kd_row = (_key_data(rng) if rng is not None
                   else np.zeros(2, np.uint32))
-        (self._caches, self._logits, self._kd, self._pos, self._rem,
-         self._eos, self._scales) = self._efns.insert(
-            self._caches, self._logits, self._kd, self._pos, self._rem,
-            self._eos, self._scales, caches1, logits1, np.int32(slot),
-            np.int32(p_len), np.int32(max_new_tokens), np.int32(eos),
-            kd_row)
+        if self.paged:
+            # the prompt K/V already lives in the slot's pages — the
+            # paged insert is a scalar/row scatter only
+            (self._logits, self._kd, self._pos, self._rem,
+             self._eos) = self._efns.insert(
+                self._logits, self._kd, self._pos, self._rem,
+                self._eos, logits1, np.int32(slot), np.int32(p_len),
+                np.int32(max_new_tokens), np.int32(eos), kd_row)
+        else:
+            (self._caches, self._logits, self._kd, self._pos, self._rem,
+             self._eos, self._scales) = self._efns.insert(
+                self._caches, self._logits, self._kd, self._pos,
+                self._rem, self._eos, self._scales, caches1, logits1,
+                np.int32(slot), np.int32(p_len),
+                np.int32(max_new_tokens), np.int32(eos), kd_row)
         self._pos_h[slot] = p_len
         self._rem_h[slot] = max_new_tokens
         self._eos_h[slot] = eos
@@ -743,6 +1127,10 @@ class SlotEngine:
         if self.prefill_chunk is None:
             raise RuntimeError("engine built without prefill_chunk")
         prompt = self._validate_admit(slot, prompt, max_new_tokens, rng)
+        if self.paged:
+            self._start_prefill_paged(slot, prompt, max_new_tokens,
+                                      rng, eos_id, tag)
+            return
         start, caches, logits = 0, None, None
         if self.prefix_cache is not None:
             start, caches, logits = self.prefix_cache.lookup(prompt[0])
@@ -753,6 +1141,103 @@ class SlotEngine:
             prompt=prompt, budget=int(max_new_tokens), rng=rng,
             eos_id=eos_id, caches=caches, logits=logits,
             next_start=start, tag=tag)
+
+    def _pages_for(self, p_len: int, budget: int) -> int:
+        """Pages an admission reserves: the prompt plus the decode
+        reservation (the full budget unless kv_decode_reserve bounds
+        it), on the page grid."""
+        eff = (budget if self.kv_decode_reserve is None
+               else min(budget, self.kv_decode_reserve))
+        tokens = min(p_len + eff, self.t_max)
+        return -(-tokens // self.kv_page_size)
+
+    def can_admit_pages(self, p_len: int, budget: int) -> bool:
+        """The scheduler's page-aware admission gate: True when pages
+        for `p_len` prompt tokens plus the decode reservation exist
+        (reclaiming LRU prefix-cache snapshots if the free list alone
+        is short). Conservative — a prefix-cache hit at the actual
+        admission can only REDUCE the fresh-page need — so a True here
+        guarantees `start_prefill` succeeds. Always True on a
+        contiguous engine (slot availability is the only gate there).
+
+        Evictions only happen when they can actually make the head
+        admissible: a blocked head re-asking every cycle must not
+        grind the whole cache away for zero admission benefit, so the
+        gate first checks how many pages eviction could genuinely
+        free (snapshot pages no live slot shares)."""
+        if not self.paged:
+            return True
+        need = self._pages_for(p_len, budget)
+        free = self._alloc.free_count()
+        if free >= need:
+            return True
+        if (self.prefix_cache is None
+                or free + self.prefix_cache.reclaimable_pages() < need):
+            return False
+        self.prefix_cache.reclaim(need - free)
+        return self._alloc.free_count() >= need
+
+    def _set_page_row(self, slot: int, pages: list[int], *,
+                      kill: bool = False) -> None:
+        row = np.full(self._l_pages, -1, np.int32)
+        row[:len(pages)] = pages
+        self._pt, self._rem = self._efns.page_row(
+            self._pt, np.int32(slot), row, self._rem,
+            np.int32(1 if kill else 0))
+
+    def _stamp_decode_scales(self, pages: list[int], src: int) -> None:
+        """int8 pools: freshly granted decode pages inherit the slot's
+        last content-bearing page's per-head scale — a fresh page has
+        no content to derive one from, and the append path quantizes
+        with its target page's scale."""
+        if not self.kv_int8 or not pages:
+            return
+        dst = np.full(self._l_pages, self.kv_pages, np.int32)
+        dst[:len(pages)] = pages
+        self._scales = self._efns.stamp_scales(self._scales,
+                                               np.int32(src), dst)
+
+    def _start_prefill_paged(self, slot, prompt, max_new_tokens, rng,
+                             eos_id, tag) -> None:
+        """Paged admission: grant pages for prompt + reservation (the
+        prefix-cache hit contributes its pages SHARED — refcounted,
+        read-only, zero-copy), write the slot's page-table row, and
+        register the pending prefill; chunks then stream straight into
+        the granted pages."""
+        p_len = prompt.shape[1]
+        start, shared, logits = 0, [], None
+        if self.prefix_cache is not None:
+            start, shared, logits = self.prefix_cache.lookup(prompt[0])
+            shared = list(shared or [])
+        if shared:
+            # the slot takes its OWN reference on the snapshot's pages:
+            # release() drops it symmetrically whether or not the
+            # snapshot is evicted while this request runs
+            self._alloc.retain(shared)
+        fresh_n = self._pages_for(p_len, max_new_tokens) - len(shared)
+        fresh = self._alloc.alloc(fresh_n)
+        if (fresh is None and self.prefix_cache is not None
+                and (self._alloc.free_count()
+                     + self.prefix_cache.reclaimable_pages())
+                >= fresh_n):
+            self.prefix_cache.reclaim(fresh_n
+                                      - self._alloc.free_count())
+            fresh = self._alloc.alloc(fresh_n)
+        if fresh is None:
+            if shared:
+                self._alloc.release(shared)
+            raise PageExhausted(
+                f"admission needs {fresh_n} fresh pages, only "
+                f"{self._alloc.free_count()} free — gate admissions "
+                f"on can_admit_pages()")
+        pages = shared + fresh
+        self._set_page_row(slot, pages)
+        self._alloc_tokens[slot] = len(pages) * self.kv_page_size
+        self._prefills[slot] = _PendingPrefill(
+            prompt=prompt, budget=int(max_new_tokens), rng=rng,
+            eos_id=eos_id, caches=None, logits=logits,
+            next_start=start, tag=tag, pages=pages,
+            shared=len(shared))
 
     def prefill_step(self, slot: int) -> bool:
         """Advance `slot`'s pending prefill by ONE chunk dispatch;
@@ -778,16 +1263,44 @@ class SlotEngine:
                 padded = np.zeros((1, c), np.int32)
                 padded[:, :end - pend.next_start] = pend.prompt[
                     :, pend.next_start:end]
-                pend.logits, pend.caches = self._sfns.prefill_chunk(
-                    self._params, pend.caches, padded,
-                    np.int32(pend.next_start), np.int32(end))
+                if self.paged:
+                    # direct-to-pool: the chunk program resolves the
+                    # slot's pages through the table and writes K/V
+                    # straight into them — no [1, t_max] intermediate
+                    pend.logits, self._caches, new_scales = (
+                        self._efns.prefill_chunk(
+                            self._params, self._caches, self._pt,
+                            self._scales, np.int32(slot), padded,
+                            np.int32(pend.next_start), np.int32(end)))
+                    if self.kv_int8:
+                        self._scales = new_scales
+                else:
+                    pend.logits, pend.caches = self._sfns.prefill_chunk(
+                        self._params, pend.caches, padded,
+                        np.int32(pend.next_start), np.int32(end))
                 pend.next_start = end
                 if (self.prefix_cache is not None and end % c == 0):
-                    self.prefix_cache.insert(pend.prompt[0, :end],
-                                             pend.caches, pend.logits)
+                    if self.paged:
+                        # the snapshot IS the slot's pages [0, end):
+                        # page-aligned, fully written, never written
+                        # again — sharing them costs refcounts, not
+                        # copies
+                        self.prefix_cache.insert(
+                            pend.prompt[0, :end],
+                            pend.pages[:end // self.kv_page_size],
+                            pend.logits)
+                    else:
+                        self.prefix_cache.insert(pend.prompt[0, :end],
+                                                 pend.caches,
+                                                 pend.logits)
             done = pend.next_start >= p_len
         if done:
             del self._prefills[slot]
+            if self.paged:
+                self._slot_pages[slot] = pend.pages
+                n_prompt = -(-p_len // self.kv_page_size)
+                self._stamp_decode_scales(pend.pages[n_prompt:],
+                                          pend.pages[n_prompt - 1])
             self._insert(slot, pend.caches, pend.logits, p_len,
                          pend.budget, pend.eos_id, pend.rng)
         return done
@@ -795,8 +1308,17 @@ class SlotEngine:
     def cancel_prefill(self, slot: int) -> None:
         """Drop a pending prefill (deadline hit while still chunking):
         the partial caches are discarded and the slot returns to
-        free_slots immediately — nothing ever reached the batch row."""
-        self._prefills.pop(slot, None)
+        free_slots immediately — nothing ever reached the batch row.
+        A paged engine returns the grant to the allocator (snapshot-
+        shared pages survive via their cache refs)."""
+        pend = self._prefills.pop(slot, None)
+        if pend is not None and self.paged and pend.pages:
+            # the slot's device row is already dead (it never reached
+            # insert), but its table row points at the dying grant —
+            # clear it before the pages can be re-granted
+            self._set_page_row(slot, [], kill=True)
+            self._alloc.release(pend.pages)
+            self._alloc_tokens[slot] = 0
 
     def prefilling(self) -> list[int]:
         """Slots with a chunked prefill in progress, admission order."""
@@ -815,10 +1337,17 @@ class SlotEngine:
             raise ValueError(f"need n_steps >= 1, got {n_steps}")
         snapshot = (self._rem_h.copy(), self._occupied.copy(),
                     self._eos_h.copy())
-        toks, self._caches, self._logits, self._kd, self._pos, self._rem = (
-            self._efns.window(self._params, self._caches, self._logits,
-                              self._kd, self._pos, self._rem, self._eos,
-                              self._scales, n_steps))
+        if self.paged:
+            (toks, self._caches, self._logits, self._kd, self._pos,
+             self._rem) = self._efns.window(
+                self._params, self._caches, self._pt, self._logits,
+                self._kd, self._pos, self._rem, self._eos,
+                self._scales, n_steps)
+        else:
+            (toks, self._caches, self._logits, self._kd, self._pos,
+             self._rem) = self._efns.window(
+                self._params, self._caches, self._logits, self._kd,
+                self._pos, self._rem, self._eos, self._scales, n_steps)
         self._pending = (toks, snapshot)
 
     def spec_room(self, slot: int) -> bool:
@@ -832,6 +1361,50 @@ class SlotEngine:
         if self.draft_k is None:
             return False
         return bool(self._pos_h[slot] + self.draft_k + 1 <= self.t_max)
+
+    def ensure_decode_room(self, n_tokens: int) -> list[int]:
+        """Paged engines only (contiguous rooms are sized at admission
+        — returns []): grow every occupied slot's page grant so the
+        next dispatch can emit up to min(n_tokens, remaining budget)
+        tokens without writing an unallocated page. Returns the slots
+        that could NOT be granted after exhausting the free list and
+        the prefix cache's reclaimable snapshots — the scheduler
+        quarantines those (finish or retry honestly) BEFORE
+        dispatching, so a starved slot can never corrupt a neighbor's
+        pages (an unallocated append would be dropped, not misplaced,
+        but the emitted token would be attention-blind to it — hence
+        the hard gate). With the default full-budget reservation this
+        is a no-op; it only grants when kv_decode_reserve admitted
+        optimistically."""
+        if not self.paged:
+            return []
+        failed = []
+        ps = self.kv_page_size
+        for slot in range(self.n_slots):
+            if not self._occupied[slot] or self._rem_h[slot] < 1:
+                continue
+            target = int(self._pos_h[slot]
+                         + min(int(n_tokens), int(self._rem_h[slot])))
+            if target <= self._alloc_tokens[slot]:
+                continue
+            need = -(-(target - int(self._alloc_tokens[slot])) // ps)
+            fresh = self._alloc.alloc(need)
+            if (fresh is None and self.prefix_cache is not None
+                    and (self._alloc.free_count()
+                         + self.prefix_cache.reclaimable_pages())
+                    >= need):
+                self.prefix_cache.reclaim(need
+                                          - self._alloc.free_count())
+                fresh = self._alloc.alloc(need)
+            if fresh is None:
+                failed.append(slot)
+                continue
+            pages = self._slot_pages[slot]
+            self._stamp_decode_scales(fresh, pages[-1])
+            pages.extend(fresh)
+            self._set_page_row(slot, pages)
+            self._alloc_tokens[slot] = len(pages) * ps
+        return failed
 
     def begin_verify(self, drafts, vlive, proposed=None) -> None:
         """Dispatch ONE speculative verify (async, collected like a
@@ -882,11 +1455,18 @@ class SlotEngine:
                     f"bonus before t_max {self.t_max}")
         snapshot = (self._rem_h.copy(), self._occupied.copy(),
                     self._eos_h.copy())
-        (toks, n_emit, n_acc, self._caches, self._logits, self._kd,
-         self._pos, self._rem) = self._efns.verify(
-            self._params, self._caches, self._logits, self._kd,
-            self._pos, self._rem, self._eos, self._scales, drafts,
-            vlive)
+        if self.paged:
+            (toks, n_emit, n_acc, self._caches, self._logits, self._kd,
+             self._pos, self._rem) = self._efns.verify(
+                self._params, self._caches, self._pt, self._logits,
+                self._kd, self._pos, self._rem, self._eos,
+                self._scales, drafts, vlive)
+        else:
+            (toks, n_emit, n_acc, self._caches, self._logits, self._kd,
+             self._pos, self._rem) = self._efns.verify(
+                self._params, self._caches, self._logits, self._kd,
+                self._pos, self._rem, self._eos, self._scales, drafts,
+                vlive)
         self._pending = (toks, snapshot, (n_emit, n_acc, vlive,
                                           proposed))
 
@@ -1023,10 +1603,20 @@ class SlotEngine:
         slot must not grow these (gated by test)."""
         out = {"window": self._efns.window._cache_size(),
                "insert": self._efns.insert._cache_size(),
-               "prefill": self._sfns.prefill._cache_size(),
                "health": self._efns.health._cache_size()}
-        if self.prefill_chunk is not None:
-            out["prefill_chunk"] = self._sfns.prefill_chunk._cache_size()
+        if self.paged:
+            # the paged admission path: direct-to-pool chunks + the
+            # grant-path programs (no bucketed monolithic prefill)
+            out["prefill_chunk"] = self._efns.prefill_chunk._cache_size()
+            out["page_row"] = self._efns.page_row._cache_size()
+            if self.kv_int8:
+                out["stamp_scales"] = (
+                    self._efns.stamp_scales._cache_size())
+        else:
+            out["prefill"] = self._sfns.prefill._cache_size()
+            if self.prefill_chunk is not None:
+                out["prefill_chunk"] = (
+                    self._sfns.prefill_chunk._cache_size())
         if self.draft_k is not None:
             out["verify"] = self._efns.verify._cache_size()
         return out
@@ -1044,6 +1634,45 @@ class SlotEngine:
 
         out = {}
         with prof.compiling(None):
+            if self.paged:
+                # paged programs register under their own names so the
+                # profile serve verb can put the gather-indirection
+                # cost NEXT TO the contiguous serve.window figure
+                out["serve.window_paged"] = prof.register_program(
+                    "serve.window_paged",
+                    self._efns.window.lower(
+                        self._params, self._caches, self._pt,
+                        self._logits, self._kd, self._pos, self._rem,
+                        self._eos, self._scales, window).compile())
+                out["serve.insert_paged"] = prof.register_program(
+                    "serve.insert_paged",
+                    self._efns.insert.lower(
+                        self._logits, self._kd, self._pos, self._rem,
+                        self._eos,
+                        jnp.zeros((1, self._logits.shape[1]),
+                                  self._logits.dtype),
+                        np.int32(0), np.int32(0), np.int32(0),
+                        np.int32(-1),
+                        np.zeros(2, np.uint32)).compile())
+                c = self.prefill_chunk
+                out["serve.prefill_chunk_paged"] = prof.register_program(
+                    "serve.prefill_chunk_paged",
+                    self._efns.prefill_chunk.lower(
+                        self._params, self._caches, self._pt,
+                        self._scales, np.int32(0),
+                        np.zeros((1, c), np.int32), np.int32(0),
+                        np.int32(c)).compile())
+                if self.draft_k is not None:
+                    out["lm.verify"] = prof.register_program(
+                        "lm.verify",
+                        self._efns.verify.lower(
+                            self._params, self._caches, self._pt,
+                            self._logits, self._kd, self._pos,
+                            self._rem, self._eos, self._scales,
+                            np.zeros((self.n_slots, self.draft_k),
+                                     np.int32),
+                            np.zeros(self.n_slots, bool)).compile())
+                return out
             out["serve.window"] = prof.register_program(
                 "serve.window",
                 self._efns.window.lower(
@@ -1091,7 +1720,24 @@ class SlotEngine:
         the masked window at `n_steps`. Runs on the real (empty) engine
         state with a ZERO budget, so every row stays dead and the
         warmup dispatches are bit-level no-ops."""
-        if self.prefill_chunk is not None:
+        if self.paged:
+            # two chunk steps against the live pool with an
+            # all-unallocated page table and p_end == start == 0:
+            # every page write drops, so the dispatches are bit-level
+            # no-ops that compile the chunk-from-fresh AND the
+            # chunk-from-chunk chains (pools flow through EVERY paged
+            # program under one pinned sharding)
+            c = self.prefill_chunk
+            logits1 = None
+            for _ in range(2):
+                logits1, self._caches, sc = self._efns.prefill_chunk(
+                    self._params, self._caches, self._pt, self._scales,
+                    np.int32(0), np.zeros((1, c), np.int32),
+                    np.int32(0), np.int32(0))
+                if self.kv_int8:
+                    self._scales = sc
+            caches1 = None
+        elif self.prefill_chunk is not None:
             c = self.prefill_chunk
             caches1 = self._sfns.init_caches(1)
             # two chunk steps: the first consumes init_caches' arrays,
@@ -1114,12 +1760,19 @@ class SlotEngine:
         # so the second cycle warms exactly the executables the serve
         # loop reuses forever
         for _ in range(2):
-            (self._caches, self._logits, self._kd, self._pos, self._rem,
-             self._eos, self._scales) = self._efns.insert(
-                self._caches, self._logits, self._kd, self._pos,
-                self._rem, self._eos, self._scales, caches1, logits1,
-                np.int32(0), np.int32(1), np.int32(0), np.int32(-1),
-                np.zeros(2, np.uint32))
+            if self.paged:
+                (self._logits, self._kd, self._pos, self._rem,
+                 self._eos) = self._efns.insert(
+                    self._logits, self._kd, self._pos, self._rem,
+                    self._eos, logits1, np.int32(0), np.int32(1),
+                    np.int32(0), np.int32(-1), np.zeros(2, np.uint32))
+            else:
+                (self._caches, self._logits, self._kd, self._pos,
+                 self._rem, self._eos, self._scales) = self._efns.insert(
+                    self._caches, self._logits, self._kd, self._pos,
+                    self._rem, self._eos, self._scales, caches1, logits1,
+                    np.int32(0), np.int32(1), np.int32(0), np.int32(-1),
+                    np.zeros(2, np.uint32))
             self.step_window(n_steps)
             if self.draft_k is not None:
                 # the verify program at its ONE fixed shape, chained
@@ -1130,6 +1783,17 @@ class SlotEngine:
                     np.zeros((self.n_slots, self.draft_k), np.int32),
                     np.zeros(self.n_slots, bool))
                 self.collect()
+        if self.paged:
+            # the grant/release-path program: a page-row rewrite with
+            # the unallocated row slot 0 already holds (and the kill
+            # branch exercised — slot 0's budget is already 0) plus,
+            # int8, the scale stamp with every target out of bounds —
+            # all bit-level no-ops at the real executables' shapes
+            self._set_page_row(0, [], kill=True)
+            if self.kv_int8:
+                self._scales = self._efns.stamp_scales(
+                    self._scales, np.int32(0),
+                    np.full(self._l_pages, self.kv_pages, np.int32))
         # the health reduce is part of the armed serve loop's steady
         # state (one dispatch per cycle) — warm it with everything else
         self.slot_health()
@@ -1137,7 +1801,12 @@ class SlotEngine:
     def kv_bytes_per_slot(self) -> int:
         """HBM bytes of ring-cache state per decode slot (K + V rows
         across blocks, plus dequant scales when int8) — the denominator
-        of the int8 capacity claim: slots_at_budget = budget // this."""
+        of the int8 capacity claim: slots_at_budget = budget // this.
+        On a PAGED engine this is the WORST CASE (a full-t_max
+        request's pages); the live figure is `kv_bytes_resident`,
+        because short requests no longer reserve t_max."""
+        if self.paged:
+            return self._l_pages * self.kv_page_bytes()
         per = 0
         for kc, vc in self._caches:
             per += (kc.nbytes + vc.nbytes) // self.n_slots
@@ -1145,3 +1814,53 @@ class SlotEngine:
             for s in pair:
                 per += s.nbytes // self.n_slots
         return per
+
+    def kv_page_bytes(self) -> int:
+        """HBM bytes ONE page costs across every block's K + V pools,
+        plus its per-(page, head) dequant scales when int8 — the unit
+        the tokens-per-HBM-byte capacity claim divides by."""
+        head_dim = self._cfg.embed_dim // self._cfg.num_heads
+        item = (1 if self.kv_int8
+                else jnp.dtype(self._cfg.cache_dtype).itemsize)
+        per = (self._cfg.num_blocks * 2 * self.kv_page_size
+               * self._cfg.num_heads * head_dim * item)
+        if self.kv_int8:
+            per += self._cfg.num_blocks * 2 * self._cfg.num_heads * 4
+        return per
+
+    def kv_bytes_resident(self) -> int:
+        """HBM bytes of KV state currently RESERVED: the paged
+        counterpart of `kv_bytes_per_slot` — used pages times page
+        bytes. A contiguous engine reserves every slot's full row up
+        front, so its figure is constant at n_slots * per-slot bytes;
+        the ratio of the two under mixed-length traffic IS the paged
+        capacity win."""
+        if not self.paged:
+            return self.n_slots * self.kv_bytes_per_slot()
+        return self._alloc.used_count() * self.kv_page_bytes()
+
+    def tokens_resident(self) -> int:
+        """Tokens of KV actually held on device right now: decoded
+        positions of occupied slots plus prefilled positions of
+        pending chunked admissions. tokens_resident /
+        kv_bytes_resident is the tokens-per-HBM-byte figure the paged
+        engine exists to raise."""
+        toks = int(sum(int(self._pos_h[s]) for s in range(self.n_slots)
+                       if self._occupied[s]))
+        toks += int(sum(p.next_start for p in self._prefills.values()))
+        return toks
+
+    def page_stats(self) -> dict:
+        """The per-cycle page/occupancy rollup the scheduler feeds to
+        ServingMetrics.on_pages (paged engines only — None tells the
+        caller the engine is contiguous)."""
+        if not self.paged:
+            return None
+        return {
+            "pages_total": self.kv_pages,
+            "pages_used": self._alloc.used_count(),
+            "pages_cached": (self.prefix_cache.cached_pages()
+                             if self.prefix_cache is not None else 0),
+            "resident_tokens": self.tokens_resident(),
+            "resident_bytes": self.kv_bytes_resident(),
+        }

@@ -115,8 +115,34 @@ class ServingMetrics:
         self._m_spec_accepted = reg.counter(
             "serve_spec_accepted_tokens_total",
             "draft tokens the verify accepted (emitted as-is)")
+        # paged KV (ISSUE 11): pool occupancy gauges — the live
+        # tokens-resident-per-HBM-byte capacity signals — plus the
+        # page-exhaustion backpressure counter
+        self._m_pages_used = reg.gauge(
+            "serve_kv_pages_used",
+            "KV pool pages currently allocated (slots + prefix-cache "
+            "snapshots), last cycle")
+        self._m_pages_total = reg.gauge(
+            "serve_kv_pages_total",
+            "total KV pool pages the paged engine was built with")
+        self._m_pages_cached = reg.gauge(
+            "serve_kv_pages_cached",
+            "distinct KV pool pages pinned by prefix-cache snapshots "
+            "(a subset of serve_kv_pages_used; shared zero-copy with "
+            "the slots that wrote them), last cycle")
+        self._m_page_exhausted = reg.counter(
+            "serve_page_exhaustions_total",
+            "cycles the paged engine refused work for lack of free "
+            "pages (admission gate or mid-decode growth)")
         self._jit_cache_seen: int | None = None
         self.compiles_observed = 0
+        # paged-KV rollup (all zero/None on contiguous engines)
+        self.kv_pages_total: int | None = None
+        self.kv_pages_used_peak = 0
+        self.kv_resident_tokens_peak = 0
+        self.kv_resident_bytes_peak = 0
+        self.kv_tokens_per_byte_peak: float | None = None
+        self.page_exhaustions = 0
         # speculative rollup: dispatch counts by kind plus the draft
         # ledger (slot_verifies = per-slot participations, the
         # denominator of the per-slot tokens-per-dispatch figure)
@@ -295,6 +321,41 @@ class ServingMetrics:
         self._log(event="serve_spec_verify", drafted=drafted,
                   accepted=accepted, emitted=emitted, slots=slots)
 
+    # -- paged KV ---------------------------------------------------------
+
+    def on_pages(self, *, pages_total: int, pages_used: int,
+                 pages_cached: int, resident_tokens: int,
+                 resident_bytes: int) -> None:
+        """Per-cycle page-pool occupancy from the paged engine
+        (engine.page_stats): gauges for live scraping plus the peak
+        rollup the summary reports — peak resident tokens over the
+        bytes backing them is the tokens-per-HBM-byte capacity claim.
+        Logs nothing per cycle (one gauge set per cycle, no event
+        spam)."""
+        if self.kv_pages_total is None:
+            self._m_pages_total.set(pages_total)
+        self.kv_pages_total = int(pages_total)
+        self._m_pages_used.set(pages_used)
+        self._m_pages_cached.set(pages_cached)
+        self.kv_pages_used_peak = max(self.kv_pages_used_peak,
+                                      int(pages_used))
+        if resident_tokens > self.kv_resident_tokens_peak:
+            self.kv_resident_tokens_peak = int(resident_tokens)
+            if resident_bytes > 0:
+                self.kv_tokens_per_byte_peak = (resident_tokens
+                                                / resident_bytes)
+        self.kv_resident_bytes_peak = max(self.kv_resident_bytes_peak,
+                                          int(resident_bytes))
+
+    def on_page_exhausted(self, *, rid=None, needed: int = 0) -> None:
+        """The paged engine could not grant pages this cycle —
+        admission held the queue head back, or a running slot's
+        mid-decode growth failed. New event type only; the frozen
+        historical schemas are untouched."""
+        self.page_exhaustions += 1
+        self._m_page_exhausted.inc()
+        self._log(event="serve_page_exhausted", id=rid, needed=needed)
+
     # -- engine cycle ----------------------------------------------------
 
     def on_cycle(self, *, queue_depth: int, occupancy: float,
@@ -408,6 +469,24 @@ class ServingMetrics:
             "serve_spec_tokens_per_dispatch": (
                 round(self.spec_emitted / self.spec_slot_verifies, 3)
                 if self.spec_slot_verifies else None),
+            # paged-KV rollup (additive, ISSUE 11): pool size and peak
+            # occupancy, the peak tokens-resident-per-HBM-byte the
+            # capacity claim is stated in, and how often the pool ran
+            # dry — all None/0 on contiguous engines
+            "serve_kv_pages_total": self.kv_pages_total,
+            "serve_kv_pages_used_peak": (
+                self.kv_pages_used_peak
+                if self.kv_pages_total is not None else None),
+            "serve_kv_resident_tokens_peak": (
+                self.kv_resident_tokens_peak
+                if self.kv_pages_total is not None else None),
+            "serve_kv_resident_bytes_peak": (
+                self.kv_resident_bytes_peak
+                if self.kv_pages_total is not None else None),
+            "serve_kv_tokens_per_hbm_byte": (
+                None if self.kv_tokens_per_byte_peak is None
+                else round(self.kv_tokens_per_byte_peak, 6)),
+            "serve_page_exhaustions": self.page_exhaustions,
         }
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.summary())

@@ -1,0 +1,96 @@
+"""Host-side free-list allocator for the paged KV pool (serve/engine.py
+paged mode).
+
+The device holds one fixed page pool per block — `[n_pages, page_size,
+H, D]` K and V arrays — and an `[S, t_max/page_size]` int32 page table
+mapping each slot's logical pages to physical ones. THIS class owns the
+physical pages: admission asks it for pages covering the prompt plus
+the decode reservation, slot release returns them, and the radix prefix
+cache retains extra references so a snapshot's pages survive the slot
+that wrote them.
+
+Pages are REFERENCE COUNTED, not exclusively owned: a chunk-boundary
+snapshot shares the very pages the prefilling slot wrote (they are
+page-aligned and never written again — see docs/LONG_CONTEXT.md "Paged
+KV"), so a prefix-cache hit costs zero copies and a shared page is
+freed only when the last holder (slot or snapshot) releases it.
+
+Allocation is DETERMINISTIC (lowest free id first, via a heap): a
+replayed drill performs the identical alloc/release sequence and gets
+the identical physical placement, which keeps fault-injection runs
+bit-reproducible like every other serve drill.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+
+class PageExhausted(RuntimeError):
+    """Raised when a grant cannot be satisfied — the scheduler's
+    admission gate (`SlotEngine.can_admit_pages`) exists to make this
+    unreachable on the admission path; mid-decode growth surfaces it
+    as an honest per-request quarantine instead."""
+
+
+class PageAllocator:
+    """Free list + refcounts over `n_pages` fixed-size KV pages."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 1:
+            raise ValueError(f"need n_pages >= 1, got {n_pages}")
+        if page_size < 1:
+            raise ValueError(f"need page_size >= 1, got {page_size}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._free = list(range(self.n_pages))
+        heapq.heapify(self._free)
+        self._refs = np.zeros(self.n_pages, np.int64)
+
+    # -- grants -----------------------------------------------------------
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Grant `n` fresh pages (refcount 1 each), lowest ids first;
+        None — and NO partial grant — when fewer than `n` are free."""
+        if n < 0:
+            raise ValueError(f"need n >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        out = [heapq.heappop(self._free) for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def retain(self, pages) -> None:
+        """Add one reference to each page (prefix-cache snapshot, or a
+        hit handing shared prefix pages to a new slot)."""
+        for p in pages:
+            if self._refs[p] < 1:
+                raise ValueError(f"retain of free page {p}")
+            self._refs[p] += 1
+
+    def release(self, pages) -> int:
+        """Drop one reference per page; pages reaching zero return to
+        the free list. Returns how many were actually freed."""
+        freed = 0
+        for p in pages:
+            if self._refs[p] < 1:
+                raise ValueError(f"release of free page {p}")
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                heapq.heappush(self._free, int(p))
+                freed += 1
+        return freed
+
+    # -- accounting -------------------------------------------------------
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def used_count(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return int(self._refs[page])

@@ -10,26 +10,30 @@ whose edges are CHUNK-sized token tuples (vLLM/SGLang's prefix reuse,
 quantized to the chunk grid), and `SlotEngine.start_prefill` asks it for
 the longest cached prefix before prefilling only the suffix.
 
-Correctness contract (gated by tests/test_prefix_cache.py):
+Two storage flavors share ONE radix/LRU core (`_RadixPrefixBase`: the
+chunk grid, the longest-prefix walk, never-hit-first LRU eviction,
+pruning, counters, the serve_prefix_* instruments and summary) so the
+policy cannot drift between them:
 
-- a HIT hands back deep COPIES of the stored arrays — the chunk program
-  donates its input caches, so the stored master must never enter a
-  donating dispatch;
-- a hit is bit-identical to recomputing the prefix, because the stored
-  snapshot IS the chunk program's output for those tokens (same
-  executables, same values — nothing approximate is stored);
-- eviction (LRU under `max_bytes`) only ever causes EXTRA prefill work:
-  a lookup after evict misses and the engine re-prefills from scratch —
-  stale state is structurally impossible because snapshots are keyed by
-  the full token prefix and never mutated in place.
+- `PrefixCache` (contiguous engines) stores ARRAY snapshots under a
+  byte budget. A HIT hands back deep COPIES of the stored arrays — the
+  chunk program donates its input caches, so the stored master must
+  never enter a donating dispatch; a hit is bit-identical to
+  recomputing the prefix because the stored snapshot IS the chunk
+  program's output for those tokens. Snapshots are device-resident by
+  default; `host=True` stores numpy copies, trading hit latency for
+  HBM.
+- `PagedPrefixCache` (paged engines, ISSUE 11) stores REFCOUNTED PAGE
+  LISTS under a page budget — zero copies; see its docstring for the
+  sharing invariant.
 
-Snapshots are device-resident by default (HBM — a hit costs one device
-copy per array, no host round-trip); `host=True` stores numpy copies
-instead, trading hit latency for HBM (the budget then bounds host RSS).
-Counters (`hits`/`misses`/`evictions`/token-weighted hit rate) feed
+In both flavors eviction only ever causes EXTRA prefill work: a lookup
+after evict misses and the engine re-prefills from scratch — stale
+state is structurally impossible because snapshots are keyed by the
+full token prefix and never mutated in place (gated by
+tests/test_prefix_cache.py and tests/test_paged_kv.py). Counters feed
 `ServingMetrics.summary()` and stream as `serve_prefix_*` events when a
-logger is attached — new event types only, the existing serve.jsonl
-record schema is untouched.
+logger is attached.
 """
 
 from __future__ import annotations
@@ -61,7 +65,7 @@ class _Node:
 
     def __init__(self, parent=None, edge=None):
         self.children: dict[tuple, _Node] = {}
-        self.snapshot = None          # (caches, logits) or None
+        self.snapshot = None          # storage-flavor payload or None
         self.nbytes = 0
         self.stamp = 0                # LRU clock at last touch
         self.parent = parent
@@ -69,27 +73,21 @@ class _Node:
         self.hit_count = 0            # lookups served from this node
 
 
-class PrefixCache:
-    """Radix tree of chunk-boundary KV snapshots with an LRU byte budget.
+class _RadixPrefixBase:
+    """The storage-agnostic radix/LRU core both cache flavors run on:
+    chunk-grid tokenization, the longest-cached-prefix walk with
+    hit/miss bookkeeping, radix insert-or-dedupe, never-hit-first LRU
+    victim selection, pruning, pause_writes, and the serve_prefix_*
+    instruments/counters/summary. Subclasses own only what a snapshot
+    IS (arrays vs page refs), how it is handed out, and the budget it
+    lives under — `_release_snapshot(node)` is the one storage hook
+    eviction calls."""
 
-    `chunk` fixes the snapshot grid: node depth d holds the state after
-    tokens[:d*chunk]. `max_bytes` bounds the summed nbytes of stored
-    snapshots (0 disables storage entirely — lookups always miss)."""
-
-    def __init__(self, chunk: int, max_bytes: int, *,
-                 host: bool = False, logger=None, registry=None):
+    def __init__(self, chunk: int, *, logger=None, registry=None):
         if chunk < 1:
             raise ValueError(f"need chunk >= 1, got {chunk}")
-        if max_bytes < 0:
-            raise ValueError(f"need max_bytes >= 0, got {max_bytes}")
         self.chunk = int(chunk)
-        self.max_bytes = int(max_bytes)
-        self.host = bool(host)
         self.logger = logger
-        # registry mirrors of the instance counters below — additive
-        # (the jsonl events and summary() fields are unchanged);
-        # registry=None uses the process-wide default, same knob as
-        # ServingMetrics so tests can isolate instruments
         from idc_models_tpu.observe import metrics_registry as mreg
 
         reg = registry if registry is not None else mreg.REGISTRY
@@ -100,21 +98,186 @@ class PrefixCache:
             "serve_prefix_evictions_total", "LRU snapshot evictions")
         self._m_bytes = reg.gauge(
             "serve_prefix_cache_bytes", "bytes of stored snapshots")
-        self._pack = None             # (caches, n_tokens) -> stored tree
-        self._unpack = None           # stored tree -> caller tree
         # brownout hook: while True, insert() stores nothing (lookups
-        # still serve hits) — snapshot copies + eviction churn are the
-        # first work a degrading server sheds
+        # still serve hits) — snapshot work is the first thing a
+        # degrading server sheds
         self.writes_paused = False
         self._root = _Node()
         self._clock = 0
-        self.nbytes = 0
         self.n_snapshots = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.hit_tokens = 0           # prefix tokens served from cache
         self.lookup_tokens = 0        # prompt tokens seen by lookup
+
+    # -- the chunk grid ---------------------------------------------------
+
+    def _chunks(self, tokens) -> list[tuple]:
+        # one C-level tolist() (not a python int() per element): insert
+        # runs once per completed chunk boundary, so an admission pays
+        # O(P) host tokenization per boundary — with this constant it
+        # is dominated by the device chunk dispatch it accompanies
+        toks = np.asarray(tokens).reshape(-1).tolist()
+        n_full = len(toks) // self.chunk
+        return [tuple(toks[i * self.chunk:(i + 1) * self.chunk])
+                for i in range(n_full)]
+
+    def _check_boundary(self, tokens) -> np.ndarray:
+        toks = np.asarray(tokens).reshape(-1)
+        if toks.size == 0 or toks.size % self.chunk:
+            raise ValueError(
+                f"prefix length {toks.size} is not a multiple of the "
+                f"chunk {self.chunk} — snapshots live on chunk "
+                f"boundaries only")
+        return toks
+
+    # -- lookup / insert plumbing -----------------------------------------
+
+    def _lookup_node(self, tokens):
+        """Longest cached prefix on the chunk grid, with the hit/miss
+        bookkeeping applied: ``(node, start)`` or ``(None, 0)``."""
+        node = self._root
+        best, best_depth = None, 0
+        depth = 0
+        for edge in self._chunks(tokens):
+            node = node.children.get(edge)
+            if node is None:
+                break
+            depth += 1
+            if node.snapshot is not None:
+                best, best_depth = node, depth
+        self.lookup_tokens += int(np.asarray(tokens).size)
+        if best is None:
+            self.misses += 1
+            self._m_lookups.inc(result="miss")
+            self._log(event="serve_prefix_miss",
+                      prompt_tokens=int(np.asarray(tokens).size))
+            return None, 0
+        self._clock += 1
+        best.stamp = self._clock
+        best.hit_count += 1
+        self.hits += 1
+        self._m_lookups.inc(result="hit")
+        start = best_depth * self.chunk
+        self.hit_tokens += start
+        self._log(event="serve_prefix_hit", prefix_tokens=start,
+                  prompt_tokens=int(np.asarray(tokens).size))
+        return best, start
+
+    def _insert_node(self, toks):
+        """Create-or-walk the radix path for `toks` and LRU-touch it;
+        returns the node, or None when a snapshot already sits there
+        (the existing entry keeps answering — dedupe)."""
+        node = self._root
+        for edge in self._chunks(toks):
+            node = node.children.setdefault(edge, _Node(node, edge))
+        self._clock += 1
+        node.stamp = self._clock
+        return None if node.snapshot is not None else node
+
+    # -- eviction ---------------------------------------------------------
+
+    def _walk(self):
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.snapshot is not None:
+                yield n
+
+    def _evict_lru(self, protect=None, victim=None) -> int:
+        """Evict ONE snapshot — never-hit (speculative) ones before
+        hit-proven ones, LRU within each class: a burst of long
+        unique-tail prompts then churns its own useless snapshots
+        instead of flushing the shared system-prefix state the cache
+        exists for. `victim` overrides the selection (the paged
+        flavor's reclaim ranks by freeable pages first). Returns
+        whatever `_release_snapshot` reports freed (pool pages for the
+        paged flavor, 0 for arrays)."""
+        if victim is None:
+            victims = [n for n in self._walk() if n is not protect]
+            if not victims:
+                return 0
+            victim = min(victims,
+                         key=lambda n: (min(n.hit_count, 1), n.stamp))
+        v = victim
+        freed_bytes = v.nbytes
+        freed = self._release_snapshot(v)
+        v.snapshot, v.nbytes = None, 0
+        self.n_snapshots -= 1
+        self.evictions += 1
+        self._m_evictions.inc()
+        self._m_bytes.set(self.nbytes)
+        self._log(event="serve_prefix_evict", freed_bytes=freed_bytes)
+        self._prune(v)
+        return freed
+
+    def _release_snapshot(self, node) -> int:
+        raise NotImplementedError
+
+    def _prune(self, node) -> None:
+        while (node is not self._root and node.snapshot is None
+               and not node.children and node.parent is not None):
+            del node.parent.children[node.edge]
+            node = node.parent
+
+    def pause_writes(self, paused: bool) -> None:
+        """Brownout stage-1 side effect (serve/brownout.py): toggle
+        snapshot storage. Reads are never paused — a warm cache keeps
+        serving hits through the brownout."""
+        self.writes_paused = bool(paused)
+
+    # -- observability ----------------------------------------------------
+
+    def hit_rate(self) -> float | None:
+        total = self.hits + self.misses
+        return None if total == 0 else self.hits / total
+
+    def token_hit_rate(self) -> float | None:
+        return (None if self.lookup_tokens == 0
+                else self.hit_tokens / self.lookup_tokens)
+
+    def summary(self) -> dict:
+        """The `serve_prefix_*` fields merged into the serving rollup —
+        identical keys for both storage flavors."""
+        return {
+            "serve_prefix_hits": self.hits,
+            "serve_prefix_misses": self.misses,
+            "serve_prefix_evictions": self.evictions,
+            "serve_prefix_hit_rate": (
+                None if self.hit_rate() is None
+                else round(self.hit_rate(), 4)),
+            "serve_prefix_token_hit_rate": (
+                None if self.token_hit_rate() is None
+                else round(self.token_hit_rate(), 4)),
+            "serve_prefix_bytes": self.nbytes,
+            "serve_prefix_snapshots": self.n_snapshots,
+        }
+
+    def _log(self, **record) -> None:
+        if self.logger is not None:
+            self.logger.log(**record)
+
+
+class PrefixCache(_RadixPrefixBase):
+    """Radix tree of chunk-boundary ARRAY snapshots with an LRU byte
+    budget — the contiguous engines' flavor.
+
+    `chunk` fixes the snapshot grid: node depth d holds the state after
+    tokens[:d*chunk]. `max_bytes` bounds the summed nbytes of stored
+    snapshots (0 disables storage entirely — lookups always miss)."""
+
+    def __init__(self, chunk: int, max_bytes: int, *,
+                 host: bool = False, logger=None, registry=None):
+        if max_bytes < 0:
+            raise ValueError(f"need max_bytes >= 0, got {max_bytes}")
+        super().__init__(chunk, logger=logger, registry=registry)
+        self.max_bytes = int(max_bytes)
+        self.host = bool(host)
+        self._pack = None             # (caches, n_tokens) -> stored tree
+        self._unpack = None           # stored tree -> caller tree
+        self.nbytes = 0
 
     def set_packer(self, pack, unpack) -> None:
         """Install a storage transform: ``pack(caches, n_tokens)`` maps
@@ -130,16 +293,6 @@ class PrefixCache:
 
     # -- lookup / insert --------------------------------------------------
 
-    def _chunks(self, tokens) -> list[tuple]:
-        # one C-level tolist() (not a python int() per element): insert
-        # runs once per completed chunk boundary, so an admission pays
-        # O(P) host tokenization per boundary — with this constant it
-        # is dominated by the device chunk dispatch it accompanies
-        toks = np.asarray(tokens).reshape(-1).tolist()
-        n_full = len(toks) // self.chunk
-        return [tuple(toks[i * self.chunk:(i + 1) * self.chunk])
-                for i in range(n_full)]
-
     def lookup(self, tokens):
         """Longest cached prefix of `tokens` on the chunk grid.
 
@@ -147,31 +300,9 @@ class PrefixCache:
         in the returned caches (0, None, None on a miss). The arrays are
         fresh copies, safe to feed a donating chunk program; the stored
         master is untouched."""
-        node, depth = self._root, 0
-        best, best_depth = None, 0
-        for edge in self._chunks(tokens):
-            node = node.children.get(edge)
-            if node is None:
-                break
-            depth += 1
-            if node.snapshot is not None:
-                best, best_depth = node, depth
-        self.lookup_tokens += int(np.asarray(tokens).size)
+        best, start = self._lookup_node(tokens)
         if best is None:
-            self.misses += 1
-            self._m_lookups.inc(result="miss")
-            self._log(event="serve_prefix_miss",
-                      prompt_tokens=int(np.asarray(tokens).size))
             return 0, None, None
-        self._clock += 1
-        best.stamp = self._clock
-        best.hit_count += 1
-        self.hits += 1
-        self._m_lookups.inc(result="hit")
-        start = best_depth * self.chunk
-        self.hit_tokens += start
-        self._log(event="serve_prefix_hit", prefix_tokens=start,
-                  prompt_tokens=int(np.asarray(tokens).size))
         caches, logits = best.snapshot
         # BOTH halves leave as fresh arrays — logits too, even though
         # today's call sites never donate or mutate them: the stored
@@ -187,20 +318,11 @@ class PrefixCache:
         grid). Copies the arrays; returns False (and stores nothing)
         when the snapshot alone exceeds the whole budget or the key is
         already present (the existing entry is LRU-touched)."""
-        toks = np.asarray(tokens).reshape(-1)
-        if toks.size == 0 or toks.size % self.chunk:
-            raise ValueError(
-                f"prefix length {toks.size} is not a multiple of the "
-                f"chunk {self.chunk} — snapshots live on chunk "
-                f"boundaries only")
+        toks = self._check_boundary(tokens)
         if self.writes_paused:
             return False
-        node = self._root
-        for edge in self._chunks(toks):
-            node = node.children.setdefault(edge, _Node(node, edge))
-        self._clock += 1
-        node.stamp = self._clock
-        if node.snapshot is not None:
+        node = self._insert_node(toks)
+        if node is None:
             return False
         if self._pack is not None:
             caches = self._pack(caches, int(toks.size))
@@ -219,79 +341,204 @@ class PrefixCache:
         self._m_bytes.set(self.nbytes)
         return True
 
-    # -- eviction ---------------------------------------------------------
-
-    def _walk(self):
-        stack = [self._root]
-        while stack:
-            n = stack.pop()
-            stack.extend(n.children.values())
-            if n.snapshot is not None:
-                yield n
-
-    def _evict_lru(self, protect=None) -> None:
-        # every chunk boundary of every prompt is snapshotted
-        # speculatively; only some ever serve a hit. Evict never-hit
-        # (speculative) snapshots before hit-proven ones, LRU within
-        # each class — a burst of long unique-tail prompts then churns
-        # its own useless snapshots instead of flushing the shared
-        # system-prefix state the cache exists for.
-        victims = [n for n in self._walk() if n is not protect]
-        if not victims:
-            return
-        v = min(victims, key=lambda n: (min(n.hit_count, 1), n.stamp))
-        self.nbytes -= v.nbytes
-        self.n_snapshots -= 1
-        self.evictions += 1
-        self._m_evictions.inc()
-        self._m_bytes.set(self.nbytes)
-        self._log(event="serve_prefix_evict", freed_bytes=v.nbytes)
-        v.snapshot, v.nbytes = None, 0
-        self._prune(v)
-
-    def _prune(self, node) -> None:
-        while (node is not self._root and node.snapshot is None
-               and not node.children and node.parent is not None):
-            del node.parent.children[node.edge]
-            node = node.parent
-
-    def pause_writes(self, paused: bool) -> None:
-        """Brownout stage-1 side effect (serve/brownout.py): toggle
-        snapshot storage. Reads are never paused — a warm cache keeps
-        serving hits through the brownout."""
-        self.writes_paused = bool(paused)
+    def _release_snapshot(self, node) -> int:
+        self.nbytes -= node.nbytes
+        return 0
 
     def clear(self) -> None:
         self._root = _Node()
         self.nbytes = 0
         self.n_snapshots = 0
 
-    # -- observability ----------------------------------------------------
 
-    def hit_rate(self) -> float | None:
-        total = self.hits + self.misses
-        return None if total == 0 else self.hits / total
+class PagedPrefixCache(_RadixPrefixBase):
+    """Radix prefix cache for the PAGED engine (ISSUE 11): a snapshot
+    is a LIST OF POOL PAGE IDS plus a copy of the boundary logits —
+    never a copy of the K/V itself.
 
-    def token_hit_rate(self) -> float | None:
-        return (None if self.lookup_tokens == 0
-                else self.hit_tokens / self.lookup_tokens)
+    The sharing story that makes snapshots free: chunk boundaries land
+    on the page grid (page_size | chunk, enforced by the engine), so
+    the pages covering a completed boundary are FULLY WRITTEN and — by
+    the engine's write discipline (chunks splice [start, p_end),
+    decode appends at >= p_len) — never written again. A snapshot
+    therefore just takes a refcount on the prefilling slot's own pages
+    (`PageAllocator.retain`), and a hit hands the page ids to the new
+    slot, which retains them too: N requests sharing a system prompt
+    hold ONE physical copy of its K/V. "Copy-on-write" never triggers
+    because no write ever targets a shared page — the alignment
+    invariant is the whole mechanism.
 
-    def summary(self) -> dict:
-        """The `serve_prefix_*` fields merged into the serving rollup."""
-        return {
-            "serve_prefix_hits": self.hits,
-            "serve_prefix_misses": self.misses,
-            "serve_prefix_evictions": self.evictions,
-            "serve_prefix_hit_rate": (
-                None if self.hit_rate() is None
-                else round(self.hit_rate(), 4)),
-            "serve_prefix_token_hit_rate": (
-                None if self.token_hit_rate() is None
-                else round(self.token_hit_rate(), 4)),
-            "serve_prefix_bytes": self.nbytes,
-            "serve_prefix_snapshots": self.n_snapshots,
-        }
+    Eviction is the base LRU under a budget counted in PAGES; evicting
+    a snapshot drops its refs, and a page returns to the free list
+    only when no slot still shares it — eviction can only ever cost
+    re-prefill, exactly like the array flavor's contract. `reclaim(n)`
+    is the allocator-pressure hook: admission and mid-decode growth
+    evict snapshots to free pages before declaring exhaustion."""
 
-    def _log(self, **record) -> None:
-        if self.logger is not None:
-            self.logger.log(**record)
+    is_paged = True
+
+    def __init__(self, chunk: int, max_pages: int | None = None, *,
+                 budget_mb: float | None = None, logger=None,
+                 registry=None):
+        if (max_pages is None) == (budget_mb is None):
+            raise ValueError("pass exactly one of max_pages (a page "
+                             "budget) or budget_mb (resolved to pages "
+                             "when the engine binds its allocator)")
+        if max_pages is not None and max_pages < 0:
+            raise ValueError(f"need max_pages >= 0, got {max_pages}")
+        if budget_mb is not None and budget_mb < 0:
+            raise ValueError(f"need budget_mb >= 0, got {budget_mb}")
+        super().__init__(chunk, logger=logger, registry=registry)
+        self.max_pages = None if max_pages is None else int(max_pages)
+        self._budget_mb = budget_mb
+        self._alloc = None
+        self._page_bytes = 0
+        # distinct pages this cache references -> snapshot refcount
+        # (a page shared by k snapshots counts ONCE against the page
+        # budget; the allocator holds k refs for it)
+        self._page_refs: dict[int, int] = {}
+
+    def bind(self, allocator, page_bytes: int) -> None:
+        """Attach the engine's allocator (refcount authority) and the
+        page byte size; resolves a budget_mb construction into pages.
+
+        Rebinding a POPULATED cache to a different allocator (the
+        warm-restart pattern: a server rebuilt after a crash reuses
+        the dead engine's cache object) DROPS every snapshot first:
+        unlike the array flavor's snapshots, page ids name physical
+        pages of the pool that died with the old engine — carrying
+        them across would retain/release pages the new allocator
+        hands to unrelated live requests (silent cross-request
+        corruption). The rebuilt cache starts cold and re-warms."""
+        if self._alloc is not None and allocator is not self._alloc:
+            self._root = _Node()
+            self._page_refs.clear()
+            self.n_snapshots = 0
+        self._alloc = allocator
+        self._page_bytes = int(page_bytes)
+        if self.max_pages is None:
+            self.max_pages = int(self._budget_mb * 1024 * 1024
+                                 // max(page_bytes, 1))
+
+    # -- accounting -------------------------------------------------------
+
+    def cached_pages(self) -> int:
+        return len(self._page_refs)
+
+    def reclaimable_pages(self) -> int:
+        """Pages that evicting EVERY snapshot would actually free:
+        those whose allocator refcount is entirely cache-held (a page
+        a live slot still shares frees nothing). The admission gate
+        checks this before evicting, so a hopeless query cannot
+        destroy the cache for zero admission benefit."""
+        if self._alloc is None:
+            return 0
+        return sum(1 for p, refs in self._page_refs.items()
+                   if self._alloc.refcount(p) == refs)
+
+    @property
+    def nbytes(self) -> int:
+        return self.cached_pages() * self._page_bytes
+
+    # -- lookup / insert --------------------------------------------------
+
+    def lookup(self, tokens):
+        """Longest cached prefix of `tokens` on the chunk grid:
+        ``(start, page_ids, logits)`` — `start` tokens already live in
+        the returned pages (0, None, None on a miss). The CALLER
+        retains the pages for its own lifetime; the ids themselves are
+        a fresh list and the logits a fresh copy."""
+        import jax.numpy as jnp
+
+        best, start = self._lookup_node(tokens)
+        if best is None:
+            return 0, None, None
+        pages, logits = best.snapshot
+        return start, list(pages), jnp.array(logits, copy=True)
+
+    def insert(self, tokens, pages, logits) -> bool:
+        """Snapshot the state after `tokens` as the page ids covering
+        them (length must sit on the chunk grid and the pages must
+        exactly cover it). Takes cache-owned refcounts — zero copies.
+        Returns False (nothing stored) when writes are paused, the key
+        exists, or the page budget cannot fit it even after
+        eviction."""
+        import jax.numpy as jnp
+
+        if self._alloc is None:
+            raise RuntimeError("PagedPrefixCache.bind(allocator, "
+                               "page_bytes) must run before insert — "
+                               "the engine does this at construction")
+        toks = self._check_boundary(tokens)
+        if self.writes_paused:
+            return False
+        pages = [int(p) for p in pages]
+        node = self._insert_node(toks)
+        if node is None:
+            return False
+        new_distinct = sum(1 for p in pages
+                           if p not in self._page_refs)
+        while (self.cached_pages() + new_distinct > self.max_pages
+               and self.n_snapshots > 0):
+            before = self.n_snapshots
+            self._evict_lru(protect=node)
+            if self.n_snapshots == before:      # nothing evictable
+                break
+            new_distinct = sum(1 for p in pages
+                               if p not in self._page_refs)
+        if self.cached_pages() + new_distinct > self.max_pages:
+            self._prune(node)
+            return False
+        self._alloc.retain(pages)
+        for p in pages:
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+        node.snapshot = (tuple(pages), jnp.array(logits, copy=True))
+        node.nbytes = len(pages) * self._page_bytes
+        self.n_snapshots += 1
+        self._m_bytes.set(self.nbytes)
+        return True
+
+    # -- eviction / reclaim -----------------------------------------------
+
+    def _release_snapshot(self, node) -> int:
+        pages = node.snapshot[0]
+        for p in pages:
+            self._page_refs[p] -= 1
+            if self._page_refs[p] == 0:
+                del self._page_refs[p]
+        return self._alloc.release(pages)
+
+    def reclaim(self, n_pages: int) -> int:
+        """Free at least `n_pages` pool pages by evicting snapshots
+        (or as many as evictions can free); returns the count actually
+        freed. The allocator-pressure hook admission and decode-growth
+        call before declaring page exhaustion.
+
+        Victim ranking puts FREEABILITY before the LRU policy: a
+        snapshot whose eviction frees pages NOW (it holds the last
+        reference) beats one that merely unblocks a later eviction
+        (pages shared with other snapshots), and snapshots pinned
+        entirely by LIVE SLOTS are never evicted here at all — they
+        free nothing this reclaim and destroying a hit-proven shared
+        system prefix for zero pages is the waste the admission gate's
+        reclaimable check exists to prevent."""
+        freed = 0
+        while freed < n_pages and self.n_snapshots > 0:
+            best, best_key = None, None
+            for node in self._walk():
+                pages = node.snapshot[0]
+                frees = sum(1 for p in pages
+                            if self._alloc.refcount(p) == 1)
+                # progress = some page would free once its OTHER
+                # cache-held refs go too (chained boundary snapshots)
+                progress = any(self._alloc.refcount(p)
+                               == self._page_refs[p] for p in pages)
+                if not frees and not progress:
+                    continue                   # slot-pinned: keep it
+                key = (frees == 0, min(node.hit_count, 1), node.stamp)
+                if best is None or key < best_key:
+                    best, best_key = node, key
+            if best is None:
+                break
+            freed += self._evict_lru(victim=best)
+        return freed

@@ -157,6 +157,12 @@ class AdmissionQueue:
     def pop(self) -> Entry:
         return self._q.popleft()
 
+    def peek(self) -> Entry:
+        """The head entry without popping it — the page-aware
+        admission gate inspects the head's demand before committing to
+        take it."""
+        return self._q[0]
+
     def push_front(self, entry: Entry) -> None:
         """Head-of-line insertion for RETRIED entries only: they were
         already admitted once (so they do not cheat the backpressure
@@ -222,6 +228,10 @@ class Scheduler:
         self._cycle = 0
         self._closed = False
         self._prefill_error_pending = 0
+        # paged-KV backpressure: set when admission stalls on page
+        # exhaustion this cycle, consumed (and cleared) by the
+        # brownout evaluation — ISSUE 11's exhaustion -> brownout wire
+        self._page_pressure = False
         # refill slots the just-collected window freed before the next
         # window dispatches (recycle idles one window, not two) — at the
         # price of those prefills sitting in the device-idle gap instead
@@ -336,8 +346,33 @@ class Scheduler:
         free = self.engine.free_slots()
         clamp = (self.brownout.token_clamp if self.brownout is not None
                  else None)
+        can_admit = getattr(self.engine, "can_admit_pages", None)
         while (admitted < self.max_prefills_per_cycle and free
                and len(self.queue)):
+            # page-aware admission (paged engines): the HEAD request
+            # must fit — pages for its prompt plus the decode
+            # reservation — before it leaves the queue. FIFO holds
+            # (no skipping ahead of a starved head: that would starve
+            # long requests forever); the exhaustion is recorded as
+            # backpressure and feeds the brownout signal below.
+            if can_admit is not None:
+                head = self.queue.peek()
+                # gate on the EFFECTIVE budget: brownout stage 2 clamps
+                # it at admission below, and the clamp is exactly the
+                # smaller-reservations lever the pages-pressure
+                # escalation exists to pull — gating on the unclamped
+                # ask would wedge admission at the stage meant to
+                # unwedge it
+                eff = (head.budget if clamp is None
+                       else min(head.budget, clamp))
+                if not can_admit(len(head.prompt), eff):
+                    self._page_pressure = True
+                    on_exh = getattr(self.metrics, "on_page_exhausted",
+                                     None)
+                    if on_exh is not None:
+                        on_exh(rid=head.rid,
+                               needed=len(head.prompt) + head.budget)
+                    break
             e = self.queue.pop()
             slot = free.pop(0)
             if clamp is not None and e.budget > clamp:
@@ -697,6 +732,35 @@ class Scheduler:
                 self._abort_running(e)
                 raise
             prefill_stall_s += self.clock() - t_pf2
+        # 5.5 paged engines: grow page grants so every running slot
+        #     can emit the next dispatch's worth of tokens; slots the
+        #     pool cannot cover even after prefix-cache reclaim are
+        #     quarantined NOW (retry or honest finish — never a
+        #     dispatch that would decode blind past its last page).
+        #     Their just-collected tokens are dropped like a health
+        #     quarantine's: a retry restarts from the prompt and
+        #     re-derives the exact stream.
+        if self._running:
+            need = self.window
+            if self._spec:
+                need = max(need, self.engine.draft_k + 1)
+            starved = self.engine.ensure_decode_room(need)
+            if starved:
+                self._page_pressure = True
+                on_exh = (getattr(self.metrics, "on_page_exhausted",
+                                  None) if self.metrics else None)
+                quarantined = set()
+                for slot in starved:
+                    e = self._running.pop(slot, None)
+                    if e is None:
+                        continue
+                    if on_exh is not None:
+                        on_exh(rid=e.rid, needed=need)
+                    self.engine.release(slot)
+                    quarantined.add(id(e))
+                    self._quarantine(e, "page_exhausted", now, done)
+                got = [(e, t) for e, t in got
+                       if id(e) not in quarantined]
         # 6. dispatch the next window over every occupied slot — the
         #    plain fused window, or (speculative mode, when the
         #    drafter proposed and every running slot has verify room)
@@ -753,13 +817,23 @@ class Scheduler:
         emitted = self._finalize_window(got, finished, cancelled, t_now,
                                         now, done)
         # brownout runs EVERY cycle (drain ticks included — recovery
-        # hysteresis needs to see the queue empty out)
+        # hysteresis needs to see the queue empty out); page
+        # exhaustion joins the SLO/queue signals so a pool running dry
+        # degrades the server instead of wedging admissions silently
+        page_pressure, self._page_pressure = self._page_pressure, False
         if self.brownout is not None:
-            self.brownout.evaluate(queue_depth=len(self.queue))
+            self.brownout.evaluate(queue_depth=len(self.queue),
+                                   pressure=page_pressure)
         if (self._running or admitted or chunk_steps) and self.metrics:
             self.metrics.on_cycle(queue_depth=len(self.queue),
                                   occupancy=occupancy, tokens=emitted,
                                   prefill_s=prefill_stall_s)
+            on_pages = getattr(self.metrics, "on_pages", None)
+            stats_fn = getattr(self.engine, "page_stats", None)
+            if on_pages is not None and stats_fn is not None:
+                stats = stats_fn()
+                if stats is not None:
+                    on_pages(**stats)
             # compiles observed via jit cache-size deltas: after warmup
             # this total must never move (the no-recompile contract);
             # when it does, the registry counter says exactly when

@@ -309,6 +309,45 @@ def test_cli_serve_chunked_prefix_int8(tmp_path, capsys):
                   "--prefix-cache-mb", "4"])
 
 
+def test_cli_serve_paged_kv(tmp_path, capsys):
+    """ISSUE-11 paged KV from the product surface: the --kv-page-size/
+    --kv-pages knobs, the page-occupancy epilogue, the serve_kv_*
+    summary fields, and the usage-error gates. Engine semantics are
+    owned by tests/test_paged_kv.py."""
+    import json
+
+    out = _run(["serve", "--host-devices", "8", "--requests", "6",
+                "--slots", "2", "--window", "4", "--t-max", "32",
+                "--vocab", "11", "--embed-dim", "16", "--num-heads", "2",
+                "--mlp-dim", "32", "--num-blocks", "1",
+                "--prefill-chunk", "8", "--kv-page-size", "4",
+                "--kv-pages", "16", "--prefix-cache-mb", "4",
+                "--path", str(tmp_path)], capsys)
+    assert "served: ok=6" in out
+    assert "paged kv:" in out and "pages peak" in out
+    assert "tokens/HBM-byte" in out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("serve summary:")][0]
+    summary = json.loads(line.split("serve summary:", 1)[1])
+    assert summary["serve_kv_pages_total"] == 16
+    assert 0 < summary["serve_kv_pages_used_peak"] <= 16
+    assert summary["serve_kv_tokens_per_hbm_byte"] > 0
+    # usage-error gates: each bad combination dies cleanly
+    for args in (["--kv-page-size", "4"],                  # no pages
+                 ["--kv-pages", "16"],                     # no size
+                 ["--kv-page-size", "4", "--kv-pages", "16"],  # no chunk
+                 ["--prefill-chunk", "8", "--kv-page-size", "5",
+                  "--kv-pages", "16"],                     # 5 !| 32
+                 ["--prefill-chunk", "8", "--kv-page-size", "16",
+                  "--kv-pages", "16"],                     # 16 !| 8
+                 ["--prefill-chunk", "8", "--kv-page-size", "4",
+                  "--kv-pages", "4"],                      # < t_max
+                 ["--kv-decode-reserve", "4"]):            # not paged
+        with pytest.raises(SystemExit):
+            cli.main(["serve", "--host-devices", "8", "--t-max", "32"]
+                     + args)
+
+
 def test_cli_serve_trace_out_and_stats(tmp_path, capsys):
     """ISSUE-5/7 observability from the product surface, one chunked
     serve run covering the whole stack: --trace-out produces a

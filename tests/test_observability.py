@@ -320,6 +320,13 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
         m.on_dispatch("window")
         m.on_dispatch("verify")
         m.on_spec(drafted=8, accepted=5, emitted=7, slots=2)
+        # ISSUE-11 paged-KV hooks: one NEW event type (exhaustion),
+        # frozen from day one; on_pages sets gauges + peaks, no event
+        m.on_pages(pages_total=32, pages_used=10, pages_cached=3,
+                   resident_tokens=150, resident_bytes=40960)
+        m.on_pages(pages_total=32, pages_used=7, pages_cached=3,
+                   resident_tokens=90, resident_bytes=28672)
+        m.on_page_exhausted(rid="r9", needed=48)
     recs = [json.loads(l) for l in open(log)]
     by_event = {r["event"]: r for r in recs}
     # the historical event set + per-event keys, byte-for-byte names
@@ -328,7 +335,8 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
                              "serve_finish", "serve_slot_fault",
                              "serve_retry", "serve_shed",
                              "serve_clamp", "serve_fault_injected",
-                             "serve_spec_verify"}
+                             "serve_spec_verify",
+                             "serve_page_exhausted"}
     assert set(by_event["serve_submit"]) == {"ts", "event", "id"}
     assert set(by_event["serve_admit"]) == {"ts", "event", "id",
                                             "queue_wait_ms"}
@@ -351,6 +359,9 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
     assert set(by_event["serve_spec_verify"]) == {"ts", "event",
                                                   "drafted", "accepted",
                                                   "emitted", "slots"}
+    # the ISSUE-11 paged-KV event, frozen from day one
+    assert set(by_event["serve_page_exhausted"]) == {"ts", "event",
+                                                     "id", "needed"}
     # the historical summary keys all still present
     s = m.summary()
     for k in ("serve_requests", "serve_rejected", "serve_timed_out",
@@ -371,7 +382,14 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
               "serve_decode_dispatches", "serve_tokens_per_dispatch",
               "serve_spec_verify_dispatches", "serve_spec_drafted",
               "serve_spec_accepted", "serve_spec_accept_rate",
-              "serve_spec_tokens_per_dispatch"):
+              "serve_spec_tokens_per_dispatch",
+              # the ISSUE-11 additive paged-KV rollup, frozen from
+              # day one
+              "serve_kv_pages_total", "serve_kv_pages_used_peak",
+              "serve_kv_resident_tokens_peak",
+              "serve_kv_resident_bytes_peak",
+              "serve_kv_tokens_per_hbm_byte",
+              "serve_page_exhaustions"):
         assert k in s, k
     assert s["serve_slot_faults"] == 1 and s["serve_retries"] == 1
     assert s["serve_shed"] == 1 and s["serve_clamped"] == 1
@@ -379,6 +397,14 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
     assert s["serve_tokens_per_dispatch"] == 1.5   # 3 tokens / 2
     assert s["serve_spec_accept_rate"] == 0.625    # 5 / 8 drafted
     assert s["serve_spec_tokens_per_dispatch"] == 3.5  # 7 / 2 slots
+    # paged rollup keeps PEAKS (the capacity claim is stated at peak
+    # residency), and tokens-per-byte is taken AT the peak
+    assert s["serve_kv_pages_total"] == 32
+    assert s["serve_kv_pages_used_peak"] == 10
+    assert s["serve_kv_resident_tokens_peak"] == 150
+    assert s["serve_kv_resident_bytes_peak"] == 40960
+    assert s["serve_kv_tokens_per_hbm_byte"] == round(150 / 40960, 6)
+    assert s["serve_page_exhaustions"] == 1
 
 
 def test_fed_driver_round_health_schema_unchanged(tmp_path):

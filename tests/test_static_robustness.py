@@ -448,6 +448,82 @@ def test_single_cost_analysis_extraction_point():
         f"cost-analysis allowlist entries match no code: {stale}")
 
 
+# -- ISSUE 11: no stray t_max-sized KV allocations in serve/ -------------
+#
+# The paged engine exists so HBM stops being reserved per slot's worst
+# case; a new serve-side `zeros((..., t_max, ...))`-style KV allocation
+# would quietly reintroduce the reservation the pool replaced. The scan
+# flags allocation calls (zeros/ones/full/empty) whose literal shape
+# tuple has rank >= 3 (KV-shaped — token-id buffers are 2-D) and
+# mentions t_max anywhere inside it.
+
+_ALLOC_CALLS = {"zeros", "ones", "full", "empty"}
+
+# (path relative to the repo root, dotted enclosing-function path) ->
+# why a t_max-sized KV allocation is correct there
+TMAX_KV_ALLOWLIST = {
+    ("idc_models_tpu/serve/engine.py", "_engine_fns.init_caches.mk"):
+        "the CONTIGUOUS-mode constructor: per-slot [t_max] ring rows "
+        "are exactly what that mode is — the paged twin "
+        "(_paged_engine_fns) allocates the page pool instead",
+}
+
+
+def _enclosing_path(stack) -> str:
+    names = [n.name for n in stack
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    return ".".join(names) if names else "<module>"
+
+
+def _mentions_t_max(node) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "t_max":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "t_max":
+            return True
+    return False
+
+
+def _scan_tmax_kv_allocs(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(REPO)).replace("\\", "/")
+    violations, live = [], set()
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _ALLOC_CALLS
+                    and child.args
+                    and isinstance(child.args[0], ast.Tuple)
+                    and len(child.args[0].elts) >= 3
+                    and _mentions_t_max(child.args[0])):
+                key = (rel, _enclosing_path(stack))
+                live.add(key)
+                if key not in TMAX_KV_ALLOWLIST:
+                    violations.append((rel, child.lineno, key[1]))
+            walk(child, stack + [child])
+
+    walk(tree, [])
+    return violations, live
+
+
+def test_no_tmax_sized_kv_allocations_in_serve():
+    violations, live = [], set()
+    for f in sorted((PACKAGE / "serve").rglob("*.py")):
+        v, l = _scan_tmax_kv_allocs(f)
+        violations.extend(v)
+        live.update(l)
+    assert not violations, (
+        "t_max-sized KV allocation in serve/ outside the contiguous-"
+        "mode constructor — per-slot worst-case reservations are what "
+        "paged KV removed; allocate pool pages (or extend the "
+        f"documented TMAX_KV_ALLOWLIST): {violations}")
+    stale = set(TMAX_KV_ALLOWLIST) - live
+    assert not stale, (
+        f"t_max KV allowlist entries match no code: {stale}")
+
+
 def test_serve_handlers_quarantine_or_reraise():
     violations, live = [], set()
     for f in sorted((PACKAGE / "serve").rglob("*.py")):
