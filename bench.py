@@ -1284,6 +1284,106 @@ def bench_serving_paged_kv(on_accelerator: bool):
     }
 
 
+def bench_serving_cluster(on_accelerator: bool):
+    """The ISSUE-12 router tier: aggregate tokens/sec from 1 vs 2
+    replicas on the SAME Poisson burst trace — the scale-out record.
+
+    Each replica is its own engine on its OWN device slice (the
+    per-replica seq-mesh carve-up), so with two replicas the router's
+    host loop dispatches replica A's window while replica B's
+    executes. On an ACCELERATOR fleet (each replica its own chip)
+    `cluster_scaling_1to2` is the >= 1.8x scale-out gate with
+    `cluster_ttft_ms_p95_2r` no worse than single-replica
+    (docs/BENCHMARKS.md). On the CPU SIMULATOR the virtual devices
+    share the host's physical cores, so one replica already saturates
+    the machine when busy and wall-clock compute scaling is
+    machine-bound at ~1.0x — the CPU figure therefore measures the
+    ROUTER TAX (scaling must stay near 1.0: the tier must not COST
+    throughput at 2 replicas) plus the structural TTFT win from the
+    doubled slot pool; the >= 1.8x claim is stated as an accelerator
+    expectation, the same discipline docs/LONG_CONTEXT.md "What is
+    measured vs expected" applies to ring comm/compute overlap.
+
+    Methodology matches bench_serving: both fleets replay the
+    identical trace as a burst (arrival order kept, deterministic),
+    per-request outputs are bit-identical between fleet sizes (greedy
+    serial parity — asserted via total useful tokens), compilation is
+    paid at fleet construction (outside the timed window), and three
+    interleaved pairs are taken with the best PAIRED ratio reported
+    (the chip/host load drifts on the minutes scale; pairing cancels
+    most of it). Request ids are re-labelled per pass so the same
+    routers replay the trace repeatedly without rebuilding."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from idc_models_tpu.serve import Router, build_replica, poisson_trace
+    from idc_models_tpu.models.lm import attention_lm
+
+    if on_accelerator:
+        vocab, e, heads, blocks, mlp = 1024, 512, 8, 2, 2048
+        t_max, n_slots, window, n_req = 2048, 8, 64, 24
+        prompt_lens, budgets = (64, 256), (400, 500)
+    else:
+        # CPU smoke scale: big enough that window compute (not python
+        # bookkeeping) dominates the passes being compared — the
+        # router-tax figure is then about the tier, not the noise
+        vocab, e, heads, blocks, mlp = 128, 64, 2, 2, 256
+        t_max, n_slots, window, n_req = 128, 4, 16, 24
+        prompt_lens, budgets = (8, 16), (48, 56)
+    model = attention_lm(vocab, t_max, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks)
+    params = model.init(jax.random.key(0)).params
+    devices = jax.devices()
+    base_trace = poisson_trace(n_req, rate_per_s=1e9, vocab=vocab,
+                               t_max=t_max, prompt_lens=prompt_lens,
+                               budgets=budgets, seed=0)
+
+    def mk_router(n: int) -> Router:
+        reps = [build_replica(
+            params, replica_id=f"f{n}r{i}",
+            device=devices[i % len(devices)], embed_dim=e,
+            num_heads=heads, num_blocks=blocks, t_max=t_max,
+            n_slots=n_slots, window=window, max_queue_depth=256)
+            for i in range(n)]
+        return Router(reps)
+
+    def cluster_pass(router: Router, tag: str):
+        trace = [(t, dataclasses.replace(r, id=f"{tag}-{r.id}"))
+                 for t, r in base_trace]
+        t0 = time.perf_counter()
+        results = router.run(trace)
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in results)        # fence
+        assert toks and all(r.status == "ok" for r in results)
+        ttft = float(np.percentile([r.ttft_ms for r in results], 95))
+        return toks / dt, ttft, toks
+
+    r1, r2 = mk_router(1), mk_router(2)
+    cluster_pass(r1, "w1")                       # compile + warm both
+    cluster_pass(r2, "w2")
+    tp1s, tp2s, ratios = [], [], []
+    ttft1 = ttft2 = None
+    for i in range(3):                           # interleaved pairs
+        tp1, ttft1, tok1 = cluster_pass(r1, f"p{i}a")
+        tp2, ttft2, tok2 = cluster_pass(r2, f"p{i}b")
+        assert tok1 == tok2, (tok1, tok2)        # same useful output
+        tp1s.append(tp1)
+        tp2s.append(tp2)
+        ratios.append(tp2 / tp1)
+    return {
+        "cluster_trace_requests": n_req,
+        "cluster_slots_per_replica": n_slots,
+        "cluster_tokens_per_sec_1r": round(max(tp1s), 1),
+        "cluster_tokens_per_sec_2r": round(max(tp2s), 1),
+        "cluster_scaling_1to2": round(max(ratios), 3),
+        "cluster_scaling_windows": [round(x, 3) for x in ratios],
+        "cluster_ttft_ms_p95_1r": round(ttft1, 2),
+        "cluster_ttft_ms_p95_2r": round(ttft2, 2),
+    }
+
+
 def bench_serving_resilience(on_accelerator: bool):
     """The ISSUE-8 resilience layer under load, two scenarios:
 
@@ -1714,6 +1814,7 @@ HIGHER_IS_BETTER = (
     "serve_spec_accept_rate", "serve_spec_tokens_per_dispatch",
     "serve_paged_concurrent_residency_ratio",
     "serve_kv_tokens_per_hbm_byte", "serve_paged_tokens_per_sec",
+    "cluster_tokens_per_sec_2r", "cluster_scaling_1to2",
     "ring_fwd_speedup_vs_jnp", "ring_fwd_speedup_median",
     "zigzag_schedule_speedup", "fed_byz_robust_advantage",
 )
@@ -1721,7 +1822,7 @@ LOWER_IS_BETTER = (
     "fed_round_s", "fed_round_32_s", "secure_round_s",
     "prefill_ms", "decode_ms_per_token",
     "serve_ttft_ms_p50", "serve_ttft_ms_p95",
-    "serve_ttft_ms_p95_shared_prefix",
+    "serve_ttft_ms_p95_shared_prefix", "cluster_ttft_ms_p95_2r",
     "serve_chunked_prefill_decode_stall_ms",
     "serve_resilience_ttft_ms_p95_brownout",
     "serve_resilience_overhead_pct",
@@ -1844,6 +1945,7 @@ def main() -> None:
     ring.update(bench_serving_shared_prefix(on_accelerator))
     ring.update(bench_serving_speculative(on_accelerator))
     ring.update(bench_serving_paged_kv(on_accelerator))
+    ring.update(bench_serving_cluster(on_accelerator))
     ring.update(bench_serving_resilience(on_accelerator))
     ring.update(bench_tracer_overhead(on_accelerator))
     ring.update(bench_profile_overhead(on_accelerator))

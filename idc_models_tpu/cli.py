@@ -35,8 +35,8 @@ def main(argv: list[str] | None = None) -> int:
     runner = {"vgg": _run_dist, "mobile": _run_dist, "dense": _run_dist,
               "fed": _run_fed, "secure_fed": _run_secure,
               "attention": _run_attention, "lm": _run_lm,
-              "serve": _run_serve, "stats": _run_stats,
-              "profile": _run_profile,
+              "serve": _run_serve, "serve_cluster": _run_serve_cluster,
+              "stats": _run_stats, "profile": _run_profile,
               "convert_weights": _run_convert}[ns.preset_key]
     # --trace-out: ONE wiring point arms the runtime tracer for every
     # verb — the instrumented spans (serve scheduler cycles, federated
@@ -437,6 +437,100 @@ def _parse(argv):
     sp.add_argument("--slo-window-s", type=float, default=60.0,
                     help="the SLO engine's SHORT evaluation window in "
                          "seconds (the long window is 5x this)")
+
+    sp = sub.add_parser(
+        "serve-cluster", aliases=["serve_cluster"],
+        help="disaggregated multi-replica serving (serve/cluster/): a "
+             "router places requests on N engine replicas by health/"
+             "load/page headroom/SLO burn, dedicated prefill replicas "
+             "hand completed KV snapshots to decode replicas through "
+             "the cluster prefix registry, and a killed replica's "
+             "journaled requests migrate onto survivors")
+    sp.add_argument("--path", default=None,
+                    help="artifact root (cluster events stream to "
+                         "<path>/logs/cluster.jsonl)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--host-devices", type=int, default=0,
+                    help="force N virtual CPU devices — each replica "
+                         "takes its own device slice")
+    sp.add_argument("--trace-out", default=None,
+                    help="write a Chrome trace-event JSON of the "
+                         "cluster's spans (placements, handoffs, "
+                         "migrations, every replica's serve loop) "
+                         "here")
+    sp.add_argument("--vocab", type=int, default=16)
+    sp.add_argument("--t-max", type=int, default=64)
+    sp.add_argument("--embed-dim", type=int, default=32)
+    sp.add_argument("--num-heads", type=int, default=2)
+    sp.add_argument("--mlp-dim", type=int, default=64)
+    sp.add_argument("--num-blocks", type=int, default=2)
+    sp.add_argument("--replicas", type=int, default=2,
+                    help="decode-capable replicas (each its own "
+                         "engine on its own device slice)")
+    sp.add_argument("--prefill-replicas", type=int, default=0,
+                    help="dedicated prefill replicas: they never "
+                         "decode — they drive chunked prefill and "
+                         "publish boundary KV snapshots into the "
+                         "cluster prefix registry for decode replicas "
+                         "to adopt (needs --prefill-chunk and "
+                         "--prefix-cache-mb)")
+    sp.add_argument("--slots", type=int, default=4,
+                    help="decode slots per replica")
+    sp.add_argument("--window", type=int, default=8)
+    sp.add_argument("--max-queue-depth", type=int, default=64,
+                    help="per-replica admission-queue bound")
+    sp.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked prefill (0 = off; must divide "
+                         "--t-max) — required for prefill replicas "
+                         "and the prefix registry")
+    sp.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                    help="per-replica radix prefix cache budget in MB")
+    sp.add_argument("--registry-mb", type=float, default=0.0,
+                    help="cluster prefix-registry budget in MB (0 = "
+                         "off): chunk-boundary snapshots published by "
+                         "any replica, adopted by every other — a hot "
+                         "system prompt is prefilled ONCE cluster-wide")
+    sp.add_argument("--requests", type=int, default=16,
+                    help="synthetic trace length (ignored with "
+                         "--trace)")
+    sp.add_argument("--rate", type=float, default=50.0,
+                    help="synthetic Poisson arrival rate, requests/s")
+    sp.add_argument("--trace", default=None,
+                    help="JSONL request trace to replay "
+                         "(serve.load_trace format)")
+    sp.add_argument("--realtime", action="store_true",
+                    help="honor trace arrival times on the wall clock")
+    sp.add_argument("--eos", type=int, default=None)
+    sp.add_argument("--temperature", type=float, default=0.0)
+    sp.add_argument("--top-k", type=int, default=0)
+    sp.add_argument("--journal-dir", default=None,
+                    help="directory for per-replica journal WALs "
+                         "(<dir>/journal-<replica>.jsonl) — required "
+                         "for the kill drill's migration")
+    sp.add_argument("--max-retries", type=int, default=2,
+                    help="router-level re-placement bound per request "
+                         "(migrations + hedges)")
+    sp.add_argument("--hedge-after-ms", type=float, default=None,
+                    help="duplicate a still-unfinished request onto a "
+                         "second replica this long after placement "
+                         "(first result wins; off by default)")
+    sp.add_argument("--brownout-queue-high", type=int, default=None,
+                    help="arm a per-replica brownout controller at "
+                         "this queue-depth watermark (also the drain "
+                         "mechanism: a draining replica jumps to its "
+                         "shed stage)")
+    sp.add_argument("--kill-replica", type=int, default=None,
+                    help="failover drill: hard-kill replica INDEX "
+                         "after --kill-after-steps router steps and "
+                         "migrate its journaled requests onto the "
+                         "survivors (needs --journal-dir)")
+    sp.add_argument("--kill-after-steps", type=int, default=4,
+                    help="router steps before the --kill-replica "
+                         "drill fires")
+    sp.add_argument("--drain-replica", type=int, default=None,
+                    help="drain drill: gracefully drain replica INDEX "
+                         "after --kill-after-steps router steps "
+                         "(placement stops, in-flight work completes)")
 
     sp = sub.add_parser(
         "profile",
@@ -1741,6 +1835,172 @@ def _serve_body(ns, mesh, params, logger) -> None:
     if logger:
         logger.log(event="serve_summary", **summary)
     server.close()
+    _finish_logger(logger)
+
+
+def _run_serve_cluster(ns):
+    """Disaggregated multi-replica serving (serve/cluster/, ISSUE 12):
+    a router tier over N engine replicas — SLO/health-aware placement,
+    prefill/decode separation over the cluster prefix registry, drain,
+    and journal-backed failover (docs/LONG_CONTEXT.md "Disaggregated
+    serving")."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu.observe import JsonlLogger, Timer
+    from idc_models_tpu.serve import (
+        PrefixRegistry, RetryPolicy, Router, build_replica, load_trace,
+        poisson_trace,
+    )
+
+    if ns.replicas < 1:
+        sys.exit(f"--replicas {ns.replicas} must be >= 1")
+    if ns.prefill_replicas < 0:
+        sys.exit(f"--prefill-replicas {ns.prefill_replicas} must be "
+                 f">= 0")
+    if ns.prefill_chunk and (ns.prefill_chunk < 1
+                             or ns.t_max % ns.prefill_chunk):
+        sys.exit(f"--prefill-chunk {ns.prefill_chunk} must be >= 1 "
+                 f"and divide --t-max {ns.t_max}")
+    if ns.prefix_cache_mb > 0 and not ns.prefill_chunk:
+        sys.exit("--prefix-cache-mb needs --prefill-chunk")
+    if ns.registry_mb > 0 and not ns.prefix_cache_mb:
+        sys.exit("--registry-mb needs --prefix-cache-mb (replicas "
+                 "adopt registry snapshots through their local cache)")
+    if ns.prefill_replicas and not ns.registry_mb:
+        sys.exit("--prefill-replicas needs --registry-mb: the handoff "
+                 "artifact travels through the cluster prefix registry")
+    if ns.max_retries < 0:
+        sys.exit(f"--max-retries {ns.max_retries} must be >= 0")
+    if ns.hedge_after_ms is not None and ns.hedge_after_ms <= 0:
+        sys.exit(f"--hedge-after-ms {ns.hedge_after_ms} must be > 0")
+    n_fleet = ns.replicas + ns.prefill_replicas
+    for flag, idx in (("--kill-replica", ns.kill_replica),
+                      ("--drain-replica", ns.drain_replica)):
+        if idx is not None and not 0 <= idx < n_fleet:
+            sys.exit(f"{flag} {idx} outside the fleet [0, {n_fleet})")
+    if ns.kill_replica is not None and not ns.journal_dir:
+        sys.exit("--kill-replica needs --journal-dir: migration "
+                 "replays the dead replica's journal WAL")
+    if ns.kill_after_steps < 0:
+        sys.exit(f"--kill-after-steps {ns.kill_after_steps} must be "
+                 f">= 0")
+
+    logger = (JsonlLogger(Path(ns.path) / "logs" / "cluster.jsonl")
+              if ns.path else None)
+    model_kw = dict(embed_dim=ns.embed_dim, num_heads=ns.num_heads,
+                    num_blocks=ns.num_blocks, t_max=ns.t_max)
+    from idc_models_tpu.models.lm import attention_lm
+
+    model = attention_lm(ns.vocab, ns.t_max, embed_dim=ns.embed_dim,
+                         num_heads=ns.num_heads, mlp_dim=ns.mlp_dim,
+                         num_blocks=ns.num_blocks)
+    params = model.init(jax.random.key(ns.seed)).params
+
+    registry = (PrefixRegistry(ns.prefill_chunk,
+                               int(ns.registry_mb * 1024 * 1024),
+                               logger=logger)
+                if ns.registry_mb > 0 else None)
+    # always a policy: --max-retries 0 means ZERO re-placements (a
+    # valid, strict budget), never "unbounded"
+    retry = RetryPolicy(max_retries=ns.max_retries)
+    devices = jax.devices()
+    replicas = []
+    with Timer("Cluster build", logger=logger):
+        for i in range(n_fleet):
+            role = "prefill" if i >= ns.replicas else "mixed"
+            replicas.append(build_replica(
+                params, replica_id=f"r{i}", role=role,
+                device=devices[i % len(devices)],
+                n_slots=ns.slots, window=ns.window,
+                prefill_chunk=ns.prefill_chunk or None,
+                prefix_cache_mb=ns.prefix_cache_mb,
+                shared_prefix=registry,
+                journal_path=(
+                    str(Path(ns.journal_dir) / f"journal-r{i}.jsonl")
+                    if ns.journal_dir else None),
+                retry=retry,
+                brownout_queue_high=ns.brownout_queue_high,
+                max_queue_depth=ns.max_queue_depth,
+                temperature=ns.temperature, top_k=ns.top_k or None,
+                eos_id=ns.eos, cache_dtype=jnp.float32,
+                logger=logger, **model_kw))
+    router = Router(
+        replicas, retry=retry,
+        hedge_after_s=(None if ns.hedge_after_ms is None
+                       else ns.hedge_after_ms / 1e3),
+        prefix_registry=registry, logger=logger)
+    if ns.trace:
+        trace = load_trace(ns.trace)
+    else:
+        trace = poisson_trace(
+            ns.requests, rate_per_s=ns.rate, vocab=ns.vocab,
+            t_max=ns.t_max, eos_id=ns.eos,
+            prompt_lens=(2, max(ns.t_max // 4, 2)),
+            budgets=(2, max(ns.t_max // 4, 2)), seed=ns.seed,
+            sampled=ns.temperature > 0.0)
+    print(f"cluster: {ns.replicas} decode replica(s) + "
+          f"{ns.prefill_replicas} prefill replica(s), {ns.slots} "
+          f"slots each (window {ns.window}, t_max {ns.t_max}); "
+          f"serving {len(trace)} requests")
+    drill_at = (ns.kill_after_steps
+                if (ns.kill_replica is not None
+                    or ns.drain_replica is not None) else None)
+    with Timer("Serving trace (cluster)", logger=logger):
+        if drill_at is None:
+            results = router.run(trace, realtime=ns.realtime)
+        else:
+            # drill mode: burst-submit (re-offering on backpressure —
+            # a refused submit leaves no Result and must not be
+            # silently dropped), step to the drill point, fire it,
+            # then drain — deterministic and journal-backed
+            steps = 0
+            for _, req in sorted(trace, key=lambda tr: tr[0]):
+                while not router.submit(req):
+                    shed = router.poll(req.id)
+                    if shed is not None and shed.status == "shed":
+                        break           # terminal answer, not a race
+                    router.step()
+                    steps += 1
+            for _ in range(max(drill_at - steps, 0)):
+                router.step()
+            if ns.drain_replica is not None:
+                router.drain_replica(f"r{ns.drain_replica}")
+                print(f"drained replica r{ns.drain_replica}")
+            if ns.kill_replica is not None:
+                migrated = router.kill_replica(f"r{ns.kill_replica}")
+                print(f"killed replica r{ns.kill_replica}: "
+                      f"{len(migrated)} journaled request(s) migrated "
+                      f"onto the survivors")
+            router.drain()
+            results = router.results()
+    n_ok = sum(r.status == "ok" for r in results)
+    summary = router.summary()
+    print(f"served: ok={n_ok} "
+          f"timed_out={summary['cluster_timed_out']} "
+          f"rejected={summary['cluster_rejected']} "
+          f"shed={summary['cluster_shed']} "
+          f"tokens={summary['cluster_tokens']}")
+    if summary.get("cluster_ttft_ms_p95") is not None:
+        print(f"ttft p95 {summary['cluster_ttft_ms_p95']} ms "
+              f"(pooled across replicas)")
+    print(f"placements: {summary['cluster_placements']}  "
+          f"migrations={summary['cluster_migrations']} "
+          f"handoffs={summary['cluster_handoffs']} "
+          f"hedges={summary['cluster_hedges']}  replicas "
+          f"live={summary['cluster_replicas_live']} "
+          f"draining={summary['cluster_replicas_draining']} "
+          f"dead={summary['cluster_replicas_dead']}")
+    if registry is not None:
+        print(f"prefix registry: {summary['cluster_prefix_hits']} "
+              f"hit(s), {summary['cluster_prefix_published']} "
+              f"published, {summary['cluster_prefix_bytes']} bytes")
+    print("cluster summary:", json.dumps(summary))
+    if logger:
+        logger.log(event="cluster_summary", **summary)
+    router.close()
     _finish_logger(logger)
 
 

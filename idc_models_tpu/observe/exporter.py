@@ -11,9 +11,15 @@ serving process needs the LIVE surface Prometheus actually scrapes:
   probes: seconds since the serve scheduler's last cycle
   (`last_tick_age_s`, from the `serve_last_tick_monotonic_seconds`
   gauge the metrics hooks maintain), current `queue_depth` and
-  `slot_occupancy` gauge values, and `"status": "ok"`. Fields whose
-  gauge was never set are null — a trainer process exposing /metrics
-  has no queue.
+  `slot_occupancy` gauge values, the paged engine's
+  `kv_pages_used`/`kv_pages_total` pool occupancy, the brownout
+  controller's `brownout_stage` (0 = normal .. 3 = shedding), and
+  `"status": "ok"`. The page and brownout fields are what a cluster
+  router routes on: a replica with no page headroom should not take
+  a long prompt, and a replica deep in its brownout stages (draining,
+  or organically overloaded) is unplaceable. Fields whose gauge was
+  never set are null — a trainer process exposing /metrics has no
+  queue, no pool, no brownout.
 
 The server is a daemon `ThreadingHTTPServer` on its own thread: scrapes
 never block the scheduler (instruments are individually lock-guarded,
@@ -144,6 +150,7 @@ class MetricsExporter:
             return inst.value(default=None)
 
         last_tick = gauge_value(LAST_TICK_GAUGE)
+        stage = gauge_value("serve_brownout_stage")
         return {
             "status": "ok",
             "last_tick_age_s": (
@@ -151,4 +158,10 @@ class MetricsExporter:
                 else round(time.monotonic() - last_tick, 4)),
             "queue_depth": gauge_value("serve_queue_depth"),
             "slot_occupancy": gauge_value("serve_slot_occupancy"),
+            # the cluster-router placement signals (ISSUE 12): page
+            # headroom for paged engines, and the brownout stage so a
+            # draining/shedding replica reads as unplaceable
+            "kv_pages_used": gauge_value("serve_kv_pages_used"),
+            "kv_pages_total": gauge_value("serve_kv_pages_total"),
+            "brownout_stage": None if stage is None else int(stage),
         }

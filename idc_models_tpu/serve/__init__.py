@@ -2,6 +2,9 @@ from idc_models_tpu.serve.api import (  # noqa: F401
     LMServer, Request, Result, load_trace, poisson_trace, save_trace,
 )
 from idc_models_tpu.serve.brownout import BrownoutController  # noqa: F401
+from idc_models_tpu.serve.cluster import (  # noqa: F401
+    PrefixRegistry, Replica, Router, build_replica,
+)
 from idc_models_tpu.serve.engine import SlotEngine  # noqa: F401
 from idc_models_tpu.serve.faults import (  # noqa: F401
     InjectedEngineCrash, InjectedPrefillError, ServeFault,

@@ -105,7 +105,8 @@ class LMServer:
                  draft_order: int = 3, drafter=None,
                  kv_page_size: int | None = None,
                  kv_pages: int | None = None,
-                 kv_decode_reserve: int | None = None):
+                 kv_decode_reserve: int | None = None,
+                 registry=None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
@@ -127,6 +128,12 @@ class LMServer:
         # and the MB budget converts to pages when the engine binds
         # its allocator.
         paged = kv_page_size is not None or kv_pages is not None
+        # registry: an observe MetricsRegistry for this server's
+        # instruments (None = the process-wide default). A multi-
+        # replica process (serve/cluster) gives each replica its OWN
+        # registry so the serve_* gauges don't stomp each other and
+        # each replica's /healthz stays an honest per-replica document.
+        self.registry = registry
         if prefix_cache is not None and prefix_cache_mb:
             raise ValueError("pass prefix_cache OR prefix_cache_mb, "
                              "not both")
@@ -136,11 +143,11 @@ class LMServer:
             if paged:
                 prefix_cache = PagedPrefixCache(
                     prefill_chunk, budget_mb=prefix_cache_mb,
-                    logger=logger)
+                    logger=logger, registry=registry)
             else:
                 prefix_cache = PrefixCache(
                     prefill_chunk, int(prefix_cache_mb * 1024 * 1024),
-                    logger=logger)
+                    logger=logger, registry=registry)
         # speculative decoding (ISSUE 10): spec_decode compiles the
         # fixed-k verify program into the engine and arms the
         # scheduler's draft-and-verify window mode. The default
@@ -169,7 +176,7 @@ class LMServer:
         # feed its declared objectives (ttft/queue_wait/error_rate) and
         # evaluate burn rates once per scheduler cycle
         self.metrics = ServingMetrics(logger, prefix_cache=prefix_cache,
-                                      slo=slo)
+                                      slo=slo, registry=registry)
         # journal: a RequestJournal or a path — the WAL of accepted
         # work a rebuilt server recovers in-flight requests from
         # (resubmit_pending / serve/journal.py)

@@ -168,6 +168,23 @@ class BrownoutController:
         if self.logger is not None:
             self.logger.log(event="serve_brownout", **rec)
 
+    def force_stage(self, stage: int, *, reason: str = "drain") -> int:
+        """Jump straight to `stage`, bypassing the dwell timer — the
+        DRAIN entry point (serve/cluster): a replica being drained is
+        pushed to the shed stage so new submits are refused with the
+        honest ``shed`` status while its in-flight work completes, and
+        a drain that is cancelled steps back down through the normal
+        hysteresis. The jump is recorded like any other transition
+        (trace point, jsonl record, gauge), so the drain is visible in
+        the same timeline as organic brownouts."""
+        if not 0 <= stage < len(STAGES):
+            raise ValueError(f"stage must be in [0, {len(STAGES) - 1}], "
+                             f"got {stage}")
+        if stage != self.stage:
+            self._transition(stage, self.clock(), reason)
+            self._clear_since = None
+        return self.stage
+
     # -- the knobs the scheduler consults ---------------------------------
 
     @property

@@ -1,0 +1,7 @@
+from idc_models_tpu.serve.cluster.registry import (  # noqa: F401
+    PrefixRegistry,
+)
+from idc_models_tpu.serve.cluster.replica import (  # noqa: F401
+    Replica, build_replica,
+)
+from idc_models_tpu.serve.cluster.router import Router  # noqa: F401

@@ -1,0 +1,236 @@
+"""Cross-replica prefix registry: chunk-boundary KV snapshots shared
+by every replica in a cluster.
+
+Each replica's radix `PrefixCache` (serve/prefix_cache.py) is local to
+one engine; under a router fanning one workload across N replicas, a
+hot system prompt would be prefilled once PER REPLICA. The registry is
+the cluster-wide tier above those caches: a host-memory store of
+chunk-boundary snapshots keyed by the token prefix, shared by every
+replica in the process.
+
+What is stored is the PACKED host-numpy form of the array snapshot —
+the caches truncated to the prefix length, exactly what
+`PrefixCache.set_packer` stores locally — because that form is
+device-agnostic: any replica's `_unpack` pads it back to `t_max` and
+re-places it under its OWN mesh sharding, so one published snapshot
+serves engines on different devices. This is also the prefill→decode
+HANDOFF artifact: a dedicated prefill replica drives chunks to the
+last boundary, each completed boundary publishes here, and the decode
+replica's admission adopts the prefix without re-running a single
+chunk (serve/cluster/router.py; gated bit-identical by test).
+
+The PAGED flavor deliberately does not publish: a `PagedPrefixCache`
+snapshot is a list of physical page ids in ONE engine's pool —
+meaningless to any other replica. Paged replicas keep their local
+zero-copy sharing; cross-replica reuse is the array flavor's job.
+
+Thread-safety: replicas in this process are stepped by one router
+loop, so access is single-threaded by construction (like every other
+serve-side host structure); the registry holds no locks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from idc_models_tpu.observe import metrics_registry as mreg
+
+
+def _host_copy(tree):
+    import jax
+
+    return jax.tree.map(lambda a: np.array(a, copy=True), tree)
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return sum(int(a.nbytes) for a in jax.tree.leaves(tree))
+
+
+class _Node:
+    __slots__ = ("children", "snapshot", "nbytes", "stamp", "parent",
+                 "edge", "hit_count")
+
+    def __init__(self, parent=None, edge=None):
+        self.children: dict[tuple, _Node] = {}
+        self.snapshot = None
+        self.nbytes = 0
+        self.stamp = 0
+        self.parent = parent
+        self.edge = edge
+        self.hit_count = 0
+
+
+class PrefixRegistry:
+    """Radix store of published chunk-boundary snapshots under a byte
+    budget, LRU-evicted (never-hit snapshots first, like the local
+    caches — a burst of unique tails churns its own entries, not the
+    shared system prompts the registry exists for).
+
+    `chunk` must equal every attached cache's chunk — snapshots live
+    on one grid. `max_bytes` bounds the summed host bytes of stored
+    snapshots."""
+
+    def __init__(self, chunk: int, max_bytes: int, *, logger=None,
+                 registry=None):
+        if chunk < 1:
+            raise ValueError(f"need chunk >= 1, got {chunk}")
+        if max_bytes < 0:
+            raise ValueError(f"need max_bytes >= 0, got {max_bytes}")
+        self.chunk = int(chunk)
+        self.max_bytes = int(max_bytes)
+        self.logger = logger
+        reg = registry if registry is not None else mreg.REGISTRY
+        self._m_lookups = reg.counter(
+            "cluster_prefix_lookups_total",
+            "cross-replica prefix-registry lookups by outcome",
+            labels=("result",))
+        self._m_published = reg.counter(
+            "cluster_prefix_published_total",
+            "chunk-boundary snapshots published into the cross-replica "
+            "prefix registry")
+        self._m_bytes = reg.gauge(
+            "cluster_prefix_registry_bytes",
+            "host bytes of snapshots held by the cross-replica prefix "
+            "registry")
+        self._root = _Node()
+        self._clock = 0
+        self.nbytes = 0
+        self.n_snapshots = 0
+        self.publishes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- the chunk grid ---------------------------------------------------
+
+    def _chunks(self, tokens) -> list[tuple]:
+        toks = np.asarray(tokens).reshape(-1).tolist()
+        n_full = len(toks) // self.chunk
+        return [tuple(toks[i * self.chunk:(i + 1) * self.chunk])
+                for i in range(n_full)]
+
+    # -- publish / lookup -------------------------------------------------
+
+    def publish(self, tokens, caches, logits) -> bool:
+        """Store the snapshot for `tokens` (length on the chunk grid)
+        as host-numpy deep copies. Returns False (nothing stored) when
+        the key already exists (dedupe — the first publisher's copy
+        keeps answering; boundary snapshots for the same tokens are
+        identical by the chunk program's determinism) or the snapshot
+        alone exceeds the whole budget."""
+        toks = np.asarray(tokens).reshape(-1)
+        if toks.size == 0 or toks.size % self.chunk:
+            raise ValueError(
+                f"prefix length {toks.size} is not a multiple of the "
+                f"chunk {self.chunk} — snapshots live on chunk "
+                f"boundaries only")
+        node = self._root
+        for edge in self._chunks(toks):
+            node = node.children.setdefault(edge, _Node(node, edge))
+        self._clock += 1
+        node.stamp = self._clock
+        if node.snapshot is not None:
+            return False
+        snap = (_host_copy(caches), np.array(logits, copy=True))
+        size = _tree_bytes(snap[0]) + int(snap[1].nbytes)
+        if size > self.max_bytes:
+            self._prune(node)
+            return False
+        node.snapshot = snap
+        node.nbytes = size
+        self.nbytes += size
+        self.n_snapshots += 1
+        self.publishes += 1
+        self._m_published.inc()
+        while self.nbytes > self.max_bytes and self.n_snapshots > 1:
+            self._evict_lru(protect=node)
+        self._m_bytes.set(self.nbytes)
+        self._log(event="cluster_prefix_publish",
+                  prefix_tokens=int(toks.size), nbytes=size)
+        return True
+
+    def lookup(self, tokens):
+        """Longest published prefix of `tokens` on the chunk grid:
+        ``(start, packed_caches, logits)`` — fresh numpy copies, or
+        (0, None, None) on a miss."""
+        node = self._root
+        best, best_depth, depth = None, 0, 0
+        for edge in self._chunks(tokens):
+            node = node.children.get(edge)
+            if node is None:
+                break
+            depth += 1
+            if node.snapshot is not None:
+                best, best_depth = node, depth
+        if best is None:
+            self.misses += 1
+            self._m_lookups.inc(result="miss")
+            return 0, None, None
+        self._clock += 1
+        best.stamp = self._clock
+        best.hit_count += 1
+        self.hits += 1
+        self._m_lookups.inc(result="hit")
+        caches, logits = best.snapshot
+        return (best_depth * self.chunk, _host_copy(caches),
+                np.array(logits, copy=True))
+
+    def covered(self, tokens) -> int:
+        """Chunk-grid tokens of `tokens` the registry already holds —
+        the router's handoff short-circuit (a hot prompt need not be
+        prefilled again anywhere). Pure read: no hit/LRU bookkeeping."""
+        node, depth, best = self._root, 0, 0
+        for edge in self._chunks(tokens):
+            node = node.children.get(edge)
+            if node is None:
+                break
+            depth += 1
+            if node.snapshot is not None:
+                best = depth
+        return best * self.chunk
+
+    # -- eviction ---------------------------------------------------------
+
+    def _walk(self):
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.snapshot is not None:
+                yield n
+
+    def _evict_lru(self, protect=None) -> None:
+        victims = [n for n in self._walk() if n is not protect]
+        if not victims:
+            return
+        v = min(victims, key=lambda n: (min(n.hit_count, 1), n.stamp))
+        self.nbytes -= v.nbytes
+        v.snapshot, v.nbytes = None, 0
+        self.n_snapshots -= 1
+        self.evictions += 1
+        self._m_bytes.set(self.nbytes)
+        self._prune(v)
+
+    def _prune(self, node) -> None:
+        while (node is not self._root and node.snapshot is None
+               and not node.children and node.parent is not None):
+            del node.parent.children[node.edge]
+            node = node.parent
+
+    # -- observability ----------------------------------------------------
+
+    def summary(self) -> dict:
+        return {
+            "cluster_prefix_published": self.publishes,
+            "cluster_prefix_hits": self.hits,
+            "cluster_prefix_misses": self.misses,
+            "cluster_prefix_evictions": self.evictions,
+            "cluster_prefix_snapshots": self.n_snapshots,
+            "cluster_prefix_bytes": self.nbytes,
+        }
+
+    def _log(self, **record) -> None:
+        if self.logger is not None:
+            self.logger.log(**record)

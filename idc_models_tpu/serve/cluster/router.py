@@ -1,0 +1,677 @@
+"""The cluster router: one public submit/poll/drain surface over N
+`LMServer` replicas — SLO-aware placement, prefill/decode
+disaggregation over the prefix-registry handoff, straggler hedging,
+graceful drain, and journal-backed failover.
+
+This is the layer ROADMAP item 1 names above the single-engine serve
+stack, realized on the repo's own control plane:
+
+- **Placement** reads each replica's health document (the in-process
+  twin of `/healthz`): a replica is a candidate only while live, not
+  draining, not brownout-shedding, under its queue bound, and — paged
+  engines — holding page headroom for THIS request; candidates order
+  by (SLO burning, load, fewest free slots), ties broken by fleet
+  order, so placement is a pure function of observable state and
+  drills replay deterministically.
+- **Disaggregation**: with dedicated `role="prefill"` replicas armed,
+  a prompt reaching the first chunk boundary is first driven through
+  `Replica.prefill_only` — chunked prefill to the last boundary, each
+  boundary snapshot published into the cluster `PrefixRegistry` — and
+  the decode replica's normal admission then ADOPTS the published
+  prefix: the decode replica never runs those chunks, and the tokens
+  are bit-identical to a single-replica run because the snapshot IS
+  the chunk program's output (gated by test). A prompt the registry
+  already covers skips the prefill replica entirely — the hot system
+  prompt is prefilled once, cluster-wide.
+- **Hedging** (`hedge_after_s`): a request still unfinished that long
+  after placement is duplicated onto the least-loaded OTHER replica;
+  the first finisher answers under the original id and the loser is
+  discarded — the classic tail-latency trade (bounded duplicated
+  work), bounded per request by the `RetryPolicy`'s max_retries.
+- **Drain**: `drain_replica` flips the replica to draining (placement
+  stops; its brownout — when armed — jumps to the shed stage) while
+  its in-flight work steps to completion.
+- **Failover**: a replica whose step raises (or is killed by the
+  drill) is marked dead; terminal results its final tick salvaged are
+  adopted, and everything its journal WAL shows accepted-but-
+  unfinished is resubmitted through the NORMAL placement path onto
+  survivors — original id, seed, relative deadline, and trace_id
+  preserved (the journal contract), so recovered greedy/seeded output
+  is bit-identical (the engine's serial-parity contract; gated by
+  test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from idc_models_tpu.observe import metrics_registry as mreg
+from idc_models_tpu.observe import trace
+from idc_models_tpu.serve.api import Request, Result
+from idc_models_tpu.serve.journal import pending_requests
+from idc_models_tpu.serve.metrics import aggregate_summaries
+
+
+class Router:
+    """Front end over a fleet of `Replica`s (serve/cluster/replica.py).
+
+    The router owns the public surface: `submit`/`poll`/`step`/
+    `drain`/`run(trace)` mirror `LMServer`'s so a caller scales from
+    one replica to N without changing shape. `retry` (a scheduler
+    `RetryPolicy`) bounds per-request re-placements (migrations +
+    hedges); `prefix_registry` arms cross-replica prefix reuse and the
+    prefill/decode handoff; `slo` (an `observe.slo.SLOEngine`) is fed
+    cluster-level TTFT/error samples — the router's own burn-rate
+    alerting over the whole fleet."""
+
+    def __init__(self, replicas, *, retry=None, hedge_after_s=None,
+                 prefix_registry=None, slo=None, logger=None,
+                 registry=None, clock=time.monotonic):
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("need at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"replica ids must be unique, got {ids}")
+        if hedge_after_s is not None and hedge_after_s <= 0:
+            raise ValueError(f"need hedge_after_s > 0, got "
+                             f"{hedge_after_s}")
+        # misconfigured disaggregation fails at FLEET BUILD, not on the
+        # first caller's submit: a prefill replica is useless without
+        # chunked prefill (boundary snapshots are the artifact) and
+        # without a registry to publish through, and its chunk grid
+        # must match the registry's
+        for r in replicas:
+            if r.role != "prefill":
+                continue
+            chunk = r.server.engine.prefill_chunk
+            if chunk is None:
+                raise ValueError(
+                    f"prefill replica {r.replica_id!r} was built "
+                    f"without prefill_chunk — boundary snapshots are "
+                    f"the handoff artifact")
+            if prefix_registry is None:
+                raise ValueError(
+                    f"prefill replica {r.replica_id!r} needs a "
+                    f"prefix_registry: the handoff artifact travels "
+                    f"through it")
+            if chunk != prefix_registry.chunk:
+                raise ValueError(
+                    f"prefill replica {r.replica_id!r} chunk {chunk} "
+                    f"!= registry chunk {prefix_registry.chunk} — "
+                    f"snapshots live on one grid")
+        self.replicas = replicas
+        self._by_id = {r.replica_id: r for r in replicas}
+        self.retry = retry
+        self.hedge_after_s = hedge_after_s
+        self.prefix_registry = prefix_registry
+        self.slo = slo
+        self.logger = logger
+        self.clock = clock
+        reg = registry if registry is not None else mreg.REGISTRY
+        self._m_placements = reg.counter(
+            "cluster_placements_total",
+            "requests placed on a replica by the router",
+            labels=("replica",))
+        self._m_migrations = reg.counter(
+            "cluster_migrations_total",
+            "journaled requests migrated off a dead replica onto "
+            "survivors")
+        self._m_handoffs = reg.counter(
+            "cluster_handoffs_total",
+            "prefill->decode handoffs (a dedicated prefill replica "
+            "published the prompt's boundary snapshot for the decode "
+            "replica to adopt)")
+        self._m_hedges = reg.counter(
+            "cluster_hedges_total",
+            "straggler requests duplicated onto a second replica")
+        self._m_deaths = reg.counter(
+            "cluster_replica_deaths_total",
+            "replicas marked dead (step failure or kill drill)")
+        self._g_live = reg.gauge(
+            "cluster_replicas_live",
+            "replicas currently live (placeable fleet size)")
+        self._g_live.set(len(replicas))
+        # results finalized OUTSIDE a replica's step return (failover
+        # adoption, retry-exhausted/journal-less losses) — drained into
+        # the next step()'s return so drain()/run() keep their
+        # "returns everything that finished" contract
+        self._out_of_band: list[Result] = []
+        # rid -> current owning replica / original Request / submit
+        # stamp / total placement attempts; hedge copy id -> original
+        self._owner: dict = {}
+        self._requests: dict = {}
+        self._submit_t: dict = {}
+        self._attempts: dict = {}
+        self._hedges: dict = {}
+        self._hedged: set = set()
+        # hedge copy id -> the replica it runs on (failover cleanup)
+        self._hedge_target: dict = {}
+        # rids already routed through the handoff decision — submit()
+        # re-offers under backpressure, and each re-offer must not
+        # re-prefill or duplicate the handoff record
+        self._handed_off: set = set()
+        self._results: dict[str, Result] = {}
+        # migrated requests waiting for a survivor with room, in the
+        # dead replica's original submit order
+        self._pending_migration: list[Request] = []
+        self.placements: dict[str, int] = {i: 0 for i in ids}
+        self.migrations: list[dict] = []
+        self.handoffs: list[dict] = []
+        self.hedges_sent = 0
+        # cluster-wide sheds happen at the ROUTER (no replica ever
+        # sees the request), so they must be counted here — replica
+        # metrics cannot
+        self.cluster_sheds = 0
+
+    # -- placement --------------------------------------------------------
+
+    def _score(self, replica, health) -> tuple:
+        """Lower is better. SLO-burning replicas sort last among the
+        admissible; then least loaded; then fewest free slots as the
+        tiebreak (prefer topping up an already-warm replica over waking
+        an idle one is the WRONG call for latency — most free slots
+        first); fleet order makes the whole thing deterministic."""
+        return (1 if health["slo_breached"] else 0,
+                health["load"],
+                -health["free_slots"],
+                self.replicas.index(replica))
+
+    def _place(self, request: Request):
+        """The best replica that can take `request` right now, or
+        None. Pure function of the replicas' observable health — no
+        randomness, so placement (and every drill built on it)
+        replays."""
+        p_len = len(request.prompt)
+        cands = [r for r in self.replicas
+                 if r.can_take(p_len, int(request.max_new_tokens))]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: self._score(r, r.health()))
+
+    def _submit_to(self, replica, request: Request) -> bool:
+        ok = replica.submit(request)
+        if not ok:
+            return False
+        rid = request.id
+        self._owner[rid] = replica
+        self._requests[rid] = request
+        self._submit_t[rid] = self.clock()
+        self._attempts[rid] = self._attempts.get(rid, 0) + 1
+        self._results.pop(rid, None)
+        self.placements[replica.replica_id] += 1
+        self._m_placements.inc(replica=replica.replica_id)
+        trace.point("cluster.place", rid=rid,
+                    replica=replica.replica_id,
+                    attempt=self._attempts[rid])
+        self._log(event="cluster_place", id=rid,
+                  replica=replica.replica_id,
+                  attempt=self._attempts[rid])
+        return True
+
+    def submit(self, request: Request) -> bool:
+        """Place `request` on the best replica. False = cluster-wide
+        backpressure (every admissible queue full — retry later) or a
+        cluster-wide shed (every live replica shedding — a terminal
+        ``shed`` Result is recorded, mirroring `LMServer.submit`)."""
+        prior = self._results.get(request.id)
+        if ((prior is not None and prior.status != "shed")
+                or request.id in self._owner
+                or request.id in self._hedges):
+            # the _hedges check closes the id-namespace door: a caller
+            # id colliding with an in-flight hedge copy's would be
+            # silently renamed by the first-result-wins mapping
+            raise ValueError(f"request id {request.id!r} already used")
+        self._maybe_handoff(request)
+        target = self._place(request)
+        if target is None:
+            live = [r for r in self.replicas
+                    if r.state == "live" and r.role != "prefill"]
+            if live and all(r.server.brownout is not None
+                            and r.server.brownout.shedding
+                            for r in live):
+                # every live replica is shedding: the honest terminal
+                # answer, not a queue race to wait out
+                self._results[request.id] = Result(
+                    id=request.id, tokens=[], status="shed",
+                    finish_reason="shed")
+                self.cluster_sheds += 1
+                trace.point("cluster.shed", rid=request.id)
+                if self.slo is not None and self.slo.has("error_rate"):
+                    # a cluster-wide shed IS the fleet failing its
+                    # users, even though each replica sheds by design
+                    self.slo.record("error_rate", ok=False)
+            return False
+        return self._submit_to(target, request)
+
+    # -- disaggregated prefill --------------------------------------------
+
+    def _maybe_handoff(self, request: Request) -> None:
+        """Route the prompt's chunk-grid prefix through a dedicated
+        prefill replica (publishing its boundary snapshot for the
+        decode replica to adopt) — unless the registry already covers
+        it, in which case the prompt is hot cluster-wide and nobody
+        prefills it again."""
+        if self.prefix_registry is None:
+            return
+        if request.id in self._handed_off:
+            return                      # a re-offered blocked submit
+        pre = [r for r in self.replicas
+               if r.role == "prefill" and r.state == "live"]
+        if not pre:
+            return
+        chunk = pre[0].server.engine.prefill_chunk
+        p_len = len(request.prompt)
+        boundary = (p_len // chunk) * chunk
+        if boundary < chunk:
+            return                      # nothing on the snapshot grid
+        if p_len + 1 > pre[0].server.engine.t_max:
+            # a caller error (prompt too long to ever admit) — let the
+            # normal submission path raise the honest ValueError; it
+            # must not read as a prefill-replica fault below
+            return
+        cached = self.prefix_registry.covered(request.prompt)
+        if cached >= boundary:
+            rec = {"rid": request.id, "replica": None,
+                   "prefix_tokens": cached, "cached": True}
+        else:
+            rep = min(pre, key=lambda r: (r.load(),
+                                          self.replicas.index(r)))
+            try:
+                done = rep.prefill_only(request.prompt)
+            except Exception as exc:
+                # a prefill replica that cannot prefill is dead to the
+                # fleet; the request itself just loses the handoff and
+                # prefills on its decode replica
+                self._fail_replica(rep, exc)
+                return
+            rec = {"rid": request.id, "replica": rep.replica_id,
+                   "prefix_tokens": done, "cached": False}
+        self._handed_off.add(request.id)
+        self.handoffs.append(rec)
+        self._m_handoffs.inc()
+        trace.point("cluster.handoff", **rec)
+        self._log(event="cluster_handoff", id=rec["rid"],
+                  replica=rec["replica"],
+                  prefix_tokens=rec["prefix_tokens"],
+                  cached=rec["cached"])
+
+    # -- the step loop ----------------------------------------------------
+
+    def step(self) -> list[Result]:
+        """One cluster tick: place any migration backlog, tick every
+        live/draining replica (a step that raises marks the replica
+        dead and migrates its journal), collect finished Results, and
+        evaluate hedging. Returns the requests that finished."""
+        self._place_migrations()
+        out: list[Result] = []
+        for rep in self.replicas:
+            if rep.state == "dead":
+                continue
+            try:
+                finished = rep.step()
+            except Exception as exc:
+                self._fail_replica(rep, exc)
+                continue
+            for r in finished:
+                out.extend(self._record(rep, r))
+        if self._out_of_band:
+            # failover-finalized results (adopted terminal answers,
+            # journal-less/retry-exhausted losses) join this step's
+            # return — drain()'s contract covers every finish
+            out.extend(self._out_of_band)
+            self._out_of_band = []
+        if self.hedge_after_s is not None:
+            self._maybe_hedge()
+        if self.slo is not None:
+            self.slo.evaluate()
+        return out
+
+    def _record(self, replica, result: Result) -> list[Result]:
+        rid = result.id
+        orig = self._hedges.get(rid)
+        if orig is not None:
+            # a hedge copy finished: first result answers under the
+            # original id, the second is discarded (its work was the
+            # hedge's price)
+            del self._hedges[rid]
+            self._hedge_target.pop(rid, None)
+            if orig in self._results:
+                return []
+            result = dataclasses.replace(result, id=orig)
+            rid = orig
+        elif rid in self._results:
+            return []                   # hedged original lost the race
+        self._results[rid] = result
+        self._owner.pop(rid, None)
+        self._requests.pop(rid, None)
+        self._submit_t.pop(rid, None)
+        if self.slo is not None:
+            if result.ttft_ms is not None and self.slo.has("ttft"):
+                self.slo.observe("ttft", result.ttft_ms / 1e3)
+            if self.slo.has("error_rate"):
+                self.slo.record("error_rate", ok=result.status == "ok")
+        return [result]
+
+    def poll(self, rid: str) -> Result | None:
+        return self._results.get(rid)
+
+    def results(self) -> list[Result]:
+        return list(self._results.values())
+
+    def idle(self) -> bool:
+        return (not self._pending_migration
+                and not self._owner
+                and all(r.idle() for r in self.replicas
+                        if r.state != "dead"))
+
+    def _check_liveness(self, *, submitting: bool = False) -> None:
+        """Raise instead of spinning: with no live decode-capable
+        replica, a migration backlog (or unsubmitted trace work) can
+        never place and stepping makes no progress. Draining replicas
+        still FINISH what they hold, so only the work that needs a
+        fresh placement trips this."""
+        if any(r.state == "live" and r.role != "prefill"
+               for r in self.replicas):
+            return
+        if self._pending_migration or submitting:
+            raise RuntimeError(
+                "no live decode-capable replica left — the journals "
+                "hold the unfinished requests; rebuild the fleet and "
+                "migrate them")
+
+    def drain(self) -> list[Result]:
+        """Step until every placed request (and migration backlog) has
+        finished; returns everything that finished."""
+        out = list(self._out_of_band)
+        self._out_of_band = []
+        while not self.idle():
+            self._check_liveness()
+            out.extend(self.step())
+        return out
+
+    def run(self, trace_reqs, *, realtime: bool = False,
+            on_full: str = "block") -> list[Result]:
+        """Replay `[(arrival_s, Request), ...]` across the fleet and
+        drain — `LMServer.run`'s contract at cluster scope."""
+        if on_full not in ("block", "reject"):
+            raise ValueError(f"on_full must be 'block' or 'reject', "
+                             f"got {on_full!r}")
+        trace_reqs = sorted(trace_reqs, key=lambda tr: tr[0])
+        t0 = self.clock()
+        out, i = [], 0
+        while i < len(trace_reqs) or not self.idle():
+            self._check_liveness(submitting=i < len(trace_reqs))
+            now = self.clock() - t0
+            while i < len(trace_reqs) and (not realtime
+                                           or trace_reqs[i][0] <= now):
+                req = trace_reqs[i][1]
+                if self.submit(req):
+                    i += 1
+                    continue
+                shed = self._results.get(req.id)
+                if shed is not None and shed.status == "shed":
+                    out.append(shed)
+                    i += 1
+                elif on_full == "reject":
+                    r = Result(id=req.id, tokens=[], status="rejected")
+                    self._results[r.id] = r
+                    out.append(r)
+                    i += 1
+                else:
+                    break               # blocked: re-offer next tick
+            if realtime and self.idle() and i < len(trace_reqs):
+                time.sleep(min(max(trace_reqs[i][0]
+                                   - (self.clock() - t0), 0.0), 0.005))
+                continue
+            out.extend(self.step())
+        return out
+
+    # -- hedging ----------------------------------------------------------
+
+    def _maybe_hedge(self) -> None:
+        now = self.clock()
+        for rid, rep in list(self._owner.items()):
+            if rid in self._hedged or rid in self._hedges:
+                continue                # one hedge per request (and
+                #                         never hedge a hedge)
+            if now - self._submit_t.get(rid, now) < self.hedge_after_s:
+                continue
+            if (self.retry is not None
+                    and self._attempts.get(rid, 0)
+                    > self.retry.max_retries):
+                continue
+            request = self._requests.get(rid)
+            if request is None:
+                continue
+            p_len = len(request.prompt)
+            others = [r for r in self.replicas
+                      if r is not rep
+                      and r.can_take(p_len,
+                                     int(request.max_new_tokens))]
+            if not others:
+                continue
+            hid = f"{rid}#h"
+            if (hid in self._owner or hid in self._results
+                    or hid in self._requests):
+                # a REAL request already owns the hedge id's name —
+                # don't hedge rather than collide namespaces
+                continue
+            target = min(others,
+                         key=lambda r: self._score(r, r.health()))
+            copy = dataclasses.replace(request, id=hid)
+            if not target.submit(copy):
+                continue
+            self._hedges[copy.id] = rid
+            self._hedge_target[copy.id] = target
+            self._hedged.add(rid)
+            self._attempts[rid] = self._attempts.get(rid, 0) + 1
+            self.hedges_sent += 1
+            self._m_hedges.inc()
+            trace.point("cluster.hedge", rid=rid,
+                        replica=target.replica_id)
+            self._log(event="cluster_hedge", id=rid,
+                      replica=target.replica_id)
+
+    # -- drain / failover -------------------------------------------------
+
+    def drain_replica(self, replica_id: str, *,
+                      wait: bool = False) -> None:
+        """Graceful drain: placement stops immediately (and the
+        replica's brownout, when armed, jumps to shed); with
+        `wait=True` the fleet steps until the replica is idle."""
+        rep = self._by_id[replica_id]
+        rep.drain()
+        trace.point("cluster.drain", replica=replica_id)
+        self._log(event="cluster_drain", replica=replica_id)
+        while wait and not rep.idle():
+            self.step()
+
+    def kill_replica(self, replica_id: str) -> list[str]:
+        """The failover drill: hard-kill a replica (its journal WAL is
+        all that survives) and migrate its accepted-but-unfinished
+        requests onto the survivors. Returns the migrated ids."""
+        rep = self._by_id[replica_id]
+        return self._fail_replica(
+            rep, RuntimeError("killed by operator drill"))
+
+    def _fail_replica(self, replica, exc) -> list[str]:
+        """THE cluster recovery entry point (the serve/ exception-
+        discipline scan recognizes it next to the scheduler's
+        `_quarantine`/`_abort_running`): mark the replica dead, adopt
+        any terminal Results its final tick salvaged, and queue its
+        journal's pending requests for migration onto survivors."""
+        already_dead = replica.state == "dead"
+        replica.kill()
+        if not already_dead:
+            self._m_deaths.inc()
+            self._g_live.set(sum(1 for r in self.replicas
+                                 if r.state == "live"))
+            trace.point("cluster.replica_dead",
+                        replica=replica.replica_id,
+                        error=f"{type(exc).__name__}: {exc}")
+            self._log(event="cluster_replica_dead",
+                      replica=replica.replica_id,
+                      error=f"{type(exc).__name__}: {exc}")
+        # hedge copies RUNNING ON the dying replica die with it: drop
+        # their mappings so (a) the original — when still live on its
+        # own replica — is no longer considered hedged and may
+        # re-hedge, and (b) the journal replay below cannot resurrect
+        # the copy. An original BOTH of whose carriers are now gone
+        # (its own replica died journal-less earlier) is an honest
+        # loss, recorded here.
+        dead_copies = set()
+        for hid, tgt in list(self._hedge_target.items()):
+            if tgt is not replica:
+                continue
+            dead_copies.add(hid)
+            del self._hedge_target[hid]
+            orig = self._hedges.pop(hid, None)
+            if orig is None:
+                continue
+            self._hedged.discard(orig)
+            if orig not in self._owner and orig not in self._results:
+                lost = Result(
+                    id=orig, tokens=[], status="error",
+                    finish_reason="error",
+                    error=f"replica {replica.replica_id} died holding "
+                          f"the hedge copy of an already-lost request")
+                self._results[orig] = lost
+                self._out_of_band.append(lost)
+        # terminal results the dying tick already finalized (an
+        # engine-failure tick salvages completed entries with their
+        # true statuses — api.step's pop_failed path) are real answers;
+        # adopt them instead of re-running finished work
+        for rid, owner in list(self._owner.items()):
+            if owner is not replica:
+                continue
+            r = replica.poll(rid)
+            if r is not None and r.status != "error":
+                self._out_of_band.extend(self._record(replica, r))
+        migrated: list[str] = []
+        if replica.journal_path is not None:
+            for req in pending_requests(replica.journal_path):
+                if req.id in dead_copies:
+                    continue            # a hedge copy handled above
+                orig = self._hedges.get(req.id, req.id)
+                if orig in self._results:
+                    continue            # already answered (hedge won,
+                    #                     or adopted above)
+                if req.id in self._hedges:
+                    # a dead hedge copy: the original is still running
+                    # on its own replica — don't resurrect the copy
+                    del self._hedges[req.id]
+                    self._hedge_target.pop(req.id, None)
+                    self._hedged.discard(orig)
+                    continue
+                if req.id in self._hedges.values():
+                    # the original died but its hedge copy is still
+                    # running elsewhere: the copy IS the in-flight
+                    # recovery — let it answer instead of migrating a
+                    # duplicate
+                    self._owner.pop(req.id, None)
+                    continue
+                if (self.retry is not None
+                        and self._attempts.get(req.id, 0)
+                        > self.retry.max_retries):
+                    lost = Result(
+                        id=req.id, tokens=[], status="error",
+                        finish_reason="error",
+                        error=f"replica {replica.replica_id} died and "
+                              f"the retry budget is exhausted",
+                        trace_id=req.trace_id)
+                    self._results[req.id] = lost
+                    self._out_of_band.append(lost)
+                    self._owner.pop(req.id, None)
+                    continue
+                self._owner.pop(req.id, None)
+                self._results.pop(req.id, None)
+                self._pending_migration.append(req)
+                migrated.append(req.id)
+        else:
+            # no WAL: the in-flight requests are honestly lost —
+            # except ones whose hedge copy still runs elsewhere (the
+            # copy answers under the original id when it finishes)
+            for rid, owner in list(self._owner.items()):
+                if owner is not replica:
+                    continue
+                self._owner.pop(rid, None)
+                if rid in self._hedges.values():
+                    continue
+                lost = Result(
+                    id=rid, tokens=[], status="error",
+                    finish_reason="error",
+                    error=f"replica {replica.replica_id} died "
+                          f"without a journal")
+                self._results[rid] = lost
+                self._out_of_band.append(lost)
+        self._place_migrations()
+        return migrated
+
+    def _place_migrations(self) -> None:
+        """Offer the migration backlog to survivors, original submit
+        order preserved; a backlog head the fleet cannot take yet
+        blocks the rest (FIFO — recovered requests must not reorder
+        behind each other)."""
+        while self._pending_migration:
+            req = self._pending_migration[0]
+            target = self._place(req)
+            if target is None or not self._submit_to(target, req):
+                return
+            self._pending_migration.pop(0)
+            self.migrations.append({"rid": req.id,
+                                    "replica": target.replica_id,
+                                    "trace_id": req.trace_id})
+            self._m_migrations.inc()
+            trace.point("cluster.migrate", rid=req.id,
+                        replica=target.replica_id,
+                        trace_id=req.trace_id)
+            self._log(event="cluster_migrate", id=req.id,
+                      replica=target.replica_id, trace_id=req.trace_id)
+
+    # -- lifecycle / observability ----------------------------------------
+
+    def close(self) -> None:
+        """Shut every replica down (journals flushed); the router's
+        surface then refuses new work through the replicas' own closed
+        schedulers."""
+        for rep in self.replicas:
+            if rep.state != "dead":
+                rep.server.close()
+
+    def healths(self) -> list[dict]:
+        """Every replica's placement-signal document — the fleet view
+        an operator (or test) reads in one call."""
+        return [r.health() for r in self.replicas]
+
+    def summary(self) -> dict:
+        """The cluster rollup: pooled per-request aggregates over
+        every replica (serve/metrics.aggregate_summaries), the
+        router's own counters, and the prefix registry's — the record
+        `bench_serving_cluster` and the CLI epilogue report."""
+        out = aggregate_summaries([r.server.metrics
+                                   for r in self.replicas])
+        # replica-level sheds (a straggling direct submit refused by a
+        # draining replica's brownout) plus the router-level
+        # cluster-wide ones — either way the caller got status="shed"
+        out["cluster_shed"] += self.cluster_sheds
+        out.update({
+            "cluster_replicas_live": sum(1 for r in self.replicas
+                                         if r.state == "live"),
+            "cluster_replicas_draining": sum(
+                1 for r in self.replicas if r.state == "draining"),
+            "cluster_replicas_dead": sum(1 for r in self.replicas
+                                         if r.state == "dead"),
+            "cluster_placements": dict(self.placements),
+            "cluster_migrations": len(self.migrations),
+            "cluster_handoffs": len(self.handoffs),
+            "cluster_hedges": self.hedges_sent,
+        })
+        if self.prefix_registry is not None:
+            out.update(self.prefix_registry.summary())
+        return out
+
+    def _log(self, **record) -> None:
+        if self.logger is not None:
+            self.logger.log(**record)

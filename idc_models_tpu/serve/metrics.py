@@ -499,3 +499,49 @@ class ServingMetrics:
 
 def _r(v, scale) -> float | None:
     return None if v is None else round(v * scale, 2)
+
+
+def aggregate_summaries(metrics_list) -> dict:
+    """The CLUSTER rollup over N replicas' `ServingMetrics` — the
+    record the router's `summary()` reports and `bench_serving_cluster`
+    compares across replica counts.
+
+    Percentiles are computed over the POOLED per-request samples (every
+    replica's raw ttft/queue-wait lists concatenated), never by
+    averaging per-replica percentiles — a p95 of p95s is not a p95.
+    Aggregate throughput spans from the earliest first-submit to the
+    latest last-finish across the fleet: the wall-clock window a user
+    of the whole cluster actually experienced."""
+    metrics_list = list(metrics_list)
+    ttft, queue_wait = [], []
+    tokens = finished = rejected = timed_out = shed = 0
+    t_first, t_last = None, None
+    for m in metrics_list:
+        ttft.extend(m.ttft_s)
+        queue_wait.extend(m.queue_wait_s)
+        tokens += m.tokens_out
+        finished += m.finished
+        rejected += m.rejected
+        timed_out += m.timed_out
+        shed += m.shed
+        if m._t_first is not None:
+            t_first = (m._t_first if t_first is None
+                       else min(t_first, m._t_first))
+        if m._t_last is not None:
+            t_last = (m._t_last if t_last is None
+                      else max(t_last, m._t_last))
+    span = (t_last - t_first
+            if t_first is not None and t_last is not None else None)
+    return {
+        "cluster_replicas": len(metrics_list),
+        "cluster_requests": finished,
+        "cluster_rejected": rejected,
+        "cluster_timed_out": timed_out,
+        "cluster_shed": shed,
+        "cluster_tokens": tokens,
+        "cluster_tokens_per_sec": (round(tokens / span, 2)
+                                   if span and span > 0 else None),
+        "cluster_ttft_ms_p50": _r(_pct(ttft, 50), 1e3),
+        "cluster_ttft_ms_p95": _r(_pct(ttft, 95), 1e3),
+        "cluster_queue_wait_ms_p95": _r(_pct(queue_wait, 95), 1e3),
+    }

@@ -269,7 +269,8 @@ class PrefixCache(_RadixPrefixBase):
     snapshots (0 disables storage entirely — lookups always miss)."""
 
     def __init__(self, chunk: int, max_bytes: int, *,
-                 host: bool = False, logger=None, registry=None):
+                 host: bool = False, logger=None, registry=None,
+                 shared=None):
         if max_bytes < 0:
             raise ValueError(f"need max_bytes >= 0, got {max_bytes}")
         super().__init__(chunk, logger=logger, registry=registry)
@@ -278,6 +279,22 @@ class PrefixCache(_RadixPrefixBase):
         self._pack = None             # (caches, n_tokens) -> stored tree
         self._unpack = None           # stored tree -> caller tree
         self.nbytes = 0
+        # shared: a cluster-wide PrefixRegistry
+        # (serve/cluster/registry.py). Inserts PUBLISH each boundary
+        # snapshot (as host numpy packed trees — device-agnostic, so
+        # any replica's engine can re-place them under its own mesh),
+        # and a lookup whose local walk falls short ADOPTS the
+        # registry's longer prefix: a hot system prompt prefilled once
+        # on any replica is reused everywhere. Array snapshots only —
+        # the paged flavor's page ids name physical pages of ONE
+        # engine's pool and cannot cross replicas.
+        if shared is not None and shared.chunk != self.chunk:
+            raise ValueError(
+                f"shared registry chunk {shared.chunk} != cache chunk "
+                f"{self.chunk} — snapshots live on one chunk grid")
+        self.shared = shared
+        self.shared_hits = 0
+        self.shared_hit_tokens = 0
 
     def set_packer(self, pack, unpack) -> None:
         """Install a storage transform: ``pack(caches, n_tokens)`` maps
@@ -301,6 +318,29 @@ class PrefixCache(_RadixPrefixBase):
         fresh copies, safe to feed a donating chunk program; the stored
         master is untouched."""
         best, start = self._lookup_node(tokens)
+        # the shared registry may know a LONGER prefix (another replica
+        # prefilled it): adopt it — warm the local radix so the next
+        # lookup hits without the registry hop (a no-op while brownout
+        # pauses writes), and hand out fresh unpacked arrays exactly
+        # like a local hit. Gated on the pure-read covered() first:
+        # once the local cache covers the prefix, admissions must not
+        # pay the registry's snapshot copy (or skew its hit stats)
+        # for data they would throw away.
+        if (self.shared is not None
+                and self.shared.covered(tokens) > start):
+            s2, packed, logits2 = self.shared.lookup(tokens)
+            if s2 > start:
+                self.shared_hits += 1
+                self.shared_hit_tokens += s2 - start
+                self._log(event="serve_prefix_shared_hit",
+                          prefix_tokens=s2,
+                          prompt_tokens=int(np.asarray(tokens).size))
+                self.insert(np.asarray(tokens).reshape(-1)[:s2],
+                            packed, logits2)
+                caches = (self._unpack(packed)
+                          if self._unpack is not None
+                          else _copy_tree(packed, self.host))
+                return s2, caches, _copy_tree(logits2, self.host)
         if best is None:
             return 0, None, None
         caches, logits = best.snapshot
@@ -339,11 +379,26 @@ class PrefixCache(_RadixPrefixBase):
         while self.nbytes > self.max_bytes and self.n_snapshots > 1:
             self._evict_lru(protect=node)
         self._m_bytes.set(self.nbytes)
+        # publish to the cluster registry (it deep-copies to host numpy
+        # and dedupes by key, so republishing an adopted prefix is a
+        # no-op) — local eviction above never un-publishes: the
+        # registry has its own budget and LRU
+        if self.shared is not None:
+            self.shared.publish(toks, snap[0], snap[1])
         return True
 
     def _release_snapshot(self, node) -> int:
         self.nbytes -= node.nbytes
         return 0
+
+    def summary(self) -> dict:
+        out = super().summary()
+        if self.shared is not None:
+            # additive keys, present only when a cluster registry is
+            # attached — single-replica summaries are unchanged
+            out["serve_prefix_shared_hits"] = self.shared_hits
+            out["serve_prefix_shared_hit_tokens"] = self.shared_hit_tokens
+        return out
 
     def clear(self) -> None:
         self._root = _Node()

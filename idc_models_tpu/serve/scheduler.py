@@ -504,6 +504,14 @@ class Scheduler:
                 and not len(self.queue) and not self._retrying
                 and self.engine._pending is None)
 
+    def load(self) -> int:
+        """Requests this scheduler is responsible for right now —
+        queued + prefilling + running + quarantined-awaiting-retry.
+        The cluster router's least-loaded placement signal
+        (serve/cluster/router.py): one integer, no device traffic."""
+        return (len(self.queue) + len(self._running)
+                + len(self._prefilling) + len(self._retrying))
+
     def _apply_faults(self, cycle: int) -> None:
         """Fire the plan's non-burst faults scheduled for this cycle —
         pure function of (plan, cycle), so drills replay exactly.

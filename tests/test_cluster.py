@@ -1,0 +1,556 @@
+"""ISSUE 12: the disaggregated multi-replica serving tier
+(serve/cluster/) against its three hard contracts:
+
+1. PLACEMENT — the router places on live, non-draining, non-shedding
+   replicas by load, deterministically, and every request's output is
+   bit-identical to a serial `Generator` run (each replica carries the
+   engine's serial-parity contract; the router must not break it).
+2. DISAGGREGATION — a dedicated prefill replica publishes the prompt's
+   chunk-boundary KV snapshot into the cluster prefix registry and the
+   decode replica ADOPTS it: zero prefill chunks run on the decode
+   replica, output bit-identical to a single-replica run.
+3. FAILOVER — a killed replica's journal WAL migrates its accepted-
+   but-unfinished requests onto the survivors through the normal
+   placement path, bit-identically, with each request's trace_id and
+   relative deadline preserved across the crash boundary (one rid grep
+   over the two replicas' journals reconstructs submit -> crash ->
+   migrate -> finish under a single trace_id).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu.models.lm import Generator, attention_lm
+from idc_models_tpu.serve import (
+    PrefixRegistry, Request, RetryPolicy, Router, build_replica,
+)
+
+VOCAB, SEQ, E, HEADS, MLP, BLOCKS = 11, 32, 32, 2, 64, 2
+CHUNK = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = attention_lm(VOCAB, SEQ, embed_dim=E, num_heads=HEADS,
+                         mlp_dim=MLP, num_blocks=BLOCKS)
+    return model.init(jax.random.key(0)).params
+
+
+def _model_kw():
+    return dict(embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+                t_max=SEQ)
+
+
+def _replica(params, rid, *, device=None, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("window", 4)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return build_replica(params, replica_id=rid, device=device,
+                         **_model_kw(), **kw)
+
+
+def _serial_tokens(params, prompt, steps):
+    gen = Generator(params, mesh=None, cache_dtype=jnp.float32,
+                    **_model_kw())
+    logits, caches = gen.prefill(jnp.asarray([prompt], jnp.int32))
+    toks, _, _ = gen.decode(caches, logits, len(prompt), steps)
+    return toks.tolist()[0]
+
+
+def _requests(n, seed=5, budget=None):
+    rng = np.random.default_rng(seed)
+    return [Request(id=f"r{i}",
+                    prompt=tuple(int(x) for x in
+                                 rng.integers(0, VOCAB, 3 + 2 * i)),
+                    max_new_tokens=budget or 4 + (i % 5) * 2)
+            for i in range(n)]
+
+
+# -- 1. placement + parity --------------------------------------------------
+
+
+def test_router_places_balanced_and_bit_identical(devices, params):
+    """Two replicas on their own device slices, six greedy requests:
+    placement balances by load, every output matches the serial
+    Generator bit for bit, and the rollup pools both replicas."""
+    reps = [_replica(params, f"r{i}", device=devices[i])
+            for i in range(2)]
+    router = Router(reps)
+    reqs = _requests(6)
+    out = router.run([(0.0, r) for r in reqs])
+    assert sorted(r.id for r in out) == sorted(r.id for r in reqs)
+    for q in reqs:
+        got = router.poll(q.id)
+        assert got is not None and got.status == "ok"
+        assert got.tokens == _serial_tokens(params, q.prompt,
+                                            q.max_new_tokens), q.id
+    s = router.summary()
+    # least-loaded placement over an idle fleet alternates
+    assert s["cluster_placements"] == {"r0": 3, "r1": 3}
+    assert s["cluster_requests"] == 6
+    assert s["cluster_tokens"] == sum(len(router.poll(q.id).tokens)
+                                      for q in reqs)
+    assert s["cluster_replicas_live"] == 2
+
+
+def test_placement_prefers_the_less_loaded_replica(devices, params):
+    """A replica with queued work loses placement to an idle one —
+    the health/load signal actually routes."""
+    reps = [_replica(params, f"r{i}") for i in range(2)]
+    router = Router(reps)
+    # preload r0 through the router's own surface: 3 requests land
+    # alternately, then check the next placement goes to the lighter
+    for q in _requests(3, seed=1):
+        assert router.submit(q)
+    loads = {r.replica_id: r.load() for r in reps}
+    probe = Request(id="probe", prompt=(1, 2, 3), max_new_tokens=2)
+    assert router.submit(probe)
+    lighter = min(loads, key=lambda k: (loads[k], k))
+    assert router._owner["probe"].replica_id == lighter
+    router.drain()
+    assert router.poll("probe").status == "ok"
+
+
+def test_unplaceable_states_and_cluster_shed(devices, params):
+    """Draining and dead replicas take no placements; when every live
+    replica sheds, the router records the honest cluster-wide shed
+    Result instead of queueing into a brownout."""
+    reps = [_replica(params, f"r{i}", brownout_queue_high=4)
+            for i in range(2)]
+    router = Router(reps)
+    router.drain_replica("r0")
+    assert not reps[0].placeable()
+    q = _requests(1, seed=2)[0]
+    assert router.submit(q)
+    assert router._owner[q.id].replica_id == "r1"
+    router.drain()
+    # now force both into shed: r0 is draining (already at stage 3 via
+    # its brownout), push r1 there too
+    reps[1].server.brownout.force_stage(3, reason="test")
+    shed = Request(id="shed-me", prompt=(1, 2), max_new_tokens=2)
+    assert router.submit(shed) is False
+    got = router.poll("shed-me")
+    assert got is not None and got.status == "shed"
+    # the router-level shed is visible in the rollup even though no
+    # replica ever saw the request (review fix)
+    assert router.summary()["cluster_shed"] >= 1
+
+
+def test_router_rejects_misconfigured_prefill_replicas(devices, params):
+    """Disaggregation misconfiguration fails at FLEET BUILD with a
+    named error, not on the first caller's submit (review fix)."""
+    dec = _replica(params, "dc0")
+    no_chunk = _replica(params, "pf0", role="prefill")
+    with pytest.raises(ValueError, match="without prefill_chunk"):
+        Router([dec, no_chunk],
+               prefix_registry=PrefixRegistry(CHUNK, 1 << 20))
+    chunked = _replica(params, "pf1", role="prefill",
+                       prefill_chunk=CHUNK, prefix_cache_mb=1.0)
+    with pytest.raises(ValueError, match="needs a prefix_registry"):
+        Router([dec, chunked])
+    with pytest.raises(ValueError, match="!= registry chunk"):
+        Router([dec, chunked],
+               prefix_registry=PrefixRegistry(CHUNK * 2, 1 << 20))
+
+
+# -- 2. prefill/decode disaggregation ---------------------------------------
+
+
+def test_prefill_decode_handoff_bit_identical(devices, params):
+    """The decode replica never prefills: the prefill replica runs the
+    chunks and publishes the boundary snapshot, the decode replica's
+    admission adopts the WHOLE chunk-aligned prompt from the registry
+    (its own cache counts the adoption, its engine dispatches zero
+    prefill chunks), and the output is bit-identical to a
+    single-replica/serial run. A second identical prompt short-circuits
+    the prefill replica entirely (registry already covers it)."""
+    registry = PrefixRegistry(CHUNK, 64 * 1024 * 1024)
+    pre = _replica(params, "pf0", role="prefill", prefill_chunk=CHUNK,
+                   prefix_cache_mb=8.0, shared_prefix=registry)
+    dec = _replica(params, "dc0", role="decode", prefill_chunk=CHUNK,
+                   prefix_cache_mb=8.0, shared_prefix=registry)
+    router = Router([pre, dec], prefix_registry=registry)
+    rng = np.random.default_rng(3)
+    prompt = tuple(int(x) for x in rng.integers(0, VOCAB, 2 * CHUNK))
+    router.run([(0.0, Request(id="h0", prompt=prompt,
+                              max_new_tokens=6))])
+    got = router.poll("h0")
+    assert got.status == "ok"
+    assert got.tokens == _serial_tokens(params, prompt, 6)
+    # the handoff really happened, and the decode replica served the
+    # FULL prompt from the registry: its local cache adopted all 16
+    # tokens, so its engine ran zero prefill-chunk dispatches
+    assert router.handoffs[0] == {
+        "rid": "h0", "replica": "pf0", "prefix_tokens": 2 * CHUNK,
+        "cached": False}
+    cache = dec.server.engine.prefix_cache
+    assert cache.shared_hits == 1
+    assert cache.shared_hit_tokens == len(prompt)
+    assert registry.hits == 1
+    # prefill-role replicas never take decode placements
+    assert router.summary()["cluster_placements"]["pf0"] == 0
+    # a second identical prompt: the registry already covers it — the
+    # prefill replica is skipped (cached handoff) and parity holds
+    router.run([(0.0, Request(id="h1", prompt=prompt,
+                              max_new_tokens=6))])
+    assert router.poll("h1").tokens == got.tokens
+    assert router.handoffs[1]["cached"] is True
+
+
+def test_shared_registry_reuses_across_decode_replicas(devices, params):
+    """Cross-replica prefix reuse WITHOUT dedicated prefill replicas:
+    the first decode replica to prefill a hot prompt publishes it, and
+    the other replica adopts instead of re-prefilling — one physical
+    prefill cluster-wide."""
+    registry = PrefixRegistry(CHUNK, 64 * 1024 * 1024)
+    reps = [_replica(params, f"r{i}", prefill_chunk=CHUNK,
+                     prefix_cache_mb=8.0, shared_prefix=registry)
+            for i in range(2)]
+    router = Router(reps, prefix_registry=registry)
+    rng = np.random.default_rng(4)
+    hot = tuple(int(x) for x in rng.integers(0, VOCAB, 2 * CHUNK))
+    # two requests with the same prompt land on DIFFERENT replicas
+    # (least-loaded alternation) in one burst
+    reqs = [Request(id=f"s{i}", prompt=hot, max_new_tokens=4)
+            for i in range(2)]
+    router.run([(0.0, r) for r in reqs])
+    owners = {router.poll(r.id).status for r in reqs}
+    assert owners == {"ok"}
+    want = _serial_tokens(params, hot, 4)
+    assert all(router.poll(r.id).tokens == want for r in reqs)
+    # one replica prefilled + published; the other adopted
+    shared = sum(r.server.engine.prefix_cache.shared_hits
+                 for r in reps)
+    assert registry.publishes >= 1
+    assert shared >= 1
+
+
+# -- 3. drain + failover ----------------------------------------------------
+
+
+def test_kill_drill_migrates_journal_bit_identical(devices, params,
+                                                   tmp_path):
+    """The acceptance drill: two replicas with journal WALs, a burst
+    of requests, one replica killed mid-flight. Every journaled
+    request completes on the survivor with output bit-identical to an
+    uncrashed serial run, and a single rid grep over BOTH journals
+    reconstructs submit -> crash -> migrate -> finish under ONE
+    trace_id with the original relative deadline preserved
+    (ISSUE 12 satellite)."""
+    reps = [_replica(params, f"r{i}", device=devices[i],
+                     journal_path=str(tmp_path / f"j{i}.jsonl"))
+            for i in range(2)]
+    router = Router(reps, retry=RetryPolicy(max_retries=2))
+    rng = np.random.default_rng(7)
+    reqs = [Request(id=f"k{i}",
+                    prompt=tuple(int(x) for x in
+                                 rng.integers(0, VOCAB, 4 + i)),
+                    max_new_tokens=8, deadline_s=120.0)
+            for i in range(8)]
+    for q in reqs:
+        assert router.submit(q)
+    for _ in range(2):
+        router.step()
+    # kill whichever replica still owns work (placement alternated, so
+    # both do — pick r0 deterministically)
+    migrated = router.kill_replica("r0")
+    assert migrated, "the kill must strand journaled work"
+    assert reps[0].state == "dead"
+    router.drain()
+    for q in reqs:
+        got = router.poll(q.id)
+        assert got is not None and got.status == "ok", (q.id, got)
+        assert got.tokens == _serial_tokens(params, q.prompt, 8), q.id
+    s = router.summary()
+    assert s["cluster_migrations"] == len(migrated)
+    assert s["cluster_replicas_dead"] == 1
+    # the satellite's grep: one rid, two journals, one trace_id
+    submits: dict = {}
+    finishes: dict = {}
+    for i in (0, 1):
+        for line in (tmp_path / f"j{i}.jsonl").read_text().splitlines():
+            rec = json.loads(line)
+            if rec.get("event") == "journal_submit":
+                submits.setdefault(rec["id"], []).append((i, rec))
+            elif rec.get("event") == "journal_finish":
+                finishes.setdefault(rec["id"], []).append((i, rec))
+    for rid in migrated:
+        subs = submits[rid]
+        assert len(subs) == 2                  # dead replica + survivor
+        assert {i for i, _ in subs} == {0, 1}
+        tids = {rec["trace_id"] for _, rec in subs}
+        assert len(tids) == 1, (rid, tids)     # ONE lifecycle identity
+        # the ORIGINAL relative deadline rides the migration
+        assert {rec["deadline_s"] for _, rec in subs} == {120.0}
+        fins = finishes[rid]
+        assert [i for i, _ in fins] == [1]     # finished on the survivor
+        assert fins[0][1]["status"] == "ok"
+
+
+def test_drain_completes_in_flight_work(devices, params):
+    """Draining a replica finishes what it holds (no migration, no
+    loss) while new work routes around it."""
+    reps = [_replica(params, f"r{i}", brownout_queue_high=8)
+            for i in range(2)]
+    router = Router(reps)
+    reqs = _requests(4, seed=9)
+    for q in reqs:
+        assert router.submit(q)
+    owned_by_r0 = [rid for rid, rep in router._owner.items()
+                   if rep.replica_id == "r0"]
+    assert owned_by_r0
+    router.drain_replica("r0", wait=True)
+    assert reps[0].idle()
+    # drained replica finished its own work...
+    for rid in owned_by_r0:
+        assert router.poll(rid) is not None
+    # ...its brownout sits at the shed stage, and new work avoids it
+    assert reps[0].server.brownout.stage == 3
+    late = Request(id="late", prompt=(1, 2, 3), max_new_tokens=2)
+    assert router.submit(late)
+    assert router._owner["late"].replica_id == "r1"
+    router.drain()
+    assert all(router.poll(q.id).status == "ok" for q in reqs)
+
+
+def test_replica_step_failure_fails_over(devices, params, tmp_path):
+    """An engine failure DURING a step (injected crash fault) is a
+    replica death, not a cluster death: the router marks it dead and
+    migrates its journal onto the survivor, bit-identically."""
+    from idc_models_tpu.serve import ServeFault, ServeFaultPlan
+
+    plan = ServeFaultPlan([ServeFault(kind="crash", tick=2)])
+    crasher = build_replica(
+        params, replica_id="r0", n_slots=2, window=4,
+        cache_dtype=jnp.float32, journal_path=str(tmp_path / "j0.jsonl"),
+        fault_plan=plan, **_model_kw())
+    healthy = _replica(params, "r1",
+                       journal_path=str(tmp_path / "j1.jsonl"))
+    router = Router([crasher, healthy])
+    reqs = _requests(4, seed=11, budget=8)
+    for q in reqs:
+        assert router.submit(q)
+    router.drain()
+    assert crasher.state == "dead"
+    assert router.summary()["cluster_replicas_dead"] == 1
+    assert router.summary()["cluster_migrations"] >= 1
+    for q in reqs:
+        got = router.poll(q.id)
+        assert got is not None and got.status == "ok", (q.id, got)
+        assert got.tokens == _serial_tokens(params, q.prompt, 8), q.id
+
+
+def test_handoff_caller_error_does_not_kill_prefill_replica(devices,
+                                                            params):
+    """A prompt too long to ever admit is a CALLER error: the normal
+    submission path raises the honest ValueError, and the prefill
+    replica must survive it (review fix: the handoff wrapper used to
+    read it as a replica fault and kill fleet infrastructure)."""
+    registry = PrefixRegistry(CHUNK, 1 << 20)
+    pre = _replica(params, "pf0", role="prefill", prefill_chunk=CHUNK,
+                   prefix_cache_mb=2.0, shared_prefix=registry)
+    dec = _replica(params, "dc0", prefill_chunk=CHUNK,
+                   prefix_cache_mb=2.0, shared_prefix=registry)
+    router = Router([pre, dec], prefix_registry=registry)
+    too_long = Request(id="huge", prompt=tuple(range(SEQ)),
+                       max_new_tokens=4)
+    with pytest.raises(ValueError):
+        router.submit(too_long)
+    assert pre.state == "live"          # infrastructure unharmed
+    ok = Request(id="fine", prompt=tuple(range(CHUNK)),
+                 max_new_tokens=4)
+    assert router.submit(ok)
+    router.drain()
+    assert router.poll("fine").status == "ok"
+
+
+def test_no_decode_capable_replica_raises_not_spins(devices, params,
+                                                    tmp_path):
+    """With the last decode-capable replica dead, run()/drain() must
+    raise the rebuild-the-fleet error instead of busy-looping (review
+    fix: a surviving prefill replica used to defeat the all-dead
+    guard)."""
+    registry = PrefixRegistry(CHUNK, 1 << 20)
+    dec = _replica(params, "dc0", prefill_chunk=CHUNK,
+                   prefix_cache_mb=2.0, shared_prefix=registry,
+                   journal_path=str(tmp_path / "j.jsonl"))
+    pre = _replica(params, "pf0", role="prefill", prefill_chunk=CHUNK,
+                   prefix_cache_mb=2.0, shared_prefix=registry)
+    router = Router([dec, pre], prefix_registry=registry)
+    assert router.submit(Request(id="a", prompt=(1, 2, 3),
+                                 max_new_tokens=4))
+    router.kill_replica("dc0")
+    with pytest.raises(RuntimeError, match="rebuild the fleet"):
+        router.drain()                  # migration backlog, no target
+    with pytest.raises(RuntimeError, match="rebuild the fleet"):
+        router.run([(0.0, Request(id="b", prompt=(1, 2),
+                                  max_new_tokens=2))])
+
+
+def test_hedge_first_result_wins_and_survives_owner_death(devices,
+                                                          params):
+    """Straggler hedging: past hedge_after_s the request is duplicated
+    onto the other replica; when the ORIGINAL owner then dies without
+    a journal, the hedge copy answers under the original id (review
+    fix: the loss path used to declare an error while the copy was
+    still running) — and the result is the bit-identical stream."""
+    t = [0.0]
+    reps = [_replica(params, f"r{i}") for i in range(2)]
+    router = Router(reps, hedge_after_s=0.5, clock=lambda: t[0])
+    q = Request(id="h", prompt=(1, 2, 3), max_new_tokens=6)
+    assert router.submit(q)
+    owner = router._owner["h"]
+    t[0] = 1.0                          # past the hedge threshold
+    router.step()
+    assert router.hedges_sent == 1
+    router.kill_replica(owner.replica_id)
+    out = router.drain()
+    got = router.poll("h")
+    assert got is not None and got.status == "ok"
+    assert got.tokens == _serial_tokens(params, (1, 2, 3), 6)
+    # exactly one Result surfaced for the rid — no spurious loss
+    assert [r.id for r in out + router.results()].count("h") <= 2
+    assert router.poll("h#h") is None   # the copy never leaks its id
+
+
+def test_journalless_death_returns_error_results(devices, params):
+    """A replica dying WITHOUT a WAL loses its in-flight requests
+    honestly — and those error Results come back through the step/
+    drain return value, not just poll() (review fix: failover-
+    finalized results used to bypass the drain contract)."""
+    reps = [_replica(params, f"r{i}") for i in range(2)]
+    router = Router(reps)
+    reqs = _requests(4, seed=17, budget=8)
+    for q in reqs:
+        assert router.submit(q)
+    owned = [rid for rid, rep in router._owner.items()
+             if rep.replica_id == "r0"]
+    assert owned
+    router.kill_replica("r0")
+    finished = router.drain()
+    by_id = {r.id: r for r in finished}
+    for rid in owned:
+        assert by_id[rid].status == "error"
+        assert "without a journal" in by_id[rid].error
+    # the survivor's requests still completed fine
+    for rid, rep in [(q.id, None) for q in reqs]:
+        assert rid in by_id
+
+
+def test_paged_replicas_route_on_page_headroom(devices, params):
+    """A PAGED fleet: the router's placement gate consults each
+    replica's page headroom (`can_admit_pages`), the health document
+    carries the pool occupancy, and outputs stay bit-identical.
+    Paged replicas refuse the cluster registry (physical page ids
+    cannot cross pools) — asserted loudly."""
+    with pytest.raises(ValueError, match="paged"):
+        _replica(params, "bad", prefill_chunk=8, prefix_cache_mb=1.0,
+                 shared_prefix=PrefixRegistry(8, 1024),
+                 kv_page_size=8, kv_pages=8)
+    reps = [_replica(params, f"r{i}", prefill_chunk=8,
+                     kv_page_size=8, kv_pages=8)
+            for i in range(2)]
+    router = Router(reps)
+    h = reps[0].health()
+    assert h["kv_pages_total"] == 8 and h["kv_pages_used"] == 0
+    reqs = _requests(4, seed=13, budget=6)
+    out = router.run([(0.0, r) for r in reqs])
+    assert {r.status for r in out} == {"ok"}
+    for q in reqs:
+        got = router.poll(q.id)
+        assert got.tokens == _serial_tokens(params, q.prompt, 6), q.id
+
+
+# -- the health surface -----------------------------------------------------
+
+
+def test_replica_health_document_fields(devices, params):
+    rep = _replica(params, "r0", brownout_queue_high=4)
+    h = rep.health()
+    assert h["replica"] == "r0" and h["state"] == "live"
+    assert h["queue_depth"] == 0 and h["load"] == 0
+    assert h["free_slots"] == 2 and h["brownout_stage"] == 0
+    assert h["kv_pages_total"] is None          # contiguous engine
+    assert h["slo_breached"] is False
+    assert h["last_tick_age_s"] is None         # never stepped
+    rep.server.submit(Request(id="x", prompt=(1, 2),
+                              max_new_tokens=2))
+    rep.step()
+    h = rep.health()
+    assert h["last_tick_age_s"] is not None
+    rep.drain()
+    assert rep.health()["state"] == "draining"
+    assert rep.health()["brownout_stage"] == 3  # drain = forced shed
+
+
+# -- the prefix registry (host-side unit) -----------------------------------
+
+
+def test_prefix_registry_roundtrip_dedupe_eviction():
+    reg = PrefixRegistry(4, 10_000)
+    caches = [(np.ones((1, 4, 2, 2), np.float32),
+               np.ones((1, 4, 2, 2), np.float32))]
+    logits = np.zeros((1, 8), np.float32)
+    toks = np.arange(4)
+    assert reg.publish(toks, caches, logits)
+    assert not reg.publish(toks, caches, logits)       # dedupe
+    start, got, lg = reg.lookup(np.arange(8))
+    assert start == 4
+    assert got[0][0].shape == (1, 4, 2, 2)
+    # handed-out arrays are COPIES — mutating them cannot corrupt the
+    # stored master
+    got[0][0][:] = 7.0
+    _, again, _ = reg.lookup(np.arange(8))
+    assert float(again[0][0][0, 0, 0, 0]) == 1.0
+    assert reg.covered(np.arange(8)) == 4
+    assert reg.covered(np.arange(3)) == 0
+    with pytest.raises(ValueError):
+        reg.publish(np.arange(3), caches, logits)      # off the grid
+    # budget eviction: a second distinct prefix evicts the LRU one
+    small = PrefixRegistry(4, int(sum(a.nbytes for a in caches[0])
+                                  + logits.nbytes))
+    assert small.publish(toks, caches, logits)
+    assert small.publish(np.arange(10, 14), caches, logits)
+    assert small.n_snapshots == 1 and small.evictions == 1
+
+
+def test_registry_chunk_mismatch_rejected():
+    from idc_models_tpu.serve.prefix_cache import PrefixCache
+
+    reg = PrefixRegistry(4, 1024)
+    with pytest.raises(ValueError, match="chunk"):
+        PrefixCache(8, 1024, shared=reg)
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_cli_serve_cluster_smoke(devices, capsys, tmp_path):
+    """The serve-cluster verb end to end at smoke scale: 2 decode + 1
+    prefill replica, prefix registry, journals, and the kill drill —
+    the epilogue must report the migration and the summary line must
+    parse."""
+    from idc_models_tpu.cli import main
+
+    rc = main([
+        "serve-cluster", "--replicas", "2", "--prefill-replicas", "1",
+        "--vocab", "11", "--t-max", "32", "--embed-dim", "32",
+        "--num-heads", "2", "--mlp-dim", "64", "--num-blocks", "2",
+        "--slots", "2", "--window", "4", "--requests", "8",
+        "--prefill-chunk", "4", "--prefix-cache-mb", "2",
+        "--registry-mb", "8", "--journal-dir", str(tmp_path),
+        "--kill-replica", "1", "--kill-after-steps", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "killed replica r1" in out
+    assert "migrated onto the survivors" in out
+    summary = json.loads(out.split("cluster summary: ", 1)[1]
+                         .splitlines()[0])
+    assert summary["cluster_requests"] == 8
+    assert summary["cluster_replicas_dead"] == 1
+    assert summary["cluster_timed_out"] == 0
+    assert summary["cluster_handoffs"] >= 1

@@ -51,7 +51,9 @@ def test_healthz_reports_tick_age_queue_and_occupancy():
         doc = json.loads(body)
         assert ctype.startswith("application/json")
         assert doc == {"status": "ok", "last_tick_age_s": None,
-                       "queue_depth": None, "slot_occupancy": None}
+                       "queue_depth": None, "slot_occupancy": None,
+                       "kv_pages_used": None, "kv_pages_total": None,
+                       "brownout_stage": None}
         # the serve gauges appear -> the document fills in
         reg.gauge(LAST_TICK_GAUGE, "tick stamp").set(time.monotonic())
         reg.gauge("serve_queue_depth", "depth").set(3)
@@ -60,6 +62,24 @@ def test_healthz_reports_tick_age_queue_and_occupancy():
         assert doc["queue_depth"] == 3.0
         assert doc["slot_occupancy"] == 0.5
         assert 0.0 <= doc["last_tick_age_s"] < 5.0
+
+
+def test_healthz_reports_page_headroom_and_brownout_stage():
+    """ISSUE 12 satellite: the gauges the cluster router routes on —
+    paged-KV pool occupancy and the brownout stage — surface on
+    /healthz (they previously existed only in /metrics)."""
+    reg = MetricsRegistry()
+    with MetricsExporter(reg, port=0) as exp:
+        reg.gauge("serve_kv_pages_used", "pool pages used").set(12)
+        reg.gauge("serve_kv_pages_total", "pool size").set(64)
+        reg.gauge("serve_brownout_stage", "degradation stage").set(2)
+        doc = json.loads(_get(exp.url + "/healthz")[2])
+        assert doc["kv_pages_used"] == 12.0
+        assert doc["kv_pages_total"] == 64.0
+        # the stage is an ENUM, handed back as an int so an LB config
+        # can compare it against the shed threshold without float fuzz
+        assert doc["brownout_stage"] == 2
+        assert isinstance(doc["brownout_stage"], int)
 
 
 def test_healthz_ignores_wrong_kind_and_labeled_series():

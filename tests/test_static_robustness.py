@@ -324,11 +324,15 @@ def test_allowlist_entries_still_exist():
 # re-raises). An except block in serve/ that does neither — catches,
 # logs-or-not, and falls through — is a request silently lost, the
 # exact bug class the quarantine machinery exists to kill. This scan
-# walks every handler in serve/ and requires a `raise` or a call to one
-# of the recovery entry points in its body, outside the documented
-# allowlist.
+# walks every handler in serve/ — serve/cluster/ included (ISSUE 12):
+# the router's handlers must route through ITS recovery entry point,
+# `_fail_replica` (mark the replica dead + migrate its journal), the
+# cluster-scope analogue of the scheduler's quarantine — and requires
+# a `raise` or a call to one of the recovery entry points in the
+# handler body, outside the documented allowlist.
 
-_SERVE_RECOVERY_CALLS = {"_quarantine", "_abort_running"}
+_SERVE_RECOVERY_CALLS = {"_quarantine", "_abort_running",
+                         "_fail_replica"}
 
 # (path relative to serve/, enclosing function) -> why neither raising
 # nor quarantining is correct there
