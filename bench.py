@@ -596,13 +596,250 @@ def bench_federated_robustness(on_accelerator: bool, *, n_clients: int = 10,
     mean_loss = final_loss(None)
     trimmed_loss = final_loss(get_aggregator("trimmed_mean",
                                              trim=n_byzantine))
-    return {
+    out = {
         "fed_byz_clients": n_byzantine,
         "fed_byz_total_clients": n_clients,
         "fed_byz_rounds": rounds,
         "fed_byz_mean_eval_loss": round(mean_loss, 4),
         "fed_byz_trimmed_eval_loss": round(trimmed_loss, 4),
         "fed_byz_robust_advantage": round(mean_loss / trimmed_loss, 2),
+    }
+    out.update(_bench_async_vs_sync_stragglers())
+    return out
+
+
+def _bench_async_vs_sync_stragglers():
+    """ISSUE-13 acceptance pair: under one injected straggler plan,
+    buffered-async FedAvg strictly beats the synchronous streamed
+    round on wall-clock-to-target-loss, the PR 7 round-latency SLO
+    alert FIRES in sync mode and stays SILENT in async (both
+    asserted). The wall-clock gap is injected-sleep-driven — the sync
+    barrier sleeps out each round's max straggler delay while the
+    async buffer fills from the fast arrivals — so the comparison is
+    valid on the CPU container (no device-overlap claim)."""
+    import time
+
+    import jax
+
+    from idc_models_tpu import faults as faults_lib
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.federated import (
+        ClientPopulation, CohortSampler, DriverConfig, initialize_server,
+        make_async_round, make_federated_eval, make_population_round,
+        run_rounds,
+    )
+    from idc_models_tpu.observe import SLO, SLOEngine
+    from idc_models_tpu.train import rmsprop
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    model = _small_model()
+    population = ClientPopulation(64, examples_per_client=16,
+                                  image_size=10, seed=0)
+    cohort, wave, buffer_k, rounds = 8, 8, 4, 6
+    mesh = meshlib.client_mesh(1)
+    # a quarter of the population straggles at lag 2, 0.5 s per lag
+    # unit: every sync round that samples one waits ~1 s at the
+    # barrier; the async server just keeps filling buffers — the
+    # sleeps, not the (shared) compile cost, drive the wall-clock gap
+    plan = faults_lib.PopulationFaultPlan(
+        population.size,
+        [faults_lib.PopulationFault("straggler", fraction=0.25,
+                                    staleness=2)],
+        seed=3, delay_unit_s=0.5)
+    eval_sampler = CohortSampler(population, 8, seed=999)
+    eval_imgs, eval_labels, eval_w = population.materialize(
+        eval_sampler.cohort(0))
+    eval_fn = make_federated_eval(model, binary_cross_entropy, mesh)
+
+    def slo_engine():
+        # p80 of round wall <= 0.35 s with a 20% error budget: the
+        # compile-heavy first round fits inside the budget, a straggler
+        # WAVE (every round sleeping ~0.5 s) does not — the same shape
+        # examples/11_slo_alerts.py drills
+        return SLOEngine(
+            [SLO.latency("round_seconds", threshold_s=0.35,
+                         percentile=80.0)],
+            short_window_s=60.0, long_window_s=300.0, min_samples=5)
+
+    def eval_loss(server):
+        return float(eval_fn(server, eval_imgs, eval_labels,
+                             eval_w)["loss"])
+
+    # --- sync: streamed round with the barrier sleep armed ------------
+    sampler = CohortSampler(population, cohort, seed=11)
+    sync_round = make_population_round(
+        model, rmsprop(1e-3), binary_cross_entropy, mesh, population,
+        sampler, wave_size=wave, local_epochs=1, batch_size=16,
+        faults=plan, barrier_sleep=True)
+    sync_slo = slo_engine()
+    server = initialize_server(model, jax.random.key(0))
+    server = jax.device_put(server, meshlib.replicated(mesh))
+    t0 = time.monotonic()
+    res = run_rounds(sync_round, server, None, None,
+                     np.ones((cohort,), np.float32),
+                     config=DriverConfig(rounds=rounds), seed=1,
+                     slo=sync_slo)
+    sync_wall = time.monotonic() - t0
+    target_loss = eval_loss(res.server)
+    sync_alerts = [a for a in sync_slo.alerts
+                   if a["slo"] == "round_seconds"]
+    assert sync_alerts, (
+        "the straggler barrier must trip the round-latency SLO in "
+        "sync mode (rounds: "
+        f"{[e['seconds'] for e in res.events]})")
+
+    # --- async: buffered server, same plan, run to the sync loss ------
+    async_round = make_async_round(
+        model, rmsprop(1e-3), binary_cross_entropy, population,
+        CohortSampler(population, cohort, seed=11),
+        buffer_size=buffer_k, staleness_decay=0.9, local_epochs=1,
+        batch_size=16, faults=plan, base_latency_s=(0.005, 0.02),
+        realtime=True, seed=1)
+    async_slo = slo_engine()
+    server = initialize_server(model, jax.random.key(0))
+    t0 = time.monotonic()
+    async_rounds = 0
+    staleness = []
+    while True:
+        res = run_rounds(async_round, server, None, None,
+                         np.ones((cohort,), np.float32),
+                         config=DriverConfig(rounds=async_rounds + 1),
+                         seed=1, slo=async_slo)
+        server = res.server
+        async_rounds += 1
+        staleness.append(res.history[-1].get("staleness_mean", 0.0))
+        if eval_loss(server) <= target_loss or async_rounds >= 4 * rounds:
+            break
+    async_wall = time.monotonic() - t0
+    async_loss = eval_loss(server)
+    assert not async_slo.alerts, (
+        f"async mode must absorb the stragglers without burning the "
+        f"round-latency budget, got alerts: {async_slo.alerts}")
+    assert async_loss <= target_loss, (
+        f"async never reached the sync target loss ({async_loss} > "
+        f"{target_loss} after {async_rounds} rounds)")
+    assert async_wall < sync_wall, (
+        f"async must strictly beat sync wall-clock-to-target-loss, "
+        f"got async {async_wall:.2f}s vs sync {sync_wall:.2f}s")
+    return {
+        "fed_sync_wall_to_loss_s": round(sync_wall, 3),
+        "fed_async_wall_to_loss_s": round(async_wall, 3),
+        "fed_async_speedup": round(sync_wall / async_wall, 2),
+        "fed_async_rounds_to_loss": async_rounds,
+        "fed_sync_slo_alerts": len(sync_alerts),
+        "fed_async_slo_alerts": len(async_slo.alerts),
+        "fed_async_staleness_mean": round(
+            float(np.mean(staleness)), 3),
+    }
+
+
+def _rss_mb() -> float:
+    """Current (not peak) resident set, MB, from /proc/self/status."""
+    for line in open("/proc/self/status"):
+        if line.startswith("VmRSS:"):
+            return float(line.split()[1]) / 1024.0
+    return float("nan")
+
+
+def _peak_rss_mb() -> float:
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def bench_federated_scale(on_accelerator: bool):
+    """ISSUE-13 acceptance: a 10k-virtual-client population with a
+    256-client sampled cohort trains in memory bounded by the WAVE,
+    independent of the population size. Methodology: run the identical
+    cohort/wave configuration at a 1k and then a 10k population; the
+    10k run's PEAK-RSS growth over the already-established 1k peak is
+    asserted under a small fixed bound (a population-sized allocation
+    of even one float per client per shard example would blow it), and
+    per-round RSS deltas are reported for both. A sampled round also
+    replays bit-identically from (seed, round) across two fresh
+    builds — the tree-wide drill contract."""
+    import jax
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.federated import (
+        ClientPopulation, CohortSampler, initialize_server,
+        make_population_round,
+    )
+    from idc_models_tpu.train import rmsprop
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    model = _small_model()
+    cohort, wave = 256, 32
+    n_dev = len(jax.devices())
+    mesh = meshlib.client_mesh(meshlib.largest_dividing_mesh(wave,
+                                                             n_dev))
+
+    def build_round(n_population):
+        population = ClientPopulation(
+            n_population, examples_per_client=16, image_size=10,
+            seed=0)
+        sampler = CohortSampler(population, cohort, seed=0)
+        return make_population_round(
+            model, rmsprop(1e-3), binary_cross_entropy, mesh,
+            population, sampler, wave_size=wave, local_epochs=1,
+            batch_size=16)
+
+    def run(rnd, seed_round=0):
+        server = initialize_server(model, jax.random.key(0))
+        server = jax.device_put(server, meshlib.replicated(mesh))
+        rss0 = _rss_mb()
+        t0 = time.perf_counter()
+        server, metrics = rnd(server, None, None, None,
+                              jax.random.key(1), round_idx=seed_round)
+        jax.block_until_ready(server.params)
+        return server, metrics, time.perf_counter() - t0, \
+            _rss_mb() - rss0
+
+    rnd_1k, rnd_10k = build_round(1_000), build_round(10_000)
+    run(rnd_1k)                                  # cold: pays compiles
+    _, metrics, dt_10k, _ = run(rnd_10k)
+    assert int(metrics["participants"]) == cohort
+
+    # bit-identical replay from (seed, round): a fresh build of the
+    # same population/sampler/round replays the sampled round exactly
+    s_a, _, _, _ = run(build_round(10_000), seed_round=3)
+    s_b, _, _, _ = run(build_round(10_000), seed_round=3)
+    for a, b in zip(jax.tree.leaves(jax.device_get(s_a.params)),
+                    jax.tree.leaves(jax.device_get(s_b.params))):
+        np.testing.assert_array_equal(a, b)
+
+    # the O(wave) memory gate, in a form that holds BOTH standalone and
+    # inside a full bench run (where the process peak is pre-saturated
+    # by earlier benchmarks): with every compile paid above, WARM
+    # rounds at 1k and 10k must (a) not move the process PEAK at all
+    # beyond wave-transient noise and (b) show near-equal per-round
+    # RSS deltas — a population-sized shard materialization alone
+    # would be ~190 MB at 10k
+    peak_before_warm = _peak_rss_mb()
+    _, _, dt_1k_warm, rss_1k = run(rnd_1k, seed_round=5)
+    _, _, dt_warm, rss_10k = run(rnd_10k, seed_round=5)
+    peak_growth = _peak_rss_mb() - peak_before_warm
+    assert peak_growth < 64.0, (
+        f"warm 1k+10k rounds grew the process peak RSS by "
+        f"{peak_growth:.1f} MB — population-sized state is leaking "
+        f"into the round (the contract is O(wave) memory, independent "
+        f"of population)")
+    assert rss_10k < max(2.0 * abs(rss_1k), 32.0), (
+        f"a warm 10k-population round grew RSS by {rss_10k:.1f} MB vs "
+        f"{rss_1k:.1f} MB at 1k — the per-round footprint must be "
+        f"O(wave), independent of the population")
+
+    return {
+        "fed_scale_population": 10_000,
+        "fed_scale_cohort": cohort,
+        "fed_scale_wave": wave,
+        "fed_scale_round_s": round(dt_warm, 3),
+        "fed_scale_round_s_cold": round(dt_10k, 3),
+        "fed_scale_round_s_1k": round(dt_1k_warm, 3),
+        "fed_scale_rss_delta_mb_1k": round(rss_1k, 1),
+        "fed_scale_rss_delta_mb_10k": round(rss_10k, 1),
+        "fed_scale_peak_growth_mb": round(peak_growth, 1),
+        "fed_scale_replay_bitwise": 1.0,
     }
 
 
@@ -1817,6 +2054,7 @@ HIGHER_IS_BETTER = (
     "cluster_tokens_per_sec_2r", "cluster_scaling_1to2",
     "ring_fwd_speedup_vs_jnp", "ring_fwd_speedup_median",
     "zigzag_schedule_speedup", "fed_byz_robust_advantage",
+    "fed_async_speedup", "fed_scale_replay_bitwise",
 )
 LOWER_IS_BETTER = (
     "fed_round_s", "fed_round_32_s", "secure_round_s",
@@ -1831,6 +2069,8 @@ LOWER_IS_BETTER = (
     "profile_armed_overhead_pct",
     "flash_fwd_bwd_ms", "model_step_ms",
     "zigzag_zigzag_ms", "ring_fwd_pallas_ms",
+    "fed_scale_round_s", "fed_scale_peak_growth_mb",
+    "fed_async_wall_to_loss_s",
 )
 
 
@@ -1950,6 +2190,7 @@ def main() -> None:
     ring.update(bench_tracer_overhead(on_accelerator))
     ring.update(bench_profile_overhead(on_accelerator))
     ring.update(bench_federated_robustness(on_accelerator))
+    ring.update(bench_federated_scale(on_accelerator))
     if on_accelerator:
         # second headline sample, minutes after the first (the shared
         # chip's load drifts on that timescale; back-to-back windows

@@ -168,6 +168,55 @@ def _parse(argv):
                     help="divergence detector: a round whose train loss "
                          "exceeds this multiple of the last good "
                          "round's is rolled back (0 disables)")
+    sp.add_argument("--population", type=int, default=0,
+                    help="population mode: train over N VIRTUAL clients "
+                         "(federated/population.py) whose shards derive "
+                         "lazily from (seed, id) — memory is bounded by "
+                         "the cohort, not N. 0 = classic materialized "
+                         "mode. Skips the pretrain phase; --faults then "
+                         "takes the population grammar "
+                         "(kind:rounds[:param][@c<id>,...], fractions "
+                         "like crash:2:0.1%)")
+    sp.add_argument("--cohort", type=int, default=32,
+                    help="clients sampled per round in population mode "
+                         "(deterministic per (seed, round))")
+    sp.add_argument("--cohort-wave", type=int, default=0,
+                    help="streamed-aggregation wave size (must divide "
+                         "the cohort; 0 = one wave per cohort). Server "
+                         "memory is O(wave), constant in population "
+                         "and cohort size")
+    sp.add_argument("--weighted-sampling", action="store_true",
+                    help="sample cohorts proportional to each virtual "
+                         "client's (seeded) dataset-size weight instead "
+                         "of uniformly")
+    sp.add_argument("--client-examples", type=int, default=16,
+                    help="examples per virtual client shard in "
+                         "population mode")
+    sp.add_argument("--async-buffer", type=int, default=0,
+                    help="population mode: buffered-async FedAvg "
+                         "(FedBuff) — client completions fill a buffer "
+                         "of this size, each full buffer triggers one "
+                         "staleness-weighted server update instead of "
+                         "a round barrier. 0 = synchronous streamed "
+                         "rounds")
+    sp.add_argument("--staleness-decay", type=float, default=0.9,
+                    help="async mode: per-version weight discount for "
+                         "stale updates (weight x decay^staleness), in "
+                         "(0, 1]; 1 = no discount")
+    sp.add_argument("--model", default=None,
+                    choices=("vgg16", "mobilenet_v2", "densenet201",
+                             "small_cnn"),
+                    help="population mode: override the preset model "
+                         "(small_cnn = CPU-scale population drills; "
+                         "classic mode keeps the preset's backbone)")
+    sp.add_argument("--fault-delay-ms", type=float, default=0.0,
+                    help="population mode: wall-clock delay per "
+                         "straggler staleness unit (lag k completes "
+                         "k x this late) — arms the sync round "
+                         "BARRIER sleep and the async arrival lag, "
+                         "so straggler drills are wall-clock-real; "
+                         "0 = stale-params-only stragglers (sync) / "
+                         "inert stragglers (async)")
 
     sp = sub.add_parser("secure-fed", aliases=["secure_fed"],
                         help="secure-aggregation FedAvg")
@@ -186,6 +235,12 @@ def _parse(argv):
                          "hash-PRG kernel, or auto (pallas on TPU above "
                          "the measured crossover — see the threat-model "
                          "note in secure.make_secure_fedavg_round)")
+    sp.add_argument("--async-buffer", type=int, default=0,
+                    help="rejected: buffered-async aggregation cannot "
+                         "compose with the pairwise-mask protocol (the "
+                         "build explains why) — exists so the drill "
+                         "teaches instead of silently ignoring the "
+                         "flag")
 
     sp = sub.add_parser("attention",
                         help="sequence-parallel transformer classifier "
@@ -2004,6 +2059,238 @@ def _run_serve_cluster(ns):
     _finish_logger(logger)
 
 
+def _run_fed_population(ns):
+    """Population-scale federated mode: virtual clients + cohort
+    sampling + streamed (or async buffered) aggregation — ROADMAP
+    item 4's millions-of-users story at the CLI surface."""
+    import jax
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.configs import get_preset
+    from idc_models_tpu import faults as faults_lib
+    from idc_models_tpu.federated import (
+        ClientPopulation, CohortSampler, DriverConfig, RoundFailure,
+        initialize_server, make_async_round, make_federated_eval,
+        make_population_round, run_rounds,
+    )
+    from idc_models_tpu.federated import robust
+    from idc_models_tpu.models import registry
+    from idc_models_tpu.observe import Timer, profile_trace
+    from idc_models_tpu.train import rmsprop
+
+    preset = _apply_overrides(
+        get_preset("fed"), ns, ["batch_size", "lr", "rounds",
+                                "local_epochs"])
+    n_pop = int(ns.population)
+    cohort = int(ns.cohort)
+    if cohort < 1:
+        sys.exit(f"--cohort must be >= 1, got {cohort}")
+    if cohort > n_pop:
+        sys.exit(f"--cohort {cohort} exceeds --population {n_pop}: a "
+                 f"round cannot sample more clients than the "
+                 f"population holds")
+    wave = int(ns.cohort_wave) or cohort
+    use_async = int(ns.async_buffer) != 0
+    if use_async and ns.async_buffer < 0:
+        sys.exit(f"--async-buffer must be >= 1 (0 disables async "
+                 f"mode), got {ns.async_buffer}")
+    if use_async and int(ns.cohort_wave):
+        sys.exit("--cohort-wave only applies to synchronous streamed "
+                 "rounds; the async server buffers by --async-buffer "
+                 "instead (drop one of the two flags)")
+    decay = float(ns.staleness_decay)
+    if not 0.0 < decay <= 1.0:
+        sys.exit(f"--staleness-decay must be in (0, 1], got {decay} "
+                 f"(1 = no discount; smaller discounts staler "
+                 f"updates harder)")
+    n_dev = len(jax.devices())
+    mesh = meshlib.client_mesh(meshlib.largest_dividing_mesh(wave,
+                                                             n_dev))
+    model_name = getattr(ns, "model", None) or preset.model
+    image_size = 10 if model_name == "small_cnn" else preset.image_size
+    s = int(ns.client_examples)
+    if s < 1:
+        sys.exit(f"--client-examples must be >= 1, got {s} (each "
+                 f"virtual client's shard size)")
+    weight_range = (0.5 * s, 1.5 * s) if ns.weighted_sampling else \
+        (float(s), float(s))
+    population = ClientPopulation(
+        n_pop, examples_per_client=s, image_size=image_size,
+        seed=ns.seed, weight_range=weight_range)
+    sampler = CohortSampler(population, cohort, seed=ns.seed,
+                            weighted=ns.weighted_sampling)
+    logger = _logger(ns)
+    delay_ms = float(getattr(ns, "fault_delay_ms", 0.0))
+    if delay_ms < 0:
+        sys.exit(f"--fault-delay-ms must be >= 0, got {delay_ms}")
+    plan = None
+    if getattr(ns, "faults", None):
+        try:
+            plan = faults_lib.parse_population_fault_spec(
+                ns.faults, n_pop, seed=ns.seed,
+                delay_unit_s=delay_ms / 1000.0)
+        except ValueError as e:
+            sys.exit(str(e))
+        print(f"[idc_models_tpu] injecting faults: {plan}",
+              file=sys.stderr)
+        if (use_async and delay_ms == 0.0
+                and plan.max_staleness > 0):
+            # without a wall delay a straggler never arrives late, and
+            # async staleness IS lateness — say so instead of letting
+            # the drill silently run fault-free
+            print("[idc_models_tpu] straggler faults are INERT in "
+                  "async mode without --fault-delay-ms: buffered "
+                  "staleness comes from late arrival, and the plan's "
+                  "stragglers arrive on time", file=sys.stderr)
+
+    spec = registry.get_model(model_name)
+    model = spec.build(preset.num_outputs, 3)
+    loss_fn = _loss_for(preset.num_outputs)
+    opt = rmsprop(preset.lr / 10.0)
+    server = initialize_server(model, jax.random.key(ns.seed))
+    server_ckpt = Path(ns.path) / "fed_server" if ns.path else None
+    resumed = False
+    from idc_models_tpu.train import checkpoint_exists, restore_checkpoint
+
+    if server_ckpt is not None and checkpoint_exists(server_ckpt):
+        server = restore_checkpoint(server_ckpt, jax.device_get(server))
+        print(f"resuming federated training from round "
+              f"{int(server.round)}")
+        resumed = int(server.round) > 0
+    if not use_async:
+        # the streamed wave program wants the server replicated over
+        # the client mesh; the async server is host-driven and keeps
+        # default placement
+        server = jax.device_put(server, meshlib.replicated(mesh))
+    # separate resume high-water marks per event: fed_cohort is written
+    # INSIDE round_fn while the `round` record lands after eval, so a
+    # crash in between leaves them unequal — one shared max would
+    # suppress the missing record's re-log forever
+    logged_through = -1          # `round` records (and round_health)
+    cohort_through = -1          # fed_cohort records (builder-owned)
+    if resumed and logger is not None and logger.path.exists():
+        import json as _json
+
+        for line in logger.path.read_text().splitlines():
+            try:
+                rec = _json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("event") == "round":
+                logged_through = max(logged_through, int(rec["round"]))
+            elif rec.get("event") == "fed_cohort":
+                cohort_through = max(cohort_through, int(rec["round"]))
+
+    agg_name = getattr(ns, "aggregator", "mean")
+    agg_kw = ({"trim": ns.trim} if agg_name == "trimmed_mean" else
+              {"max_norm": ns.clip_norm} if agg_name == "norm_clip"
+              else {})
+    try:
+        agg = robust.get_aggregator(agg_name, **agg_kw)
+        if use_async:
+            round_fn = make_async_round(
+                model, opt, loss_fn, population, sampler,
+                buffer_size=int(ns.async_buffer),
+                staleness_decay=decay,
+                local_epochs=preset.local_epochs,
+                batch_size=preset.batch_size, aggregator=agg,
+                faults=plan, seed=ns.seed, logger=logger,
+                log_from_round=cohort_through)
+            participant_ids_fn = lambda r: round_fn.last_participants
+        else:
+            round_fn = make_population_round(
+                model, opt, loss_fn, mesh, population, sampler,
+                wave_size=wave, local_epochs=preset.local_epochs,
+                batch_size=preset.batch_size, aggregator=agg,
+                faults=plan, barrier_sleep=delay_ms > 0,
+                logger=logger, log_from_round=cohort_through)
+            participant_ids_fn = lambda r: sampler.cohort(r)
+    except ValueError as e:
+        sys.exit(str(e))
+
+    # held-out eval cohort: a fixed seeded draw, materialized once —
+    # O(wave) like everything else in this mode
+    eval_sampler = CohortSampler(population, wave, seed=ns.seed + 4242)
+    eval_imgs, eval_labels, eval_w = population.materialize(
+        eval_sampler.cohort(0))
+    cshard = meshlib.sharding(mesh, meshlib.CLIENT_AXIS)
+    eval_imgs = jax.device_put(eval_imgs, cshard)
+    eval_labels = jax.device_put(eval_labels, cshard)
+    eval_fn = make_federated_eval(model, loss_fn, mesh)
+
+    def eval_round(sv):
+        em = _fetch_scalars(eval_fn(sv, eval_imgs, eval_labels, eval_w))
+        return {"test_loss": float(em["loss"]),
+                "test_acc": float(em["accuracy"])}
+
+    print("round, train_loss, train_acc, test_loss, test_acc")
+    totals = {"updates": 0, "staleness_sum": 0.0, "participants": 0}
+
+    def print_round(entry):
+        print(f"{entry['round']}, {entry['loss']:.4f}, "
+              f"{entry['accuracy']:.4f}, {entry['test_loss']:.4f}, "
+              f"{entry['test_acc']:.4f}")
+        totals["updates"] += int(entry.get("updates", 0))
+        totals["staleness_sum"] += (float(entry.get("staleness_mean",
+                                                    0.0))
+                                    * int(entry.get("participants", 0)))
+        totals["participants"] += int(entry.get("participants", 0))
+        if logger and entry["round"] > logged_through:
+            logger.log(event="round", round=entry["round"],
+                       train_loss=entry["loss"],
+                       train_acc=entry["accuracy"],
+                       test_loss=entry["test_loss"],
+                       test_acc=entry["test_acc"],
+                       clients_dropped=int(
+                           entry.get("clients_dropped", 0)))
+
+    spike = getattr(ns, "loss_spike_ratio", 10.0)
+    if spike is not None and spike != 0 and spike <= 1:
+        sys.exit(f"--loss-spike-ratio {spike} must be > 1 (0 disables "
+                 f"the detector)")
+    config = DriverConfig(
+        rounds=preset.rounds,
+        timeout_s=getattr(ns, "round_timeout", None),
+        max_attempts=1 + max(int(getattr(ns, "max_round_retries", 2)),
+                             0),
+        loss_spike_ratio=spike if spike and spike > 1 else None,
+        checkpoint_path=server_ckpt,
+        checkpoint_every=max(int(getattr(ns, "checkpoint_every", 10)),
+                             1))
+    try:
+        with Timer("Federated training", logger=logger), \
+                profile_trace(ns.profile_dir):
+            result = run_rounds(
+                round_fn, server, None, None,
+                np.ones((cohort,), np.float32), config=config,
+                seed=ns.seed + 1, eval_fn=eval_round,
+                on_round=print_round, logger=logger, verbose=True,
+                log_from_round=logged_through,
+                log_round_records=False, fault_plan=plan,
+                participant_ids_fn=participant_ids_fn)
+    except RoundFailure as e:
+        sys.exit(f"[idc_models_tpu] federated training aborted: {e}")
+    mode = "weighted" if ns.weighted_sampling else "uniform"
+    decomp = (f" in {cohort // wave} wave(s) of {wave}; memory "
+              f"bounded by the wave, not the population" if not
+              use_async else "; memory bounded by the in-flight pool, "
+              "not the population")
+    print(f"population: {n_pop} virtual clients, cohort {cohort} "
+          f"({mode}){decomp}")
+    if use_async:
+        mean_st = (totals["staleness_sum"] / totals["participants"]
+                   if totals["participants"] else 0.0)
+        print(f"async buffer: K={int(ns.async_buffer)}, staleness "
+              f"decay {decay}, {totals['updates']} buffered update(s),"
+              f" mean staleness {mean_st:.2f}")
+    retried = [e for e in result.events if e["status"] != "ok"]
+    if retried:
+        print(f"[idc_models_tpu] {len(retried)} round attempt(s) "
+              f"failed and were healed (rollback/reseed); see "
+              f"round_health events", file=sys.stderr)
+    _finish_logger(logger)
+
+
 def _run_fed(ns):
     import jax
 
@@ -2024,6 +2311,8 @@ def _run_fed(ns):
         rmsprop, save_checkpoint, two_phase_fit,
     )
 
+    if getattr(ns, "population", 0):
+        return _run_fed_population(ns)
     preset = _apply_overrides(
         get_preset("fed"), ns,
         ["batch_size", "lr", "rounds", "iid", "num_clients", "local_epochs",
@@ -2226,6 +2515,15 @@ def _run_secure(ns):
     from idc_models_tpu.federated import initialize_server
     from idc_models_tpu.secure import make_secure_fedavg_round
 
+    if getattr(ns, "async_buffer", 0):
+        # rejected at BUILD, with the protocol reason — not silently
+        # ignored, not a bare argparse error
+        from idc_models_tpu.federated import ensure_async_compatible
+
+        try:
+            ensure_async_compatible(secure=True)
+        except ValueError as e:
+            sys.exit(str(e))
     preset = _apply_overrides(
         get_preset("secure_fed"), ns,
         ["batch_size", "lr", "rounds", "percent", "num_clients",

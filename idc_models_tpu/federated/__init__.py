@@ -19,3 +19,12 @@ from idc_models_tpu.federated.driver import (  # noqa: F401
     RoundFailure,
     run_rounds,
 )
+from idc_models_tpu.federated.population import (  # noqa: F401
+    ClientPopulation,
+    CohortSampler,
+    make_population_round,
+)
+from idc_models_tpu.federated.async_fedavg import (  # noqa: F401
+    ensure_async_compatible,
+    make_async_round,
+)

@@ -122,7 +122,7 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
                on_round=None, logger=None, clock=time.monotonic,
                verbose: bool = False, log_from_round: int = -1,
                log_round_records: bool = True, fault_plan=None,
-               slo=None) -> DriverResult:
+               slo=None, participant_ids_fn=None) -> DriverResult:
     """Run `config.rounds` federated rounds with self-healing.
 
     `round_fn` is a `make_fedavg_round` product (or anything with the
@@ -150,6 +150,15 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
     attempt status != ok) for whichever of the two it declares, with a
     burn-rate evaluation after every attempt — `slo_alert` jsonl events
     go through the engine's own logger.
+
+    `participant_ids_fn(round_idx) -> ids` overrides which client ids
+    the ``fed.client`` markers name: population-scale rounds
+    (federated/population.py, async_fedavg.py) participate by VIRTUAL
+    client id, not by position in a materialized weight vector — the
+    hook is called after the attempt completes, so an async round can
+    report the completions it actually processed. A fault plan exposing
+    ``codes_for(round, ids)`` (faults.PopulationFaultPlan) is queried
+    per-id; the materialized-plan ``codes(round)`` path is unchanged.
     Returns the last good server state + per-round history + per-attempt
     health events; raises `RoundFailure` when a round exhausts its
     attempts (the last good state is the exception's `.server`).
@@ -274,8 +283,10 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
                 att_span.set(status=status,
                              participants=record["participants"])
                 if trace.get_tracer() is not None:
+                    ids = (participant_ids_fn(r)
+                           if participant_ids_fn is not None else None)
                     _client_spans(att_span, w_host, r, attempt,
-                                  fault_plan)
+                                  fault_plan, ids=ids)
             m_attempts.inc(status=status)
             m_seconds.observe(elapsed)
             health(record)
@@ -325,27 +336,47 @@ def run_rounds(round_fn, server: ServerState, images, labels, weights, *,
 
 
 def _client_spans(att_span, weights, round_idx: int, attempt: int,
-                  fault_plan) -> None:
+                  fault_plan, ids=None) -> None:
     """One `fed.client` marker span per participating client, nested
     under the attempt's fed.round span, carrying the client's fault
     outcome for the round (from the plan's pure (plan, round) function
     — the same codes the jitted round program branched on). Markers,
     not timings: the clients execute fused inside one dispatch.
-    `weights` is the attempt's already host-fetched array."""
+    `weights` is the attempt's already host-fetched array; `ids`, when
+    given, are VIRTUAL client ids from a population-scale round (the
+    weight attr is then omitted — the positional weight vector does
+    not describe them)."""
     from idc_models_tpu import faults as faults_lib
 
     w = np.asarray(weights)
+    by_position = ids is None
+    ids = np.flatnonzero(w > 0) if by_position else np.asarray(ids)
+    if not by_position and len(ids) == len(w):
+        # sync population rounds: `ids` are the cohort's virtual ids,
+        # position-aligned with the [cohort] participation mask the
+        # driver's reseeded retry zeroes — a masked-out client did not
+        # participate in this attempt and gets no marker
+        ids = ids[w > 0]
     codes = scales = None
     if fault_plan is not None:
-        codes, scales = fault_plan.codes(round_idx)
-    for cid in np.flatnonzero(w > 0):
-        attrs = {"round": round_idx, "attempt": attempt,
-                 "client": int(cid), "weight": float(w[cid])}
-        if codes is not None and cid < len(codes):
-            code = int(codes[cid])
+        if hasattr(fault_plan, "codes_for"):
+            codes, scales = fault_plan.codes_for(round_idx, ids)
+        else:
+            codes, scales = fault_plan.codes(round_idx)
+    for i, cid in enumerate(ids):
+        cid = int(cid)
+        attrs = {"round": round_idx, "attempt": attempt, "client": cid}
+        if by_position:
+            attrs["weight"] = float(w[cid])
+        # population plans align codes to the ids array; materialized
+        # plans index by client position
+        ci = i if (fault_plan is not None
+                   and hasattr(fault_plan, "codes_for")) else cid
+        if codes is not None and ci < len(codes):
+            code = int(codes[ci])
             attrs["fault"] = faults_lib.kind_of(code)
             if code in (faults_lib.SCALE, faults_lib.SIGN_FLIP):
-                attrs["fault_scale"] = float(scales[cid])
+                attrs["fault_scale"] = float(scales[ci])
             elif code == faults_lib.STRAGGLER:
                 attrs["staleness"] = fault_plan.staleness(round_idx)
         trace.point("fed.client", parent=att_span.span_id, **attrs)

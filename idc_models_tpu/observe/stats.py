@@ -54,6 +54,7 @@ def summarize_jsonl(path) -> dict:
     spans: dict[str, list[float]] = {}
     programs: list[dict] = []
     profile_steps: list[dict] = []
+    fed_cohorts: list[dict] = []
     last_snapshot = None
     ts = [r["ts"] for r in records
           if isinstance(r.get("ts"), (int, float))]
@@ -83,6 +84,9 @@ def summarize_jsonl(path) -> dict:
         if event == "profile_step":
             profile_steps.append({k: v for k, v in r.items()
                                   if k not in ("ts", "event")})
+        if event == "fed_cohort":
+            fed_cohorts.append({k: v for k, v in r.items()
+                                if k not in ("ts", "event")})
     events = {
         ev: {"count": slot["count"],
              "fields": {k: _num_stats(vs)
@@ -102,6 +106,7 @@ def summarize_jsonl(path) -> dict:
         "span_self": _span_self_times(records),
         "programs": programs,
         "profile_steps": profile_steps,
+        "fed_cohorts": fed_cohorts,
         "metrics": last_snapshot,
         "requests": _request_timelines(records),
     }
@@ -250,6 +255,26 @@ def format_summary(s: dict, *, top: int = 15) -> str:
                 f"{rec['device_busy_fraction']:.1%} / host-gap "
                 f"{rec['host_gap_fraction']:.1%} "
                 f"(mean {rec['step_ms_mean']} ms/step)")
+    if s.get("fed_cohorts"):
+        out.append("")
+        out.append("fed cohorts (per round):")
+        for rec in s["fed_cohorts"]:
+            mode = rec.get("mode", "sync")
+            line = (f"  round {rec.get('round'):>4} [{mode:5s}] "
+                    f"cohort={rec.get('cohort')} of "
+                    f"{rec.get('population')} "
+                    f"participants={rec.get('participants')}")
+            if mode == "async":
+                hist = rec.get("staleness_hist") or []
+                line += (f" buffer={rec.get('buffer')} "
+                         f"updates={rec.get('updates')} staleness "
+                         f"mean={rec.get('staleness_mean')} "
+                         f"max={rec.get('staleness_max')} "
+                         f"hist={hist}")
+            else:
+                line += (f" waves={rec.get('waves')}"
+                         f"x{rec.get('wave_size')}")
+            out.append(line)
     if s.get("requests"):
         out.append("")
         out.append(f"requests: {len(s['requests'])} with per-request "

@@ -220,6 +220,90 @@ def test_cli_fed_checkpoint_gate_and_resume(tmp_path, capsys):
     assert sorted(rounds) == [0, 1, 2]
 
 
+def test_cli_fed_population_sync_and_resume(tmp_path, capsys):
+    """Population mode through the product surface: virtual clients,
+    cohort sampling, streamed waves, the population epilogue line, the
+    fed_cohort jsonl events, and checkpoint/resume regenerating later
+    cohorts in a REAL second run (the cross-process half of the
+    sampler-determinism satellite)."""
+    import json
+
+    args = ["fed", "--population", "64", "--cohort", "8",
+            "--cohort-wave", "4", "--rounds", "2", "--batch-size", "8",
+            "--client-examples", "8", "--local-epochs", "1",
+            "--model", "small_cnn", "--path", str(tmp_path)]
+    first = _run(args, capsys)
+    assert "round, train_loss, train_acc, test_loss, test_acc" in first
+    assert ("population: 64 virtual clients, cohort 8 (uniform) in "
+            "2 wave(s) of 4") in first
+    second = _run(args + ["--rounds", "3"], capsys)  # last flag wins
+    assert "resuming federated training from round 2" in second
+    assert "\n2, " in second and "\n1, " not in second
+    recs = [json.loads(line) for line in
+            (tmp_path / "logs" / "run.jsonl").read_text().splitlines()]
+    cohorts = [r for r in recs if r.get("event") == "fed_cohort"]
+    assert [r["round"] for r in cohorts] == [0, 1, 2]
+    assert all(r["mode"] == "sync" and r["population"] == 64
+               and r["waves"] == 2 for r in cohorts)
+    rounds = [r["round"] for r in recs if r.get("event") == "round"]
+    assert sorted(rounds) == [0, 1, 2]       # resume never double-logs
+
+
+def test_cli_fed_population_async(tmp_path, capsys):
+    out = _run(["fed", "--population", "64", "--cohort", "8",
+                "--rounds", "2", "--batch-size", "8",
+                "--client-examples", "8", "--local-epochs", "1",
+                "--model", "small_cnn", "--async-buffer", "4",
+                "--staleness-decay", "0.8",
+                "--faults", "crash:*:10%",
+                "--path", str(tmp_path)], capsys)
+    assert "async buffer: K=4, staleness decay 0.8" in out
+    assert "buffered update(s)" in out
+    import json
+
+    recs = [json.loads(line) for line in
+            (tmp_path / "logs" / "run.jsonl").read_text().splitlines()]
+    cohorts = [r for r in recs if r.get("event") == "fed_cohort"]
+    assert cohorts and all(r["mode"] == "async" and r["buffer"] == 4
+                           for r in cohorts)
+    assert all(len(r["staleness_hist"]) == 6 for r in cohorts)
+
+
+def test_cli_fed_population_usage_errors(capsys):
+    """ISSUE-13 satellite: every bad population knob dies as a TEACHING
+    usage error, never a traceback — cohort > population, non-positive
+    async buffer, staleness decay out of range, non-dividing wave, a
+    bad population fault spec, and secure x async rejected at build."""
+    base = ["fed", "--host-devices", "2", "--model", "small_cnn"]
+    with pytest.raises(SystemExit, match="exceeds --population"):
+        cli.main(base + ["--population", "10", "--cohort", "20"])
+    with pytest.raises(SystemExit, match="--async-buffer must be"):
+        cli.main(base + ["--population", "10", "--cohort", "5",
+                         "--async-buffer", "-2"])
+    with pytest.raises(SystemExit, match="--staleness-decay must be"):
+        cli.main(base + ["--population", "10", "--cohort", "5",
+                         "--staleness-decay", "1.5"])
+    with pytest.raises(SystemExit, match="--client-examples must be"):
+        cli.main(base + ["--population", "10", "--cohort", "5",
+                         "--client-examples", "0"])
+    with pytest.raises(SystemExit, match="--cohort-wave only applies"):
+        cli.main(base + ["--population", "10", "--cohort", "4",
+                         "--cohort-wave", "2", "--async-buffer", "2"])
+    with pytest.raises(SystemExit, match="--fault-delay-ms must be"):
+        cli.main(base + ["--population", "10", "--cohort", "4",
+                         "--fault-delay-ms", "-5"])
+    with pytest.raises(SystemExit, match="must divide the cohort"):
+        cli.main(base + ["--population", "10", "--cohort", "6",
+                         "--cohort-wave", "4"])
+    with pytest.raises(SystemExit) as ei:
+        cli.main(base + ["--population", "10", "--cohort", "4",
+                         "--faults", "meteor:1:5%"])
+    assert "grammar" in str(ei.value)        # the teaching message
+    with pytest.raises(SystemExit, match="secure aggregation"):
+        cli.main(["secure-fed", "--host-devices", "2",
+                  "--async-buffer", "4"])
+
+
 def test_cli_secure_fed_masked(capsys):
     out = _run(["secure-fed", "--host-devices", "8",
                 "--synthetic-examples", "256", "--batch-size", "8",

@@ -91,6 +91,77 @@ def test_plan_codes_and_spec_parse():
     assert [f.client for f in a.faults] == [f.client for f in b.faults]
 
 
+def test_population_fault_spec_addresses_virtual_ids():
+    """ISSUE-13 satellite: the population grammar addresses the VIRTUAL
+    population — explicit c-prefixed ids (comma-joined inside one
+    group), round ranges, kind params, and seeded fractions — with the
+    PR 8 teaching-error treatment on every failure mode."""
+    plan = faults.parse_population_fault_spec(
+        "straggler:3-6:2@c97,c4012", 10000, delay_unit_s=0.25)
+    f = plan.faults[0]
+    assert (f.kind, f.rounds, f.clients, f.staleness) == \
+        ("straggler", (3, 4, 5, 6), (97, 4012), 2)
+    ids = np.array([5, 97, 4012, 9000])
+    codes, _ = plan.codes_for(4, ids)
+    assert codes.tolist() == [0, faults.STRAGGLER, faults.STRAGGLER, 0]
+    assert plan.codes_for(7, ids)[0].tolist() == [0, 0, 0, 0]
+    # the staleness lag doubles as the wall delay (k * delay_unit_s)
+    np.testing.assert_allclose(plan.delay_s(4, ids),
+                               [0.0, 0.5, 0.5, 0.0])
+    assert plan.delay_s(7, ids).tolist() == [0.0] * 4
+
+    # fraction-based selection: stable per client across rounds,
+    # deterministic per plan seed, roughly the asked-for rate
+    frac = faults.parse_population_fault_spec("crash:2:10%", 1000,
+                                              seed=4)
+    all_ids = np.arange(1000)
+    c2, _ = frac.codes_for(2, all_ids)
+    hit = c2 == faults.CRASH
+    assert 50 <= hit.sum() <= 150
+    np.testing.assert_array_equal(
+        c2, faults.parse_population_fault_spec("crash:2:10%", 1000,
+                                               seed=4).codes_for(
+            2, all_ids)[0])
+    assert (frac.codes_for(0, all_ids)[0] == 0).all()   # round-scoped
+    # two fraction faults in one plan select INDEPENDENTLY: with a
+    # shared uniform the 10% crash set would be a strict subset of the
+    # 20% straggler set and last-listed-wins would erase every crash
+    both = faults.parse_population_fault_spec(
+        "crash:*:10%,straggler:*:20%", 1000, seed=4)
+    cb, _ = both.codes_for(0, all_ids)
+    assert (cb == faults.CRASH).sum() > 40
+    assert (cb == faults.STRAGGLER).sum() > 100
+
+    # '*' = every round; scale param with @clients
+    allr = faults.parse_population_fault_spec("sign_flip:*:x1000@c5",
+                                              100)
+    codes, scales = allr.codes_for(17, np.array([5, 6]))
+    assert codes.tolist() == [faults.SIGN_FLIP, 0]
+    assert scales[0] == 1000.0
+
+    # teaching errors: every failure names the group, the grammar, and
+    # the valid kinds; out-of-range ids are loud
+    for bad in ("meteor:2:5%", "crash:2:0.5", "crash:2", "crash:one:5%",
+                "crash:2:200%", "straggler:1:2@d4", "sign_flip:1:x3",
+                "crash:2:5%@c1"):
+        with pytest.raises(ValueError) as ei:
+            faults.parse_population_fault_spec(bad, 100)
+        msg = str(ei.value)
+        assert "grammar" in msg, (bad, msg)
+        for kind in faults.KINDS:
+            assert kind in msg, (bad, kind, msg)
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.parse_population_fault_spec("meteor:2:5%", 100)
+    with pytest.raises(ValueError, match="population has 100"):
+        faults.parse_population_fault_spec("crash:1@c150", 100)
+    with pytest.raises(ValueError, match="single staleness"):
+        faults.PopulationFaultPlan(10, [
+            faults.PopulationFault("straggler", clients=(1,),
+                                   staleness=1),
+            faults.PopulationFault("straggler", clients=(2,),
+                                   staleness=3)])
+
+
 def test_crash_equals_manual_weight_zero(devices):
     """A crash fault is indistinguishable from the caller zeroing the
     client's weight: same aggregate, bit for bit."""

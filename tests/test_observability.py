@@ -440,6 +440,90 @@ def test_fed_driver_round_health_schema_unchanged(tmp_path):
     assert {"round", "attempts", "loss", "accuracy"} <= set(rounds[0])
 
 
+def test_fed_cohort_jsonl_schema_frozen(tmp_path):
+    """ISSUE-13 satellite: the NEW `fed_cohort` event's key sets (sync
+    and async shapes) are frozen from day one; the historical fed
+    events (`round`, `round_health`) stay byte-identical — gated by
+    test_fed_driver_round_health_schema_unchanged above and re-checked
+    here against a population-mode run log."""
+    import jax
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.federated import (
+        ClientPopulation, CohortSampler, initialize_server,
+        make_async_round, make_population_round,
+    )
+    from idc_models_tpu.models import small_cnn
+    from idc_models_tpu.train import rmsprop
+    from idc_models_tpu.train.losses import binary_cross_entropy
+
+    pop = ClientPopulation(32, examples_per_client=8, image_size=10,
+                           seed=0)
+    model = small_cnn(10, 3, 1)
+    log = tmp_path / "run.jsonl"
+    with JsonlLogger(log) as logger:
+        sync = make_population_round(
+            model, rmsprop(1e-3), binary_cross_entropy,
+            meshlib.client_mesh(1), pop, CohortSampler(pop, 4, seed=1),
+            wave_size=2, local_epochs=1, batch_size=8, logger=logger)
+        srv = initialize_server(model, jax.random.key(0))
+        sync(srv, None, None, None, jax.random.key(1), round_idx=0)
+        a = make_async_round(
+            model, rmsprop(1e-3), binary_cross_entropy, pop,
+            CohortSampler(pop, 4, seed=1), buffer_size=2,
+            local_epochs=1, batch_size=8, seed=2, logger=logger)
+        srv = initialize_server(model, jax.random.key(0))
+        a(srv, None, None, None, None, round_idx=0)
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    cohorts = [r for r in recs if r["event"] == "fed_cohort"]
+    assert len(cohorts) == 2
+    sync_rec = next(r for r in cohorts if r["mode"] == "sync")
+    async_rec = next(r for r in cohorts if r["mode"] == "async")
+    # FROZEN key sets — extending is a new event, not a reshaped one
+    assert set(sync_rec) == {"ts", "event", "round", "mode",
+                             "population", "cohort", "participants",
+                             "waves", "wave_size"}
+    assert set(async_rec) == {"ts", "event", "round", "mode",
+                              "population", "cohort", "participants",
+                              "buffer", "updates", "staleness_mean",
+                              "staleness_max", "staleness_hist"}
+    assert async_rec["staleness_hist"] == list(async_rec[
+        "staleness_hist"])
+    assert len(async_rec["staleness_hist"]) == 6
+    assert sum(async_rec["staleness_hist"]) == \
+        async_rec["participants"]
+
+
+def test_stats_fed_cohorts_section(tmp_path):
+    """`stats` renders the per-round cohort/buffer/staleness story from
+    fed_cohort events — the ISSUE-13 'fed cohorts' section."""
+    from idc_models_tpu.observe.stats import format_summary
+
+    log = tmp_path / "run.jsonl"
+    recs = [
+        {"ts": 1.0, "event": "fed_cohort", "round": 0, "mode": "sync",
+         "population": 10000, "cohort": 256, "participants": 256,
+         "waves": 8, "wave_size": 32},
+        {"ts": 2.0, "event": "fed_cohort", "round": 1, "mode": "async",
+         "population": 10000, "cohort": 256, "participants": 256,
+         "buffer": 8, "updates": 32, "staleness_mean": 1.25,
+         "staleness_max": 4,
+         "staleness_hist": [100, 80, 40, 20, 10, 6]},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    s = summarize_jsonl(log)
+    assert len(s["fed_cohorts"]) == 2
+    assert s["fed_cohorts"][0]["mode"] == "sync"
+    assert s["fed_cohorts"][1]["staleness_hist"] == \
+        [100, 80, 40, 20, 10, 6]
+    text = format_summary(s)
+    assert "fed cohorts (per round)" in text
+    assert "cohort=256 of 10000" in text
+    assert "waves=8x32" in text
+    assert "buffer=8 updates=32" in text
+    assert "[100, 80, 40, 20, 10, 6]" in text
+
+
 def test_stats_request_timeline_from_events_and_spans(tmp_path):
     """ISSUE-7 satellite: `summarize_jsonl` groups serve_* events AND
     rid-stamped span records into per-request timelines; the --request

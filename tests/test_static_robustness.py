@@ -528,6 +528,97 @@ def test_no_tmax_sized_kv_allocations_in_serve():
         f"t_max KV allowlist entries match no code: {stale}")
 
 
+# -- ISSUE 13: no O(population)-sized allocations in the population
+# federated layer ------------------------------------------------------
+#
+# federated/population.py exists so a 10k+ virtual-client population
+# trains in memory bounded by the cohort/wave; ONE population-shaped
+# numpy allocation (or a list comprehension over the population range)
+# silently re-materializes what the lazy design removed. The scan
+# flags allocation calls (zeros/ones/full/empty/arange) and list/set/
+# dict comprehensions whose arguments mention the population count —
+# the names `n_population`/`population_size`, or `.size` read off
+# `self`/`population`/`pop`/`.population`.
+
+_POP_ALLOC_CALLS = {"zeros", "ones", "full", "empty", "arange"}
+_POP_COUNT_NAMES = {"n_population", "population_size"}
+_POP_OWNER_NAMES = {"self", "population", "pop"}
+
+# (path relative to the repo root, dotted enclosing-function path) ->
+# why an O(population) allocation is correct there
+POPULATION_ALLOC_ALLOWLIST = {
+    # key = the shared _enclosing_path (function names only; the
+    # method lives on ClientPopulation)
+    ("idc_models_tpu/federated/population.py", "all_weights"):
+        "the one deliberately O(population) helper: materializes the "
+        "weight vector for validating the weighted sampler's "
+        "distribution on SMALL test populations — documented as never "
+        "on the training path",
+}
+
+
+def _mentions_population_count(node) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _POP_COUNT_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "size":
+            v = sub.value
+            if isinstance(v, ast.Name) and v.id in _POP_OWNER_NAMES:
+                return True
+            if isinstance(v, ast.Attribute) and v.attr == "population":
+                return True
+    return False
+
+
+def _scan_population_allocs(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(REPO)).replace("\\", "/")
+    violations, live = [], set()
+
+    def flag(node, stack, what):
+        key = (rel, _enclosing_path(stack))
+        live.add(key)
+        if key not in POPULATION_ALLOC_ALLOWLIST:
+            violations.append((rel, node.lineno, what, key[1]))
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _POP_ALLOC_CALLS
+                    and any(_mentions_population_count(a)
+                            for a in list(child.args)
+                            + [kw.value for kw in child.keywords])):
+                flag(child, stack, child.func.attr)
+            if (isinstance(child, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp))
+                    and any(_mentions_population_count(g.iter)
+                            for g in child.generators)):
+                flag(child, stack, "comprehension")
+            walk(child, stack + [child])
+
+    walk(tree, [])
+    return violations, live
+
+
+def test_no_population_sized_allocations_in_population_layer():
+    violations, live = [], set()
+    for name in ("population.py", "async_fedavg.py"):
+        v, l = _scan_population_allocs(
+            PACKAGE / "federated" / name)
+        violations.extend(v)
+        live.update(l)
+    assert not violations, (
+        "population-count-shaped allocation in the population "
+        "federated layer — virtual clients exist so memory is bounded "
+        "by the cohort/wave, never the population (derive per-client "
+        "state lazily from (seed, id), or extend the documented "
+        f"POPULATION_ALLOC_ALLOWLIST): {violations}")
+    stale = set(POPULATION_ALLOC_ALLOWLIST) - live
+    assert not stale, (
+        f"population-alloc allowlist entries match no code: {stale}")
+
+
 def test_serve_handlers_quarantine_or_reraise():
     violations, live = [], set()
     for f in sorted((PACKAGE / "serve").rglob("*.py")):
