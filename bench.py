@@ -1621,6 +1621,166 @@ def bench_serving_cluster(on_accelerator: bool):
     }
 
 
+def bench_serving_multitenant(on_accelerator: bool):
+    """Noisy-neighbor isolation (serve/tenancy.py, ISSUE 14): two
+    tenants with independent TTFT SLOs on ONE engine, tenant A
+    flooded mid-run.
+
+    Tenant B (globex, the victim) runs the same open-loop Poisson
+    trace twice: once ALONE (its clean baseline) and once mixed with
+    tenant A's (acme's) background traffic PLUS an injected A flood —
+    a burst far past A's quota. The acceptance gate, ASSERTED here:
+
+    - A's ``ttft:acme`` burn-rate alert FIRES and A is degraded (its
+      own brownout sheds / its queue quota rejects) — the flood is
+      seen and punished;
+    - B's ``ttft:globex`` alert stays SILENT, and B's TTFT p95 under
+      the flood holds within a machine-noise bar of its clean
+      baseline (the shared box drifts +/-40-50% on the minutes scale
+      — BASELINE.md — so the bar is multiplicative-with-floor, while
+      the alert silence is the structural, noise-proof half);
+    - zero jit-cache growth across the whole mixed-tenant run after
+      its first wave (tenant mixes are values, not shapes).
+
+    Isolation is quota-shaped: A may hold at most 2 of the 6 decode
+    slots and 8 queue entries, so the flood serializes behind A's own
+    allocation while B keeps 4 slots' worth of service. The client
+    replays with on_full="reject" (a flood drill's honest client:
+    refusals are answers, not things to re-offer forever)."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models.lm import attention_lm
+    from idc_models_tpu.serve import (
+        LMServer, Request, TenantQuota, TenantRegistry,
+    )
+
+    if on_accelerator:
+        vocab, e, heads, blocks, mlp = 1024, 512, 8, 2, 2048
+        t_max, n_slots, window = 512, 6, 16
+        n_b, rate_b, n_a, rate_a, n_flood = 48, 120.0, 24, 40.0, 80
+        a_slo_ms, b_slo_ms = 30.0, 500.0
+    else:
+        vocab, e, heads, blocks, mlp = 16, 32, 2, 2, 64
+        t_max, n_slots, window = 64, 6, 8
+        n_b, rate_b, n_a, rate_a, n_flood = 24, 60.0, 24, 25.0, 40
+        a_slo_ms, b_slo_ms = 12.0, 800.0
+    mesh = meshlib.seq_mesh(1)
+    model = attention_lm(vocab, t_max, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks, mesh=mesh)
+    params = model.init(jax.random.key(0)).params
+    kw = dict(embed_dim=e, num_heads=heads, num_blocks=blocks,
+              t_max=t_max, mesh=mesh, cache_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(5)
+
+    def requests(prefix, tenant, n, rate, t0=0.0, budgets=None):
+        lo_b, hi_b = budgets or (6, max(t_max // 4, 8))
+        t, out = t0, []
+        for i in range(n):
+            t += float(rng.exponential(1.0 / rate))
+            p_len = int(rng.integers(3, max(t_max // 8, 4)))
+            budget = int(rng.integers(lo_b, hi_b))
+            out.append((t, Request(
+                id=f"{prefix}{i}",
+                prompt=tuple(int(x)
+                             for x in rng.integers(0, vocab, p_len)),
+                max_new_tokens=min(budget, t_max - p_len),
+                tenant=tenant)))
+        return out
+
+    def build_server():
+        reg = TenantRegistry()
+        reg.register("acme",
+                     quota=TenantQuota(max_resident_slots=2,
+                                       max_queued=8),
+                     slo_ttft_p95_ms=a_slo_ms)
+        reg.register("globex", slo_ttft_p95_ms=b_slo_ms)
+        tenancy = reg.build(vocab=vocab, slo_short_window_s=10.0,
+                            slo_min_samples=5, brownout_dwell_s=0.0)
+        server = LMServer(params, n_slots=n_slots, window=window,
+                          max_prefills_per_cycle=n_slots,
+                          tenancy=tenancy, **kw)
+        return server, tenancy
+
+    trace_b = requests("b", "globex", n_b, rate_b)
+    span_b = trace_b[-1][0]
+
+    warm = [(0.0, Request(id=f"w{i}", prompt=(1, 2, 3),
+                          max_new_tokens=4,
+                          tenant=("acme" if i % 2 else "globex")))
+            for i in range(4)]
+
+    # -- clean baseline: tenant B alone on an identical server --------
+    server, tenancy = build_server()
+    server.run(warm)                     # warm the admission shapes
+    server.run(trace_b, realtime=True)
+    clean = server.summary()["serve_tenants"]["globex"]
+    assert tenancy.slo is not None and not tenancy.slo.alerts
+
+    # -- mixed: same B trace + A background + an injected A flood -----
+    server, tenancy = build_server()
+    flood_t = max(span_b * 0.3, 0.05)
+    # the flood asks for LONG generations (over half the cache each):
+    # serialized through A's 2-slot quota they pin A's queue at its
+    # watermark and stretch A's own TTFT far past its objective —
+    # while B, holding the other 4 slots, barely notices
+    trace = (trace_b
+             + requests("a", "acme", n_a, rate_a)
+             + [(flood_t, r) for _, r in
+                requests("f", "acme", n_flood, 1e9,
+                         budgets=(t_max * 3 // 8, t_max * 5 // 8))])
+    server.run(warm)
+    sizes = server.engine.cache_sizes()
+    results = server.run(trace, realtime=True, on_full="reject")
+    assert server.engine.cache_sizes() == sizes, (
+        server.engine.cache_sizes(), sizes)
+    s = server.summary()
+    mixed_b = s["serve_tenants"]["globex"]
+    mixed_a = s["serve_tenants"]["acme"]
+    a_alerts = [a for a in tenancy.slo.alerts
+                if a["slo"] == "ttft:acme"]
+    b_alerts = [a for a in tenancy.slo.alerts
+                if a["slo"] == "ttft:globex"]
+    degraded = (mixed_a["shed"] + mixed_a["quota_rejections"]
+                + sum(1 for r in results
+                      if r.id.startswith(("a", "f"))
+                      and r.status == "rejected"))
+    # the acceptance gates — structural, machine-noise-proof
+    assert a_alerts, "tenant A flooded but its TTFT alert never fired"
+    assert not b_alerts, (
+        f"tenant B's TTFT alert fired under A's flood: {b_alerts}")
+    assert degraded > 0, "the flood was never shed/quota-refused"
+    assert all(server.poll(r.id) is not None
+               and server.poll(r.id).status == "ok"
+               for r in (req for _, req in trace_b)), (
+        "a tenant-B request was lost under the flood")
+    ratio = (mixed_b["ttft_ms_p95"] / clean["ttft_ms_p95"]
+             if clean["ttft_ms_p95"] else None)
+    # B "unharmed": multiplicative bar with an absolute floor (clean
+    # p95 is single-digit ms on the smoke config, where scheduler
+    # jitter alone is a large multiple)
+    limit = max(3.0 * clean["ttft_ms_p95"],
+                clean["ttft_ms_p95"] + 80.0)
+    assert mixed_b["ttft_ms_p95"] <= limit, (
+        f"tenant B TTFT p95 {mixed_b['ttft_ms_p95']}ms vs clean "
+        f"{clean['ttft_ms_p95']}ms exceeds the isolation bar {limit}")
+    return {
+        "serve_mt_tenants": 2,
+        "serve_mt_flood_requests": n_flood,
+        "serve_mt_b_requests": mixed_b["requests"],
+        "serve_mt_b_ttft_ms_p95_clean": clean["ttft_ms_p95"],
+        "serve_mt_b_ttft_ms_p95_mixed": mixed_b["ttft_ms_p95"],
+        "serve_mt_b_ttft_ratio_mixed_vs_clean": (
+            round(ratio, 3) if ratio is not None else None),
+        "serve_mt_a_slo_alerts": len(a_alerts),
+        "serve_mt_b_slo_alerts": len(b_alerts),
+        "serve_mt_a_shed": mixed_a["shed"],
+        "serve_mt_a_quota_rejected": mixed_a["quota_rejections"],
+        "serve_mt_a_requests_ok": mixed_a["requests"],
+    }
+
+
 def bench_serving_resilience(on_accelerator: bool):
     """The ISSUE-8 resilience layer under load, two scenarios:
 
@@ -2063,6 +2223,8 @@ LOWER_IS_BETTER = (
     "serve_ttft_ms_p95_shared_prefix", "cluster_ttft_ms_p95_2r",
     "serve_chunked_prefill_decode_stall_ms",
     "serve_resilience_ttft_ms_p95_brownout",
+    "serve_mt_b_ttft_ms_p95_mixed",
+    "serve_mt_b_ttft_ratio_mixed_vs_clean",
     "serve_resilience_overhead_pct",
     "serve_paged_overhead_pct",
     "serve_trace_disabled_overhead_pct",
@@ -2186,6 +2348,7 @@ def main() -> None:
     ring.update(bench_serving_speculative(on_accelerator))
     ring.update(bench_serving_paged_kv(on_accelerator))
     ring.update(bench_serving_cluster(on_accelerator))
+    ring.update(bench_serving_multitenant(on_accelerator))
     ring.update(bench_serving_resilience(on_accelerator))
     ring.update(bench_tracer_overhead(on_accelerator))
     ring.update(bench_profile_overhead(on_accelerator))

@@ -492,6 +492,28 @@ def _parse(argv):
     sp.add_argument("--slo-window-s", type=float, default=60.0,
                     help="the SLO engine's SHORT evaluation window in "
                          "seconds (the long window is 5x this)")
+    sp.add_argument("--tenants", default=None,
+                    help="multi-tenant serving (serve/tenancy.py): "
+                         "comma-separated tenant names, first = the "
+                         "default for untagged requests; the synthetic "
+                         "Poisson trace tags arrivals round-robin. "
+                         "Per-tenant quotas/SLOs isolate a flooding "
+                         "tenant from its neighbors")
+    sp.add_argument("--tenant-quota", action="append", default=None,
+                    metavar="NAME=SLOTS[:QUEUED[:PAGES]]",
+                    help="per-tenant admission quota (repeatable): "
+                         "resident decode slots, queued requests, and "
+                         "KV page budget — each an int >= 1 or '-' "
+                         "for unlimited (e.g. acme=2:8:- caps acme at "
+                         "2 slots and 8 queued). Needs --tenants")
+    sp.add_argument("--tenant-slo-ttft-ms", action="append",
+                    default=None, metavar="[NAME=]MS",
+                    help="per-tenant TTFT p95 SLO in ms (repeatable): "
+                         "NAME=MS for one tenant, a bare number for "
+                         "every tenant. Burn-rate alerted per tenant "
+                         "(ttft:<name>) and the tenant's own brownout "
+                         "trigger — one tenant's flood sheds that "
+                         "tenant only. Needs --tenants")
 
     sp = sub.add_parser(
         "serve-cluster", aliases=["serve_cluster"],
@@ -1645,6 +1667,8 @@ def _run_serve(ns):
         sys.exit(f"--brownout-dwell-ms/--brownout-clear-ms must be "
                  f">= 0, got {ns.brownout_dwell_ms}/"
                  f"{ns.brownout_clear_ms}")
+    ns.tenant_list, ns.tenant_quotas, ns.tenant_slos = (
+        _parse_tenant_flags(ns))
     ns.serve_fault_plan = None
     if ns.serve_faults:
         from idc_models_tpu.serve import parse_serve_fault_spec
@@ -1709,6 +1733,91 @@ def _run_serve(ns):
             exporter.close()
 
 
+def _parse_tenant_flags(ns):
+    """Validate the serve verb's tenancy flags into (names, {name:
+    TenantQuota}, {name: ttft_ms}) — every bad spelling is a usage
+    error that TEACHES the grammar, the CLI's established discipline."""
+    quota_grammar = ("--tenant-quota grammar: NAME=SLOTS[:QUEUED"
+                     "[:PAGES]], each an int >= 1 or '-' (unlimited), "
+                     "e.g. acme=2:8:- ; NAME must be in --tenants")
+    slo_grammar = ("--tenant-slo-ttft-ms grammar: NAME=MS for one "
+                   "tenant or a bare MS > 0 for every tenant, e.g. "
+                   "acme=250 ; NAME must be in --tenants")
+    if ns.tenants is None:
+        if ns.tenant_quota:
+            sys.exit("--tenant-quota needs --tenants: quotas bound "
+                     "REGISTERED tenants")
+        if ns.tenant_slo_ttft_ms:
+            sys.exit("--tenant-slo-ttft-ms needs --tenants: SLOs "
+                     "attach to REGISTERED tenants")
+        return None, {}, {}
+    names = [t.strip() for t in ns.tenants.split(",")]
+    if any(not t for t in names):
+        sys.exit(f"--tenants {ns.tenants!r}: empty tenant name "
+                 f"(comma-separated non-empty names, first = default)")
+    if len(set(names)) != len(names):
+        sys.exit(f"--tenants {ns.tenants!r}: duplicate tenant name — "
+                 f"tenant names are identities")
+    from idc_models_tpu.serve import TenantQuota
+
+    def bound(tok, spec):
+        if tok == "-":
+            return None
+        try:
+            v = int(tok)
+        except ValueError:
+            sys.exit(f"--tenant-quota {spec!r}: {tok!r} is not an int "
+                     f"or '-'. {quota_grammar}")
+        if v < 1:
+            sys.exit(f"--tenant-quota {spec!r}: bounds must be >= 1 "
+                     f"(a 0 quota would admit nothing ever). "
+                     f"{quota_grammar}")
+        return v
+
+    quotas = {}
+    for spec in ns.tenant_quota or ():
+        name, eq, rest = spec.partition("=")
+        parts = rest.split(":") if rest else []
+        if not eq or not name or not 1 <= len(parts) <= 3:
+            sys.exit(f"--tenant-quota {spec!r}: malformed. "
+                     f"{quota_grammar}")
+        if name not in names:
+            sys.exit(f"--tenant-quota {spec!r}: unknown tenant "
+                     f"{name!r} (registered: {names}). {quota_grammar}")
+        if name in quotas:
+            sys.exit(f"--tenant-quota {spec!r}: tenant {name!r} "
+                     f"already has a quota")
+        parts += ["-"] * (3 - len(parts))
+        quotas[name] = TenantQuota(
+            max_resident_slots=bound(parts[0], spec),
+            max_queued=bound(parts[1], spec),
+            kv_page_budget=bound(parts[2], spec))
+    slos = {}
+    for spec in ns.tenant_slo_ttft_ms or ():
+        name, eq, rest = spec.partition("=")
+        if not eq:
+            name, rest = None, spec
+        try:
+            ms = float(rest)
+        except ValueError:
+            sys.exit(f"--tenant-slo-ttft-ms {spec!r}: {rest!r} is not "
+                     f"a number. {slo_grammar}")
+        if ms <= 0:
+            sys.exit(f"--tenant-slo-ttft-ms {spec!r}: must be > 0. "
+                     f"{slo_grammar}")
+        targets = [name] if name is not None else names
+        for t in targets:
+            if t not in names:
+                sys.exit(f"--tenant-slo-ttft-ms {spec!r}: unknown "
+                         f"tenant {t!r} (registered: {names}). "
+                         f"{slo_grammar}")
+            if t in slos:
+                sys.exit(f"--tenant-slo-ttft-ms {spec!r}: tenant "
+                         f"{t!r} already has a TTFT SLO")
+            slos[t] = ms
+    return names, quotas, slos
+
+
 def _serve_body(ns, mesh, params, logger) -> None:
     import json
 
@@ -1757,6 +1866,26 @@ def _serve_body(ns, mesh, params, logger) -> None:
             clamp_tokens=ns.brownout_clamp_tokens,
             escalate_dwell_s=ns.brownout_dwell_ms / 1e3,
             clear_after_s=ns.brownout_clear_ms / 1e3, logger=logger)
+    # multi-tenant serving (serve/tenancy.py, ISSUE 14): register the
+    # tenant set with its quotas + per-tenant TTFT SLOs and build the
+    # runtime against the serve knobs' windows/dwells. CLI tenants
+    # carry no trained adapters (the synthetic model has none to
+    # load); quota/SLO/brownout isolation is the full drill surface —
+    # docs/MULTITENANCY.md shows the adapter path in code.
+    tenancy = None
+    if ns.tenant_list:
+        from idc_models_tpu.serve import TenantRegistry
+
+        reg = TenantRegistry()
+        for name in ns.tenant_list:
+            reg.register(name, quota=ns.tenant_quotas.get(name),
+                         slo_ttft_p95_ms=ns.tenant_slos.get(name))
+        tenancy = reg.build(
+            vocab=ns.vocab, logger=logger,
+            slo_short_window_s=ns.slo_window_s,
+            brownout_dwell_s=ns.brownout_dwell_ms / 1e3,
+            brownout_clear_s=ns.brownout_clear_ms / 1e3,
+            brownout_clamp_tokens=ns.brownout_clamp_tokens)
     # count the journal's in-flight leftovers BEFORE the server opens
     # it for appending: these are the requests a previous crashed run
     # accepted but never finished
@@ -1781,7 +1910,8 @@ def _serve_body(ns, mesh, params, logger) -> None:
         draft_order=ns.ngram_order,
         kv_page_size=ns.kv_page_size or None,
         kv_pages=ns.kv_pages or None,
-        kv_decode_reserve=ns.kv_decode_reserve or None)
+        kv_decode_reserve=ns.kv_decode_reserve or None,
+        tenancy=tenancy)
     if n_pending:
         readmitted = server.resubmit_pending(ns.journal)
         line = (f"journal: re-admitted {len(readmitted)} in-flight "
@@ -1803,7 +1933,7 @@ def _serve_body(ns, mesh, params, logger) -> None:
             t_max=ns.t_max, eos_id=ns.eos,
             prompt_lens=(2, max(ns.t_max // 4, 2)),
             budgets=(2, max(ns.t_max // 4, 2)), seed=ns.seed,
-            sampled=ns.temperature > 0.0)
+            sampled=ns.temperature > 0.0, tenants=ns.tenant_list)
     print(f"serving {len(trace)} requests on {ns.slots} slots "
           f"(window {ns.window}, t_max {ns.t_max}, ring "
           f"{ns.seq_parallel})")
@@ -1873,6 +2003,23 @@ def _serve_body(ns, mesh, params, logger) -> None:
         names = sorted({a["slo"] for a in slo.alerts})
         print(f"slo: {len(slo.alerts)} alert(s)"
               + (f" ({', '.join(names)})" if names else ""))
+    if tenancy is not None:
+        # what isolation actually did, one line per tenant: volume,
+        # tail latency, sheds/quota refusals, the tenant's own
+        # brownout high-water stage, and whether its TTFT alert fired
+        for name, ts in summary["serve_tenants"].items():
+            bc = tenancy.brownouts.get(name)
+            alerts = (len([a for a in tenancy.slo.alerts
+                           if a["slo"] == f"ttft:{name}"])
+                      if tenancy.slo is not None else 0)
+            print(f"tenant {name}: requests={ts['requests']} "
+                  f"tokens={ts['tokens']} "
+                  f"ttft_p95={ts['ttft_ms_p95']}ms "
+                  f"shed={ts['shed']} "
+                  f"quota_rejected={ts['quota_rejections']} "
+                  f"brownout_max_stage="
+                  f"{bc.max_stage_seen if bc is not None else 0} "
+                  f"slo_alerts={alerts}")
     # resilience epilogue: what the armed machinery actually did —
     # faults fired, quarantines, retries, brownout sheds/clamps
     if (ns.serve_fault_plan is not None or retry is not None

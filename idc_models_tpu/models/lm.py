@@ -323,6 +323,42 @@ def _final_logits(ln, params, h):
     return h @ params["head"]["kernel"] + params["head"]["bias"]
 
 
+def make_adapter_head_hook(u, v, tslot):
+    """The per-tenant ADAPTER-DELTA forward hook (serve/tenancy.py) —
+    the one definition the fused window AND verify programs apply at
+    sampling time.
+
+    `u [T, V, r]` / `v [T, r, V]` stack every tenant's low-rank
+    logit-space adapter factors; `tslot [S]` (int32, traced VALUES not
+    shapes — tenant arrival patterns compile nothing) names each
+    slot's tenant. The returned hook maps base logits to effective
+    pick logits:
+
+        eff[s] = logits[s] + (logits[s] @ u[tslot[s]]) @ v[tslot[s]]
+
+    i.e. an effective head ``W (I + U_t V_t)`` per tenant. Because the
+    delta is a pure function of the BASE logits, all stored state —
+    prefill outputs, the engine's per-slot logits rows, prefix-cache
+    boundary snapshots — stays tenant-agnostic and shareable; only
+    the token PICK sees the tenant's head. Adapter-less tenants hold
+    zero rows, so their delta is exactly zero and they decode the
+    base model through the same gathered program. Accepts logits of
+    shape [S, V] (the window's per-step rows) or [S, K+1, V] (the
+    verify's candidate distributions) — the gather broadcasts over
+    any middle axes. An adapter that must touch attention/MLP
+    projections cannot take this form; that is the full-checkpoint-
+    per-tenant boundary (docs/MULTITENANCY.md)."""
+    ug = jnp.take(u, tslot, axis=0)          # [S, V, r]
+    vg = jnp.take(v, tslot, axis=0)          # [S, r, V]
+
+    def hook(logits):
+        z = jnp.einsum("s...v,svr->s...r", logits.astype(u.dtype), ug)
+        d = jnp.einsum("s...r,srv->s...v", z, vg)
+        return logits + d.astype(logits.dtype)
+
+    return hook
+
+
 def _token_forward(cfg: _ServeConfig, ln, params, caches, tok, pos, fold):
     """One token per row through every block — the single definition of
     the decode-time forward: embed (+position), then per block

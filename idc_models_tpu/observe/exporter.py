@@ -149,9 +149,19 @@ class MetricsExporter:
                 return None
             return inst.value(default=None)
 
+        def tenant_series(name):
+            # the tenant gauges are labeled by contract: collect every
+            # tenant's point into {tenant: value}
+            inst = self.registry.get(name)
+            if (inst is None or inst.kind != "gauge"
+                    or inst.label_names != ("tenant",)):
+                return {}
+            return {labels["tenant"]: val
+                    for labels, val in inst._series()}
+
         last_tick = gauge_value(LAST_TICK_GAUGE)
         stage = gauge_value("serve_brownout_stage")
-        return {
+        doc = {
             "status": "ok",
             "last_tick_age_s": (
                 None if last_tick is None
@@ -165,3 +175,25 @@ class MetricsExporter:
             "kv_pages_total": gauge_value("serve_kv_pages_total"),
             "brownout_stage": None if stage is None else int(stage),
         }
+        # multi-tenant servers (serve/tenancy.py, ISSUE 14) grow a
+        # per-tenant block — queue depth, slots, page reservations,
+        # and each tenant's OWN brownout stage — so a load balancer
+        # (or operator curl) can see WHICH tenant is degraded while
+        # the server-wide document stays healthy. Absent (no key) on
+        # tenant-less servers: the historical document shape is
+        # byte-identical.
+        depths = tenant_series("serve_tenant_queue_depth")
+        slots = tenant_series("serve_tenant_slots_used")
+        pages = tenant_series("serve_tenant_kv_pages_used")
+        stages = tenant_series("serve_tenant_brownout_stage")
+        names = (set(depths) | set(slots) | set(pages) | set(stages))
+        if names:
+            doc["tenants"] = {
+                t: {
+                    "queue_depth": depths.get(t),
+                    "slots_used": slots.get(t),
+                    "kv_pages_used": pages.get(t),
+                    "brownout_stage": (None if t not in stages
+                                       else int(stages[t])),
+                } for t in sorted(names)}
+        return doc

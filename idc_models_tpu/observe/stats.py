@@ -55,6 +55,7 @@ def summarize_jsonl(path) -> dict:
     programs: list[dict] = []
     profile_steps: list[dict] = []
     fed_cohorts: list[dict] = []
+    tenants: dict[str, dict] = {}
     last_snapshot = None
     ts = [r["ts"] for r in records
           if isinstance(r.get("ts"), (int, float))]
@@ -87,6 +88,19 @@ def summarize_jsonl(path) -> dict:
         if event == "fed_cohort":
             fed_cohorts.append({k: v for k, v in r.items()
                                 if k not in ("ts", "event")})
+        if event == "serve_tenant_finish":
+            slot_t = _tenant_slot(tenants, r)
+            slot_t["requests"] += 1
+            slot_t["tokens"] += int(r.get("tokens") or 0)
+            reason = str(r.get("reason"))
+            slot_t["by_reason"][reason] = (
+                slot_t["by_reason"].get(reason, 0) + 1)
+            if isinstance(r.get("ttft_ms"), (int, float)):
+                slot_t["ttft_ms"].append(float(r["ttft_ms"]))
+        if event == "serve_tenant_shed":
+            _tenant_slot(tenants, r)["shed"] += 1
+        if event == "serve_tenant_quota_reject":
+            _tenant_slot(tenants, r)["quota_rejections"] += 1
     events = {
         ev: {"count": slot["count"],
              "fields": {k: _num_stats(vs)
@@ -107,9 +121,31 @@ def summarize_jsonl(path) -> dict:
         "programs": programs,
         "profile_steps": profile_steps,
         "fed_cohorts": fed_cohorts,
+        # per-tenant rollup from the serve_tenant_* events (ISSUE 14):
+        # ttft_ms collapses to percentiles here, shed/quota counts ride
+        # along — the offline twin of summary()["serve_tenants"]
+        "tenants": {
+            t: {"requests": v["requests"], "tokens": v["tokens"],
+                "ttft_ms_p50": (round(float(np.percentile(
+                    v["ttft_ms"], 50)), 2) if v["ttft_ms"] else None),
+                "ttft_ms_p95": (round(float(np.percentile(
+                    v["ttft_ms"], 95)), 2) if v["ttft_ms"] else None),
+                "by_reason": v["by_reason"], "shed": v["shed"],
+                "quota_rejections": v["quota_rejections"]}
+            for t, v in sorted(tenants.items())},
         "metrics": last_snapshot,
         "requests": _request_timelines(records),
     }
+
+
+def _tenant_slot(tenants: dict, record: dict) -> dict:
+    """Get-or-create one tenant's accumulator — the ONE definition of
+    its field set, so the three serve_tenant_* event handlers cannot
+    drift."""
+    return tenants.setdefault(
+        str(record.get("tenant")),
+        {"requests": 0, "tokens": 0, "ttft_ms": [], "by_reason": {},
+         "shed": 0, "quota_rejections": 0})
 
 
 def _span_self_times(records: list[dict]) -> dict:
@@ -275,6 +311,18 @@ def format_summary(s: dict, *, top: int = 15) -> str:
                 line += (f" waves={rec.get('waves')}"
                          f"x{rec.get('wave_size')}")
             out.append(line)
+    if s.get("tenants"):
+        out.append("")
+        out.append("tenants:")
+        for name, st in s["tenants"].items():
+            reasons = ",".join(f"{k}={v}" for k, v in
+                               sorted(st["by_reason"].items()))
+            out.append(
+                f"  {name:16s} requests={st['requests']} "
+                f"tokens={st['tokens']} ttft p50={st['ttft_ms_p50']} "
+                f"p95={st['ttft_ms_p95']} shed={st['shed']} "
+                f"quota_rej={st['quota_rejections']}"
+                + (f" ({reasons})" if reasons else ""))
     if s.get("requests"):
         out.append("")
         out.append(f"requests: {len(s['requests'])} with per-request "

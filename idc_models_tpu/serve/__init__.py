@@ -24,3 +24,6 @@ from idc_models_tpu.serve.prefix_cache import (  # noqa: F401
 from idc_models_tpu.serve.scheduler import (  # noqa: F401
     AdmissionQueue, RetryPolicy, Scheduler,
 )
+from idc_models_tpu.serve.tenancy import (  # noqa: F401
+    AdapterBank, Tenancy, TenantQuota, TenantRegistry,
+)

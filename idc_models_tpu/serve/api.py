@@ -41,7 +41,10 @@ class Request:
     `eos_id` overrides the server default stop token (None = server's,
     -1 = never stop early); `trace_id` labels the request's lifecycle
     spans in exported traces (None = the scheduler assigns a
-    process-unique one at submit — it comes back on the Result)."""
+    process-unique one at submit — it comes back on the Result);
+    `tenant` names the registered tenant this request bills against on
+    a multi-tenant server (serve/tenancy.py — None = the default
+    tenant; an unknown name is a loud caller error)."""
     id: str
     prompt: tuple
     max_new_tokens: int
@@ -49,6 +52,7 @@ class Request:
     seed: int | None = None
     deadline_s: float | None = None
     trace_id: str | None = None
+    tenant: str | None = None
 
 
 @dataclasses.dataclass
@@ -106,7 +110,7 @@ class LMServer:
                  kv_page_size: int | None = None,
                  kv_pages: int | None = None,
                  kv_decode_reserve: int | None = None,
-                 registry=None):
+                 registry=None, tenancy=None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
@@ -160,6 +164,15 @@ class LMServer:
             from idc_models_tpu.models.draft import NGramDrafter
 
             drafter = NGramDrafter(draft_k, order=draft_order)
+        # tenancy (serve/tenancy.py, ISSUE 14): accept a built Tenancy
+        # runtime OR a TenantRegistry (built here against THIS model's
+        # vocab with the server's logger/registry/clock — adapter
+        # shape mismatches fail the build, not the first request)
+        if tenancy is not None and hasattr(tenancy, "build"):
+            tenancy = tenancy.build(
+                vocab=params["head"]["kernel"].shape[1],
+                logger=logger, registry=registry, clock=clock)
+        self.tenancy = tenancy
         self.engine = SlotEngine(
             params, embed_dim=embed_dim, num_heads=num_heads,
             num_blocks=num_blocks, t_max=t_max, n_slots=n_slots,
@@ -171,12 +184,15 @@ class LMServer:
             prefix_cache=prefix_cache, kv_dtype=kv_dtype,
             draft_k=draft_k if spec_decode else None,
             kv_page_size=kv_page_size, kv_pages=kv_pages,
-            kv_decode_reserve=kv_decode_reserve)
+            kv_decode_reserve=kv_decode_reserve,
+            adapter_bank=(tenancy.bank if tenancy is not None
+                          else None))
         # slo: an optional observe.slo.SLOEngine — the metrics hooks
         # feed its declared objectives (ttft/queue_wait/error_rate) and
         # evaluate burn rates once per scheduler cycle
         self.metrics = ServingMetrics(logger, prefix_cache=prefix_cache,
-                                      slo=slo, registry=registry)
+                                      slo=slo, registry=registry,
+                                      tenancy=tenancy)
         # journal: a RequestJournal or a path — the WAL of accepted
         # work a rebuilt server recovers in-flight requests from
         # (resubmit_pending / serve/journal.py)
@@ -198,7 +214,8 @@ class LMServer:
             admit_after_collect=admit_after_collect,
             metrics=self.metrics, clock=clock, retry=retry,
             fault_plan=fault_plan, health_checks=health_checks,
-            journal=journal, brownout=brownout, drafter=drafter)
+            journal=journal, brownout=brownout, drafter=drafter,
+            tenancy=tenancy)
         self._results: dict[str, Result] = {}
         self._inflight: set[str] = set()
         if warmup:
@@ -229,7 +246,8 @@ class LMServer:
             # key data on the host (identical to jax.random.key(seed))
             rng=request.seed,
             deadline=request.deadline_s,
-            trace_id=request.trace_id)
+            trace_id=request.trace_id,
+            tenant=request.tenant)
         ok = self.scheduler.submit(entry)
         if not ok:
             if entry.status == "shed":
@@ -263,15 +281,34 @@ class LMServer:
         accepted but unfinished (in original submit order) through the
         NORMAL admission path — chunked prefill and prefix-cache reuse
         included — and return the re-admitted ids. Each recovered
-        request keeps its journaled id, seed, deadline, and trace_id,
-        and its greedy/seeded output is bit-identical to what an
-        uncrashed run would have produced (the engine's serial-parity
-        contract; gated by test)."""
+        request keeps its journaled id, seed, deadline, trace_id, and
+        tenant tag, and its greedy/seeded output is bit-identical to
+        what an uncrashed run would have produced (the engine's
+        serial-parity contract; gated by test).
+
+        A journaled request the REBUILT server can never serve — a
+        tenant since decommissioned from the registry, a prompt past a
+        shrunken t_max — is SKIPPED with a warning instead of aborting
+        the whole recovery: one stale entry must not block every other
+        tenant's requests from coming back (the entry stays in the
+        WAL, so a rerun against a fixed configuration still recovers
+        it)."""
+        import warnings
+
         from idc_models_tpu.serve.journal import pending_requests
 
         out = []
         for req in pending_requests(journal_path):
-            if self.submit(req):
+            try:
+                ok = self.submit(req)
+            except ValueError as e:
+                warnings.warn(
+                    f"journal recovery skipped request {req.id!r}: "
+                    f"{e} — it remains in the WAL; rerun against a "
+                    f"configuration that can serve it",
+                    stacklevel=2)
+                continue
+            if ok:
                 out.append(req.id)
         return out
 
@@ -413,16 +450,20 @@ def poisson_trace(n_requests: int, *, rate_per_s: float, vocab: int,
                   t_max: int, prompt_lens=(4, 16), budgets=(4, 16),
                   eos_id: int | None = None,
                   deadline_s: float | None = None, seed: int = 0,
-                  sampled: bool = False):
+                  sampled: bool = False, tenants=None):
     """Synthetic open-loop arrivals: exponential inter-arrival times at
     `rate_per_s`, prompt lengths and budgets uniform over the given
     inclusive ranges (clamped so prompt + budget <= t_max). With
     `sampled=True` each request carries its own seed (for temperature>0
-    servers). Returns `[(arrival_s, Request), ...]`."""
+    servers). `tenants` (a sequence of names) tags arrivals round-robin
+    for a multi-tenant server — round-robin, not random, so every
+    tenant's sub-trace is a deterministic function of the trace alone.
+    Returns `[(arrival_s, Request), ...]`."""
     rng = np.random.default_rng(seed)
     t, trace = 0.0, []
     lo_p, hi_p = prompt_lens
     lo_b, hi_b = budgets
+    tenants = list(tenants) if tenants else None
     for i in range(n_requests):
         t += float(rng.exponential(1.0 / rate_per_s))
         p_len = int(rng.integers(lo_p, hi_p + 1))
@@ -433,7 +474,8 @@ def poisson_trace(n_requests: int, *, rate_per_s: float, vocab: int,
         trace.append((t, Request(
             id=f"r{i}", prompt=prompt, max_new_tokens=budget,
             eos_id=eos_id, deadline_s=deadline_s,
-            seed=(int(rng.integers(0, 2**31)) if sampled else None))))
+            seed=(int(rng.integers(0, 2**31)) if sampled else None),
+            tenant=(tenants[i % len(tenants)] if tenants else None))))
     return trace
 
 
@@ -445,10 +487,15 @@ def save_trace(path, trace) -> str:
     path.parent.mkdir(parents=True, exist_ok=True)
     with open(path, "w") as f:
         for t, r in trace:
-            f.write(json.dumps({
+            rec = {
                 "t": t, "id": r.id, "prompt": list(r.prompt),
                 "max_new_tokens": r.max_new_tokens, "eos_id": r.eos_id,
-                "seed": r.seed, "deadline_s": r.deadline_s}) + "\n")
+                "seed": r.seed, "deadline_s": r.deadline_s}
+            if r.tenant is not None:
+                # written only when tagged: untagged traces stay
+                # byte-identical to every file this format ever wrote
+                rec["tenant"] = r.tenant
+            f.write(json.dumps(rec) + "\n")
     return str(path)
 
 
@@ -463,5 +510,5 @@ def load_trace(path):
             id=str(d["id"]), prompt=tuple(d["prompt"]),
             max_new_tokens=int(d["max_new_tokens"]),
             eos_id=d.get("eos_id"), seed=d.get("seed"),
-            deadline_s=d.get("deadline_s"))))
+            deadline_s=d.get("deadline_s"), tenant=d.get("tenant"))))
     return trace

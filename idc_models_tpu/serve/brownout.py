@@ -56,7 +56,8 @@ class BrownoutController:
                  queue_low: int | None = None, clamp_tokens: int = 8,
                  escalate_dwell_s: float = 0.25,
                  clear_after_s: float = 1.0, prefix_cache=None,
-                 logger=None, registry=None, clock=time.monotonic):
+                 logger=None, registry=None, clock=time.monotonic,
+                 tenant: str | None = None):
         if slo is None and queue_high is None:
             raise ValueError(
                 "brownout needs at least one signal: an SLOEngine "
@@ -85,13 +86,37 @@ class BrownoutController:
         self.prefix_cache = prefix_cache
         self.logger = logger
         self.clock = clock
+        # tenant: this controller degrades ONE tenant's admissions
+        # (serve/tenancy.py), not the whole server — its gauge is the
+        # tenant-labeled twin and its jsonl event a NEW type, so the
+        # historical unlabeled serve_brownout surfaces stay
+        # byte-identical. A per-tenant controller must not hold the
+        # (shared, cross-tenant) prefix cache: stage 1's cache-write
+        # pause is a global-resource action that stays with the
+        # server-wide controller.
+        self.tenant = tenant
+        if tenant is not None and prefix_cache is not None:
+            raise ValueError(
+                "a per-tenant brownout cannot pause the SHARED prefix "
+                "cache (that would degrade every tenant for one "
+                "tenant's burn) — leave prefix_cache on the server-"
+                "wide controller")
         reg = registry if registry is not None else mreg.REGISTRY
-        self._g_stage = reg.gauge(
-            "serve_brownout_stage",
-            "current brownout degradation stage (0 normal, 1 prefix-"
-            "cache writes paused, 2 max_new_tokens clamped, 3 shedding "
-            "new submits)")
-        self._g_stage.set(0)
+        if tenant is None:
+            self._g_stage = reg.gauge(
+                "serve_brownout_stage",
+                "current brownout degradation stage (0 normal, 1 "
+                "prefix-cache writes paused, 2 max_new_tokens clamped,"
+                " 3 shedding new submits)")
+            self._g_stage.set(0)
+        else:
+            self._g_stage = reg.gauge(
+                "serve_tenant_brownout_stage",
+                "current per-tenant brownout degradation stage (0 "
+                "normal .. 3 shedding that tenant's submits) — one "
+                "tenant's flood degrades only its own admissions",
+                labels=("tenant",))
+            self._g_stage.set(0, tenant=tenant)
         self.stage = 0
         self.max_stage_seen = 0
         self.transitions: list[dict] = []
@@ -156,17 +181,25 @@ class BrownoutController:
         self.stage = stage
         self.max_stage_seen = max(self.max_stage_seen, stage)
         self._last_change = now
-        self._g_stage.set(stage)
-        if self.prefix_cache is not None:
-            self.prefix_cache.pause_writes(stage >= 1)
-        trace.point("serve.brownout", stage=stage,
-                    stage_name=STAGES[stage], direction=direction,
-                    reason=reason)
         rec = {"stage": stage, "stage_name": STAGES[stage],
                "direction": direction, "reason": reason}
+        if self.tenant is None:
+            self._g_stage.set(stage)
+            trace.point("serve.brownout", **rec)
+            event = "serve_brownout"
+        else:
+            # the tenant-labeled twin surfaces: a NEW jsonl event type
+            # (frozen from day one in test_observability) so the
+            # historical serve_brownout record stays byte-identical
+            self._g_stage.set(stage, tenant=self.tenant)
+            rec["tenant"] = self.tenant
+            trace.point("serve.tenant_brownout", **rec)
+            event = "serve_tenant_brownout"
+        if self.prefix_cache is not None:
+            self.prefix_cache.pause_writes(stage >= 1)
         self.transitions.append(rec)
         if self.logger is not None:
-            self.logger.log(event="serve_brownout", **rec)
+            self.logger.log(event=event, **rec)
 
     def force_stage(self, stage: int, *, reason: str = "drain") -> int:
         """Jump straight to `stage`, bypassing the dwell timer — the

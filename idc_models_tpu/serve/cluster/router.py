@@ -67,7 +67,8 @@ class Router:
 
     def __init__(self, replicas, *, retry=None, hedge_after_s=None,
                  prefix_registry=None, slo=None, logger=None,
-                 registry=None, clock=time.monotonic):
+                 registry=None, clock=time.monotonic,
+                 tenant_affinity_slack: int | None = 4):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("need at least one replica")
@@ -129,6 +130,21 @@ class Router:
         self._m_deaths = reg.counter(
             "cluster_replica_deaths_total",
             "replicas marked dead (step failure or kill drill)")
+        # tenant affinity (serve/tenancy.py, ISSUE 14): a tenant's
+        # requests stick to the replica that last served them — its
+        # prefix cache holds the tenant's system-prompt snapshots and
+        # its engine the tenant's warm state — unless that replica is
+        # more than `tenant_affinity_slack` requests more loaded than
+        # the best candidate (None disables affinity). Affinity never
+        # overrides admissibility: a draining/shedding/full home just
+        # loses the tenant to the normal least-loaded placement.
+        self.tenant_affinity_slack = tenant_affinity_slack
+        self._tenant_home: dict[str, object] = {}
+        self._m_affinity = reg.counter(
+            "cluster_tenant_affinity_placements_total",
+            "placements routed to the tenant's home replica by "
+            "affinity (prefix-cache / adapter warmth)",
+            labels=("tenant",))
         self._g_live = reg.gauge(
             "cluster_replicas_live",
             "replicas currently live (placeable fleet size)")
@@ -188,7 +204,17 @@ class Router:
                  if r.can_take(p_len, int(request.max_new_tokens))]
         if not cands:
             return None
-        return min(cands, key=lambda r: self._score(r, r.health()))
+        best = min(cands, key=lambda r: self._score(r, r.health()))
+        tenant = getattr(request, "tenant", None)
+        if tenant is not None and self.tenant_affinity_slack is not None:
+            home = self._tenant_home.get(tenant)
+            if (home is not None and home is not best and home in cands
+                    and not home.health()["slo_breached"]
+                    and home.load()
+                    <= best.load() + self.tenant_affinity_slack):
+                self._m_affinity.inc(tenant=tenant)
+                return home
+        return best
 
     def _submit_to(self, replica, request: Request) -> bool:
         ok = replica.submit(request)
@@ -198,6 +224,12 @@ class Router:
         self._owner[rid] = replica
         self._requests[rid] = request
         self._submit_t[rid] = self.clock()
+        tenant = getattr(request, "tenant", None)
+        if tenant is not None:
+            # the tenant's home for affinity: last successful placement
+            # wins, so a tenant displaced by load rehomes where it
+            # actually landed
+            self._tenant_home[tenant] = replica
         self._attempts[rid] = self._attempts.get(rid, 0) + 1
         self._results.pop(rid, None)
         self.placements[replica.replica_id] += 1
@@ -504,6 +536,10 @@ class Router:
         journal's pending requests for migration onto survivors."""
         already_dead = replica.state == "dead"
         replica.kill()
+        # a dead home cannot serve affinity: drop its tenants so their
+        # next placement rehomes on a survivor
+        self._tenant_home = {t: r for t, r in self._tenant_home.items()
+                             if r is not replica}
         if not already_dead:
             self._m_deaths.inc()
             self._g_live.set(sum(1 for r in self.replicas

@@ -70,8 +70,8 @@ from idc_models_tpu.observe import trace
 from idc_models_tpu.models.lm import (
     _attn_residual, _chunk_batch_forward, _final_logits, _make_pick,
     _mlp_residual, _place_params, _project_qkv, _serve_config,
-    _serving_fns, _token_forward, check_prefill_chunk, prefill_bucket,
-    prefill_buckets,
+    _serving_fns, _token_forward, check_prefill_chunk,
+    make_adapter_head_hook, prefill_bucket, prefill_buckets,
 )
 from idc_models_tpu.ring_decode import (
     make_batched_chunk_ring_decode, make_batched_ring_decode,
@@ -117,12 +117,13 @@ class _PendingPrefill:
     `shared` ids are prefix-cache pages this request only references."""
 
     __slots__ = ("prompt", "budget", "rng", "eos_id", "caches", "logits",
-                 "next_start", "tag", "pages", "shared")
+                 "next_start", "tag", "pages", "shared", "tid")
 
     def __init__(self, *, prompt, budget, rng, eos_id, caches, logits,
-                 next_start, tag=None, pages=None, shared=0):
+                 next_start, tag=None, pages=None, shared=0, tid=0):
         self.pages = pages
         self.shared = shared
+        self.tid = tid
         self.prompt = prompt
         self.budget = budget
         self.rng = rng
@@ -164,28 +165,36 @@ HEALTH_KINDS = {1: "nonfinite_logits", 2: "logit_magnitude"}
 
 
 def _window_core(cfg, pick, pad_id, params, caches, logits, kd, pos,
-                 remaining, eos, n_steps, step_fn, pin_state):
+                 remaining, eos, n_steps, step_fn, pin_state,
+                 eff=None):
     """THE masked fused-window scan — sampling rule, rng advance,
     budget/EOS retirement — shared verbatim by the contiguous and the
     paged engines (only `step_fn`, the per-token forward + cache fold,
     differs), so paged token streams are bit-identical to contiguous
-    ones by construction rather than by parallel maintenance."""
+    ones by construction rather than by parallel maintenance.
+
+    `eff` (None = identity) maps each step's base logits to the
+    EFFECTIVE pick logits — the per-tenant adapter hook
+    (models/lm.make_adapter_head_hook): the delta is applied at the
+    token pick only, while the carried logits state stays base, so
+    every stored row remains tenant-agnostic."""
     def body(carry, _):
         caches, logits, kd, pos, remaining = carry
         live = remaining > 0
+        pl = logits if eff is None else eff(logits)
         if cfg.temperature == 0.0:
             # greedy consumes NO randomness (serial pick ignores its
             # key too) — skip the S per-slot threefry splits, which
             # otherwise dominate the per-step cost at small batch
             toks = jax.vmap(lambda lg: pick(lg[None, :], None)[0])(
-                logits)
+                pl)
         else:
             pair = jax.vmap(jax.random.split)(
                 jax.random.wrap_key_data(kd))        # [S, 2] keys
             # per-slot sampling over a [1, V] row — the EXACT serial
             # pick call shape, so seeded sampling matches bit-for-bit
             toks = jax.vmap(lambda lg, k: pick(lg[None, :], k)[0])(
-                logits, pair[:, 1])
+                pl, pair[:, 1])
         toks = jnp.where(live, toks, pad_id).astype(jnp.int32)
         if cfg.temperature > 0.0:
             # the stream advances once per EMITTED token, same as the
@@ -210,7 +219,7 @@ def _window_core(cfg, pick, pad_id, params, caches, logits, kd, pos,
 
 def _verify_core(cfg, pick, pad_id, K, t_max, params, caches, logits,
                  kd, pos, remaining, eos, drafts, vlive, chunk_forward,
-                 tok_forward, pin_state):
+                 tok_forward, pin_state, eff=None):
     # SPECULATIVE VERIFY — one dispatch turns K drafted tokens per
     # slot into between 1 and K+1 EMITTED tokens per participating
     # slot:
@@ -244,8 +253,13 @@ def _verify_core(cfg, pick, pad_id, K, t_max, params, caches, logits,
     # draft position), cand[:, j] the logits after drafts[:, :j]
     cand = jnp.concatenate(
         [logits.astype(L.dtype)[:, None], L], axis=1)
+    # the per-tenant adapter hook, applied to the CANDIDATE
+    # distributions the picks see ([S, K+1, V] — one gather for all
+    # K+1 positions); the stored state (`after`, the bonus logits)
+    # stays base, same discipline as the window's per-step pick
+    cand_p = cand if eff is None else eff(cand)
     if cfg.temperature == 0.0:
-        flat = cand.reshape(-1, cand.shape[-1])
+        flat = cand_p.reshape(-1, cand_p.shape[-1])
         g = jax.vmap(lambda lg: pick(lg[None, :], None)[0])(
             flat).reshape(s_rows, K + 1).astype(jnp.int32)
         kd_chain = None
@@ -264,7 +278,7 @@ def _verify_core(cfg, pick, pad_id, K, t_max, params, caches, logits,
             return kd_n, (t, kd_n)
 
         _, (g_t, chain) = lax.scan(samp, kd,
-                                   jnp.moveaxis(cand, 0, 1))
+                                   jnp.moveaxis(cand_p, 0, 1))
         g = jnp.moveaxis(g_t, 0, 1).astype(jnp.int32)
         kd_chain = jnp.moveaxis(chain, 0, 1)     # [S, K+1, 2]
     # accepted prefix length m, the bonus pick g[m], and the emitted
@@ -386,26 +400,35 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
                               block_fold)
 
     def window_body(params, caches, logits, kd, pos, remaining, eos,
-                    scales, n_steps):
+                    scales, adapters, tslot, n_steps):
         # the whole window is ONE device program, like the serial fused
         # scan — but each slot carries its own position, budget, and rng
-        # stream, and dead slots ride along as bit-level no-ops
+        # stream, and dead slots ride along as bit-level no-ops.
+        # `adapters` is () (no tenancy — the historical program, pytree
+        # structure keeps the jit cache keys distinct) or the stacked
+        # (u [T, V, r], v [T, r, V]) tenant adapter bank, gathered by
+        # the traced per-slot tenant ids `tslot` — tenant ARRIVAL
+        # PATTERNS are values, never shapes, so a mixed-tenant batch
+        # stays one executable (gated by test)
         def step_fn(params, caches, toks, pos, live):
             return masked_step(params, caches, toks, pos, live, scales)
 
+        eff = (make_adapter_head_hook(*adapters, tslot) if adapters
+               else None)
         return _window_core(cfg, pick, pad_id, params, caches, logits,
                             kd, pos, remaining, eos, n_steps, step_fn,
-                            pin_state)
+                            pin_state, eff=eff)
 
-    # eos (argnum 6) and the dequant scales (argnum 7) are read-only
+    # eos (argnum 6), the dequant scales (argnum 7), the adapter bank
+    # (argnum 8) and the tenant-slot ids (argnum 9) are read-only
     # across windows and deliberately NOT donated — the same device
     # arrays feed every window until an admission replaces them
-    window = jax.jit(window_body, static_argnums=(8,),
+    window = jax.jit(window_body, static_argnums=(10,),
                      donate_argnums=(1, 2, 3, 4, 5))
 
-    def insert_body(caches, logits, kd, pos, rem, eos, scales,
+    def insert_body(caches, logits, kd, pos, rem, eos, tslot, scales,
                     new_caches, new_logits, slot, p_len, budget, eos_id,
-                    kd_row):
+                    tid, kd_row):
         # batch-axis scatter with the slot index (and every per-slot
         # scalar) TRACED: one compiled program admits any request into
         # any slot
@@ -434,8 +457,9 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
         pos = pos.at[slot].set(p_len)
         rem = rem.at[slot].set(budget)
         eos = eos.at[slot].set(eos_id)
+        tslot = tslot.at[slot].set(tid)
         caches, logits = pin_state(tuple(out), logits)
-        return (caches, logits, kd, pos, rem, eos,
+        return (caches, logits, kd, pos, rem, eos, tslot,
                 tuple(out_scales) if quant else ())
 
     def _quantize_row(x):
@@ -449,7 +473,8 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
                      -127, 127).astype(jnp.int8)
         return q, s
 
-    insert = jax.jit(insert_body, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+    insert = jax.jit(insert_body,
+                     donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
 
     def health_body(logits):
         # per-slot fault codes in ONE tiny reduce + fetch ([S] int32):
@@ -472,7 +497,7 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
                                                     quantized=quant)
 
         def verify_body(params, caches, logits, kd, pos, remaining,
-                        eos, scales, drafts, vlive):
+                        eos, scales, adapters, tslot, drafts, vlive):
             def chunk_forward(params, caches, drafts, pos, live):
                 def block_chunk_fold(i, kc, vc, q, k, v):
                     extra = (scales[i] if quant else ())
@@ -492,10 +517,12 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
                 return _token_forward(cfg, ln, params, caches, b,
                                       bpos, block_tok_fold)
 
+            eff = (make_adapter_head_hook(*adapters, tslot)
+                   if adapters else None)
             return _verify_core(cfg, pick, pad_id, K, t_max, params,
                                 caches, logits, kd, pos, remaining,
                                 eos, drafts, vlive, chunk_forward,
-                                tok_forward, pin_state)
+                                tok_forward, pin_state, eff=eff)
 
         verify = jax.jit(verify_body, donate_argnums=(1, 2, 3, 4, 5))
 
@@ -580,23 +607,26 @@ def _paged_engine_fns(cfg, pad_id: int, quant: bool, draft_k,
                               block_fold)
 
     def window_body(params, pools, pt, logits, kd, pos, remaining,
-                    eos, scales, n_steps):
+                    eos, scales, adapters, tslot, n_steps):
         def step_fn(params, pools, toks, pos, live):
             return masked_step(params, pools, pt, toks, pos, live,
                                scales)
 
+        eff = (make_adapter_head_hook(*adapters, tslot) if adapters
+               else None)
         return _window_core(cfg, pick, pad_id, params, pools, logits,
                             kd, pos, remaining, eos, n_steps, step_fn,
-                            pin_state)
+                            pin_state, eff=eff)
 
-    # pt (argnum 2), eos and the scales are read-only across windows
-    # and NOT donated — page-table rewrites go through the page_row
-    # program at grant time only
-    window = jax.jit(window_body, static_argnums=(9,),
+    # pt (argnum 2), eos, the scales, the adapter bank and the tenant-
+    # slot ids are read-only across windows and NOT donated —
+    # page-table rewrites go through the page_row program at grant
+    # time only
+    window = jax.jit(window_body, static_argnums=(11,),
                      donate_argnums=(1, 3, 4, 5, 6))
 
-    def insert_body(logits, kd, pos, rem, eos, new_logits, slot,
-                    p_len, budget, eos_id, kd_row):
+    def insert_body(logits, kd, pos, rem, eos, tslot, new_logits, slot,
+                    p_len, budget, eos_id, tid, kd_row):
         # the paged admission scatter touches NO cache state: the
         # prompt's K/V already sits in the slot's granted pages
         # (written there by the direct-to-pool chunk program), so
@@ -607,10 +637,11 @@ def _paged_engine_fns(cfg, pad_id: int, quant: bool, draft_k,
         pos = pos.at[slot].set(p_len)
         rem = rem.at[slot].set(budget)
         eos = eos.at[slot].set(eos_id)
+        tslot = tslot.at[slot].set(tid)
         return (lax.with_sharding_constraint(logits, rep), kd, pos,
-                rem, eos)
+                rem, eos, tslot)
 
-    insert = jax.jit(insert_body, donate_argnums=(0, 1, 2, 3, 4))
+    insert = jax.jit(insert_body, donate_argnums=(0, 1, 2, 3, 4, 5))
 
     def page_row_body(pt, slot, row, rem, kill):
         # one program serves both grant-time rewrites (kill=0) and the
@@ -707,7 +738,7 @@ def _paged_engine_fns(cfg, pad_id: int, quant: bool, draft_k,
             mesh, page_size=page_size, jit=False, quantized=quant)
 
         def verify_body(params, pools, pt, logits, kd, pos, remaining,
-                        eos, scales, drafts, vlive):
+                        eos, scales, adapters, tslot, drafts, vlive):
             def chunk_forward(params, pools, drafts, pos, live):
                 def block_chunk_fold(i, kp, vp, q, k, v):
                     extra = (scales[i] if quant else ())
@@ -727,10 +758,12 @@ def _paged_engine_fns(cfg, pad_id: int, quant: bool, draft_k,
                 return _token_forward(cfg, ln, params, pools, b, bpos,
                                       block_tok_fold)
 
+            eff = (make_adapter_head_hook(*adapters, tslot)
+                   if adapters else None)
             return _verify_core(cfg, pick, pad_id, K, t_max, params,
                                 pools, logits, kd, pos, remaining,
                                 eos, drafts, vlive, chunk_forward,
-                                tok_forward, pin_state)
+                                tok_forward, pin_state, eff=eff)
 
         verify = jax.jit(verify_body, donate_argnums=(1, 3, 4, 5, 6))
 
@@ -766,7 +799,8 @@ class SlotEngine:
                  draft_k: int | None = None,
                  kv_page_size: int | None = None,
                  kv_pages: int | None = None,
-                 kv_decode_reserve: int | None = None):
+                 kv_decode_reserve: int | None = None,
+                 adapter_bank=None):
         if n_slots < 1:
             raise ValueError(f"need n_slots >= 1, got {n_slots}")
         # paged KV mode (ISSUE 11): the per-slot [t_max, H, D] ring
@@ -922,6 +956,27 @@ class SlotEngine:
         # is a multi-hundred-MB device→host fetch per engine build
         ldtype = jnp.result_type(params["head"]["kernel"].dtype)
         rep = meshlib.replicated(self._cfg.mesh)
+        # per-tenant adapter bank (serve/tenancy.py, ISSUE 14): the
+        # stacked [T, V, r]/[T, r, V] logit-adapter factors, placed
+        # replicated ONCE and fed read-only to every window/verify —
+        # the programs gather each slot's tenant row by the traced
+        # tslot ids, so tenant mixes are values, never shapes
+        self._adapters = ()
+        self.n_tenants = 0
+        if adapter_bank is not None:
+            u = np.asarray(adapter_bank.u, np.float32)
+            v = np.asarray(adapter_bank.v, np.float32)
+            if (u.ndim != 3 or v.ndim != 3 or u.shape[1] != vocab
+                    or v.shape != (u.shape[0], u.shape[2], vocab)):
+                raise ValueError(
+                    f"adapter bank shapes must be u [T, V, r] / "
+                    f"v [T, r, V] with V = the model vocab {vocab}, "
+                    f"got {u.shape} / {v.shape} — a tenant adapter "
+                    f"trained against a different head cannot serve "
+                    f"this model")
+            self.n_tenants = u.shape[0]
+            self._adapters = (meshlib.put_with_sharding(u, rep),
+                              meshlib.put_with_sharding(v, rep))
         # device state — placed under the canonical shardings every
         # engine program pins its outputs to (one jit cache key for the
         # whole loop), donated through every window/insert
@@ -936,6 +991,12 @@ class SlotEngine:
             np.zeros(n_slots, np.int32), rep)
         self._eos = meshlib.put_with_sharding(
             np.full(n_slots, -1, np.int32), rep)
+        # per-slot tenant ids ([S] int32, tid 0 = the default tenant):
+        # always present (a tiny row) so the insert scatter has ONE
+        # signature; it only steers the adapter gather when a bank is
+        # armed
+        self._tslot = meshlib.put_with_sharding(
+            np.zeros(n_slots, np.int32), rep)
         self._scales = self._efns.init_scales(n_slots)
         # host shadows (never fetched back from device)
         self._pos_h = np.zeros(n_slots, np.int64)
@@ -1041,10 +1102,24 @@ class SlotEngine:
                              "key (or integer seed) per request")
         return prompt
 
+    def _check_tid(self, tid: int) -> None:
+        """With an adapter bank armed, an out-of-range tenant id would
+        gather a CLAMPED tenant's adapter (jnp.take clamps OOB
+        indices) — silently serving the wrong tenant's head; caught at
+        admission instead. Without a bank the tslot row steers nothing
+        and any id is inert bookkeeping."""
+        if self.n_tenants and not 0 <= tid < self.n_tenants:
+            raise ValueError(
+                f"tenant id {tid} out of range [0, {self.n_tenants}): "
+                f"the adapter bank was built with {self.n_tenants} "
+                f"tenants")
+
     def _insert(self, slot, caches1, logits1, p_len, max_new_tokens,
-                eos_id, rng) -> None:
+                eos_id, rng, tid: int = 0) -> None:
         """Scatter a fully prefilled request into the batch row — the
-        shared tail of both admission paths."""
+        shared tail of both admission paths. `tid` is the request's
+        tenant id (0 = default): a traced scalar into the tslot row,
+        steering the window/verify adapter gather for this slot."""
         eos = self.eos_id if eos_id is None else eos_id
         eos = -1 if eos is None else int(eos)
         kd_row = (_key_data(rng) if rng is not None
@@ -1053,24 +1128,27 @@ class SlotEngine:
             # the prompt K/V already lives in the slot's pages — the
             # paged insert is a scalar/row scatter only
             (self._logits, self._kd, self._pos, self._rem,
-             self._eos) = self._efns.insert(
+             self._eos, self._tslot) = self._efns.insert(
                 self._logits, self._kd, self._pos, self._rem,
-                self._eos, logits1, np.int32(slot), np.int32(p_len),
-                np.int32(max_new_tokens), np.int32(eos), kd_row)
+                self._eos, self._tslot, logits1, np.int32(slot),
+                np.int32(p_len), np.int32(max_new_tokens),
+                np.int32(eos), np.int32(tid), kd_row)
         else:
             (self._caches, self._logits, self._kd, self._pos, self._rem,
-             self._eos, self._scales) = self._efns.insert(
+             self._eos, self._tslot, self._scales) = self._efns.insert(
                 self._caches, self._logits, self._kd, self._pos,
-                self._rem, self._eos, self._scales, caches1, logits1,
-                np.int32(slot), np.int32(p_len),
-                np.int32(max_new_tokens), np.int32(eos), kd_row)
+                self._rem, self._eos, self._tslot, self._scales,
+                caches1, logits1, np.int32(slot), np.int32(p_len),
+                np.int32(max_new_tokens), np.int32(eos),
+                np.int32(tid), kd_row)
         self._pos_h[slot] = p_len
         self._rem_h[slot] = max_new_tokens
         self._eos_h[slot] = eos
         self._occupied[slot] = True
 
     def admit(self, slot: int, prompt, max_new_tokens: int, *,
-              rng=None, eos_id: int | None = None, tag=None) -> None:
+              rng=None, eos_id: int | None = None, tag=None,
+              tid: int = 0) -> None:
         """Prefill `prompt` ([P] or [1, P]) and scatter it into `slot`,
         while every other slot's state stays put. `rng` seeds this
         REQUEST's sampling stream — an integer seed or the exact key a
@@ -1091,11 +1169,12 @@ class SlotEngine:
         is unchanged."""
         if self.prefill_chunk is not None:
             self.start_prefill(slot, prompt, max_new_tokens, rng=rng,
-                               eos_id=eos_id, tag=tag)
+                               eos_id=eos_id, tag=tag, tid=tid)
             while not self.prefill_step(slot):
                 pass
             return
         prompt = self._validate_admit(slot, prompt, max_new_tokens, rng)
+        self._check_tid(tid)
         p_len = prompt.shape[1]
         # host-side prompt prep (the eager-jnp equivalent costs ~6 tiny
         # device dispatches per ADMISSION — measured to be a third of
@@ -1109,13 +1188,13 @@ class SlotEngine:
             logits1, caches1 = self._sfns.prefill(self._params, padded,
                                                   np.int32(p_len))
             self._insert(slot, caches1, logits1, p_len, max_new_tokens,
-                         eos_id, rng)
+                         eos_id, rng, tid)
 
     # -- chunked prefill --------------------------------------------------
 
     def start_prefill(self, slot: int, prompt, max_new_tokens: int, *,
                       rng=None, eos_id: int | None = None,
-                      tag=None) -> None:
+                      tag=None, tid: int = 0) -> None:
         """Reserve `slot` and register a chunked prefill for `prompt`
         WITHOUT dispatching anything: each later `prefill_step(slot)`
         runs exactly one chunk (the scheduler interleaves one per decode
@@ -1127,9 +1206,10 @@ class SlotEngine:
         if self.prefill_chunk is None:
             raise RuntimeError("engine built without prefill_chunk")
         prompt = self._validate_admit(slot, prompt, max_new_tokens, rng)
+        self._check_tid(tid)
         if self.paged:
             self._start_prefill_paged(slot, prompt, max_new_tokens,
-                                      rng, eos_id, tag)
+                                      rng, eos_id, tag, tid)
             return
         start, caches, logits = 0, None, None
         if self.prefix_cache is not None:
@@ -1140,7 +1220,7 @@ class SlotEngine:
         self._prefills[slot] = _PendingPrefill(
             prompt=prompt, budget=int(max_new_tokens), rng=rng,
             eos_id=eos_id, caches=caches, logits=logits,
-            next_start=start, tag=tag)
+            next_start=start, tag=tag, tid=tid)
 
     def _pages_for(self, p_len: int, budget: int) -> int:
         """Pages an admission reserves: the prompt plus the decode
@@ -1150,6 +1230,17 @@ class SlotEngine:
                else min(budget, self.kv_decode_reserve))
         tokens = min(p_len + eff, self.t_max)
         return -(-tokens // self.kv_page_size)
+
+    def pages_for_admission(self, p_len: int, budget: int) -> int:
+        """Pages an admission of (p_len, budget) would reserve — 0 on
+        contiguous engines. The scheduler's per-tenant page-budget
+        accounting unit (serve/tenancy.py): exact under the default
+        full-budget decode reserve, the admission-time floor under an
+        optimistic `kv_decode_reserve` (mid-decode grant growth is not
+        re-charged — documented in docs/MULTITENANCY.md)."""
+        if not self.paged:
+            return 0
+        return self._pages_for(p_len, budget)
 
     def can_admit_pages(self, p_len: int, budget: int) -> bool:
         """The scheduler's page-aware admission gate: True when pages
@@ -1198,7 +1289,7 @@ class SlotEngine:
                                                np.int32(src), dst)
 
     def _start_prefill_paged(self, slot, prompt, max_new_tokens, rng,
-                             eos_id, tag) -> None:
+                             eos_id, tag, tid=0) -> None:
         """Paged admission: grant pages for prompt + reservation (the
         prefix-cache hit contributes its pages SHARED — refcounted,
         read-only, zero-copy), write the slot's page-table row, and
@@ -1237,7 +1328,7 @@ class SlotEngine:
             prompt=prompt, budget=int(max_new_tokens), rng=rng,
             eos_id=eos_id, caches=None, logits=logits,
             next_start=start, tag=tag, pages=pages,
-            shared=len(shared))
+            shared=len(shared), tid=tid)
 
     def prefill_step(self, slot: int) -> bool:
         """Advance `slot`'s pending prefill by ONE chunk dispatch;
@@ -1302,7 +1393,7 @@ class SlotEngine:
                 self._stamp_decode_scales(pend.pages[n_prompt:],
                                           pend.pages[n_prompt - 1])
             self._insert(slot, pend.caches, pend.logits, p_len,
-                         pend.budget, pend.eos_id, pend.rng)
+                         pend.budget, pend.eos_id, pend.rng, pend.tid)
         return done
 
     def cancel_prefill(self, slot: int) -> None:
@@ -1342,12 +1433,13 @@ class SlotEngine:
              self._rem) = self._efns.window(
                 self._params, self._caches, self._pt, self._logits,
                 self._kd, self._pos, self._rem, self._eos,
-                self._scales, n_steps)
+                self._scales, self._adapters, self._tslot, n_steps)
         else:
             (toks, self._caches, self._logits, self._kd, self._pos,
              self._rem) = self._efns.window(
                 self._params, self._caches, self._logits, self._kd,
-                self._pos, self._rem, self._eos, self._scales, n_steps)
+                self._pos, self._rem, self._eos, self._scales,
+                self._adapters, self._tslot, n_steps)
         self._pending = (toks, snapshot)
 
     def spec_room(self, slot: int) -> bool:
@@ -1460,13 +1552,14 @@ class SlotEngine:
              self._pos, self._rem) = self._efns.verify(
                 self._params, self._caches, self._pt, self._logits,
                 self._kd, self._pos, self._rem, self._eos,
-                self._scales, drafts, vlive)
+                self._scales, self._adapters, self._tslot, drafts,
+                vlive)
         else:
             (toks, n_emit, n_acc, self._caches, self._logits, self._kd,
              self._pos, self._rem) = self._efns.verify(
                 self._params, self._caches, self._logits, self._kd,
-                self._pos, self._rem, self._eos, self._scales, drafts,
-                vlive)
+                self._pos, self._rem, self._eos, self._scales,
+                self._adapters, self._tslot, drafts, vlive)
         self._pending = (toks, snapshot, (n_emit, n_acc, vlive,
                                           proposed))
 
@@ -1643,16 +1736,17 @@ class SlotEngine:
                     self._efns.window.lower(
                         self._params, self._caches, self._pt,
                         self._logits, self._kd, self._pos, self._rem,
-                        self._eos, self._scales, window).compile())
+                        self._eos, self._scales, self._adapters,
+                        self._tslot, window).compile())
                 out["serve.insert_paged"] = prof.register_program(
                     "serve.insert_paged",
                     self._efns.insert.lower(
                         self._logits, self._kd, self._pos, self._rem,
-                        self._eos,
+                        self._eos, self._tslot,
                         jnp.zeros((1, self._logits.shape[1]),
                                   self._logits.dtype),
                         np.int32(0), np.int32(0), np.int32(0),
-                        np.int32(-1),
+                        np.int32(-1), np.int32(0),
                         np.zeros(2, np.uint32)).compile())
                 c = self.prefill_chunk
                 out["serve.prefill_chunk_paged"] = prof.register_program(
@@ -1669,6 +1763,7 @@ class SlotEngine:
                             self._params, self._caches, self._pt,
                             self._logits, self._kd, self._pos,
                             self._rem, self._eos, self._scales,
+                            self._adapters, self._tslot,
                             np.zeros((self.n_slots, self.draft_k),
                                      np.int32),
                             np.zeros(self.n_slots, bool)).compile())
@@ -1678,7 +1773,7 @@ class SlotEngine:
                 self._efns.window.lower(
                     self._params, self._caches, self._logits, self._kd,
                     self._pos, self._rem, self._eos, self._scales,
-                    window).compile())
+                    self._adapters, self._tslot, window).compile())
             if self.prefill_chunk is not None:
                 c = self.prefill_chunk
                 caches1 = self._sfns.init_caches(1)
@@ -1705,7 +1800,7 @@ class SlotEngine:
                     self._efns.verify.lower(
                         self._params, self._caches, self._logits,
                         self._kd, self._pos, self._rem, self._eos,
-                        self._scales,
+                        self._scales, self._adapters, self._tslot,
                         np.zeros((self.n_slots, self.draft_k),
                                  np.int32),
                         np.zeros(self.n_slots, bool)).compile())
@@ -1762,16 +1857,19 @@ class SlotEngine:
         for _ in range(2):
             if self.paged:
                 (self._logits, self._kd, self._pos, self._rem,
-                 self._eos) = self._efns.insert(
+                 self._eos, self._tslot) = self._efns.insert(
                     self._logits, self._kd, self._pos, self._rem,
-                    self._eos, logits1, np.int32(0), np.int32(1),
-                    np.int32(0), np.int32(-1), np.zeros(2, np.uint32))
+                    self._eos, self._tslot, logits1, np.int32(0),
+                    np.int32(1), np.int32(0), np.int32(-1),
+                    np.int32(0), np.zeros(2, np.uint32))
             else:
                 (self._caches, self._logits, self._kd, self._pos,
-                 self._rem, self._eos, self._scales) = self._efns.insert(
+                 self._rem, self._eos, self._tslot,
+                 self._scales) = self._efns.insert(
                     self._caches, self._logits, self._kd, self._pos,
-                    self._rem, self._eos, self._scales, caches1, logits1,
-                    np.int32(0), np.int32(1), np.int32(0), np.int32(-1),
+                    self._rem, self._eos, self._tslot, self._scales,
+                    caches1, logits1, np.int32(0), np.int32(1),
+                    np.int32(0), np.int32(-1), np.int32(0),
                     np.zeros(2, np.uint32))
             self.step_window(n_steps)
             if self.draft_k is not None:

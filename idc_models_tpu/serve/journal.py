@@ -68,12 +68,19 @@ class RequestJournal:
         clock time, which is meaningless to a recovering process)."""
         seed = (int(entry.rng)
                 if isinstance(entry.rng, (int, np.integer)) else None)
+        # the tenant tag travels the WAL so a recovered request bills
+        # the SAME tenant (quota + adapter) on the rebuilt server;
+        # written only when tagged, so tenant-less journals stay
+        # byte-identical to every file this format ever wrote
+        tkw = ({"tenant": entry.tenant}
+               if getattr(entry, "tenant", None) is not None else {})
         self._logger.log(
             event="journal_submit", id=entry.rid,
             prompt=[int(t) for t in
                     np.asarray(entry.prompt).reshape(-1)],
             max_new_tokens=int(entry.budget), eos_id=entry.eos_id,
-            seed=seed, deadline_s=deadline_s, trace_id=entry.trace_id)
+            seed=seed, deadline_s=deadline_s, trace_id=entry.trace_id,
+            **tkw)
 
     def record_progress(self, tokens_by_rid: dict) -> None:
         """One batched progress record for every request that emitted
@@ -156,7 +163,8 @@ def load_journal(path) -> dict:
             max_new_tokens=int(rec["max_new_tokens"]),
             eos_id=rec.get("eos_id"), seed=rec.get("seed"),
             deadline_s=rec.get("deadline_s"),
-            trace_id=rec.get("trace_id")))
+            trace_id=rec.get("trace_id"),
+            tenant=rec.get("tenant")))
     return {"pending": pending, "finished": finished,
             "progress": progress}
 

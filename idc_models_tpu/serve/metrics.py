@@ -45,9 +45,17 @@ class ServingMetrics:
     """
 
     def __init__(self, logger=None, prefix_cache=None, registry=None,
-                 slo=None):
+                 slo=None, tenancy=None):
         self.logger = logger
         self.slo = slo
+        # tenancy (serve/tenancy.py, ISSUE 14): when armed, the hooks
+        # also maintain tenant-labeled series (every registration
+        # carries the tenant label — enforced by the static scan), a
+        # per-tenant rollup under summary()["serve_tenants"], and the
+        # NEW serve_tenant_* jsonl events (frozen from day one; every
+        # historical event schema stays byte-identical). TTFT samples
+        # feed the tenant's own ttft:<name> SLO objective.
+        self.tenancy = tenancy
         # when a PrefixCache is attached its serve_prefix_* counters
         # roll into summary() next to the serving fields
         self.prefix_cache = prefix_cache
@@ -134,6 +142,47 @@ class ServingMetrics:
             "serve_page_exhaustions_total",
             "cycles the paged engine refused work for lack of free "
             "pages (admission gate or mid-decode growth)")
+        # tenant-labeled instruments, registered only when tenancy is
+        # armed so tenant-less servers' registries stay byte-identical
+        # (the /metrics exposition equality gates)
+        if tenancy is not None:
+            self._m_t_requests = reg.counter(
+                "serve_tenant_requests_total",
+                "requests by tenant and terminal outcome",
+                labels=("tenant", "status"))
+            self._m_t_tokens = reg.counter(
+                "serve_tenant_tokens_emitted_total",
+                "decode tokens emitted per tenant", labels=("tenant",))
+            self._m_t_ttft = reg.histogram(
+                "serve_tenant_ttft_seconds",
+                "submit -> first token per tenant", labels=("tenant",))
+            self._m_t_queue = reg.gauge(
+                "serve_tenant_queue_depth",
+                "admission-queue entries each tenant holds (last "
+                "cycle)", labels=("tenant",))
+            self._m_t_slots = reg.gauge(
+                "serve_tenant_slots_used",
+                "decode slots (running + prefilling) each tenant "
+                "holds (last cycle)", labels=("tenant",))
+            self._m_t_pages = reg.gauge(
+                "serve_tenant_kv_pages_used",
+                "KV pool pages each tenant's admissions have reserved "
+                "(last cycle; paged engines)", labels=("tenant",))
+            self._m_t_shed = reg.counter(
+                "serve_tenant_shed_total",
+                "submits refused by the tenant's own brownout shed "
+                "stage", labels=("tenant",))
+            self._m_t_quota = reg.counter(
+                "serve_tenant_quota_rejections_total",
+                "submits refused by a per-tenant quota, by quota kind",
+                labels=("tenant", "kind"))
+        # per-tenant rollup (all keyed by tenant name; empty dicts
+        # when tenancy is off)
+        self.tenant_ttft_s: dict[str, list] = {}
+        self.tenant_finished: dict[str, int] = {}
+        self.tenant_tokens: dict[str, int] = {}
+        self.tenant_shed: dict[str, int] = {}
+        self.tenant_quota_rejections: dict[str, int] = {}
         self._jit_cache_seen: int | None = None
         self.compiles_observed = 0
         # paged-KV rollup (all zero/None on contiguous engines)
@@ -177,7 +226,7 @@ class ServingMetrics:
 
     # -- request lifecycle ----------------------------------------------
 
-    def on_submit(self, rid, t: float) -> None:
+    def on_submit(self, rid, t: float, *, tenant=None) -> None:
         self.submitted += 1
         if self._t_first is None:
             self._t_first = t
@@ -203,10 +252,17 @@ class ServingMetrics:
             self.slo.observe("queue_wait", wait_s)
         self._log(event="serve_admit", id=rid, queue_wait_ms=wait_s * 1e3)
 
-    def on_first_token(self, rid, ttft_s: float) -> None:
+    def on_first_token(self, rid, ttft_s: float, *,
+                       tenant=None) -> None:
         self._m_ttft.observe(ttft_s)
         if self.slo is not None and self.slo.has("ttft"):
             self.slo.observe("ttft", ttft_s)
+        if tenant is not None and self.tenancy is not None:
+            # the tenant's own ttft:<name> objective — THE per-tenant
+            # admission/brownout signal (SLOEngine.breached)
+            self.tenancy.observe_ttft(tenant, ttft_s)
+            self._m_t_ttft.observe(ttft_s, tenant=tenant)
+            self.tenant_ttft_s.setdefault(tenant, []).append(ttft_s)
         self.ttft_s.append(ttft_s)
         wait = self._wait_by_rid.pop(rid, None)
         prefill = None if wait is None else max(ttft_s - wait, 0.0)
@@ -217,7 +273,8 @@ class ServingMetrics:
                   prefill_ms=None if prefill is None else prefill * 1e3)
 
     def on_finish(self, rid, *, n_tokens: int, ttft_s: float | None,
-                  decode_s: float, reason: str, t: float) -> None:
+                  decode_s: float, reason: str, t: float,
+                  tenant=None) -> None:
         # a request cancelled before its first token never reaches
         # on_first_token — drop its queue-wait entry here too or the
         # dict grows for the server's lifetime under deadline pressure
@@ -238,6 +295,20 @@ class ServingMetrics:
         self._log(event="serve_finish", id=rid, tokens=n_tokens,
                   reason=reason,
                   ttft_ms=None if ttft_s is None else ttft_s * 1e3)
+        if tenant is not None and self.tenancy is not None:
+            # the tenant-attributed finish is a NEW event type (frozen
+            # from day one), never a reshaped serve_finish — the
+            # historical schema stays byte-identical
+            self.tenant_finished[tenant] = (
+                self.tenant_finished.get(tenant, 0) + 1)
+            self.tenant_tokens[tenant] = (
+                self.tenant_tokens.get(tenant, 0) + n_tokens)
+            self._m_t_requests.inc(tenant=tenant, status=str(reason))
+            if n_tokens:
+                self._m_t_tokens.inc(n_tokens, tenant=tenant)
+            self._log(event="serve_tenant_finish", id=rid,
+                      tenant=tenant, tokens=n_tokens, reason=reason,
+                      ttft_ms=None if ttft_s is None else ttft_s * 1e3)
 
     # -- resilience ------------------------------------------------------
 
@@ -260,16 +331,49 @@ class ServingMetrics:
         self._log(event="serve_retry", id=rid, attempt=attempt,
                   delay_ms=delay_s * 1e3)
 
-    def on_shed(self, rid) -> None:
-        """A submit was refused by the brownout shed stage. Counted as
-        its own terminal outcome — deliberately NOT fed to the
-        error-rate SLO: shedding is the controller's intended action,
-        and scoring it as an error would make shedding beget more
-        shedding."""
+    def on_shed(self, rid, *, tenant=None) -> None:
+        """A submit was refused by the brownout shed stage (the
+        server-wide controller OR — `tenant` set with tenancy armed —
+        that tenant's own). Counted as its own terminal outcome —
+        deliberately NOT fed to the error-rate SLO: shedding is the
+        controller's intended action, and scoring it as an error
+        would make shedding beget more shedding."""
         self.shed += 1
         self._m_shed.inc()
         self._m_requests.inc(status="shed")
         self._log(event="serve_shed", id=rid)
+        if tenant is not None and self.tenancy is not None:
+            self.tenant_shed[tenant] = (
+                self.tenant_shed.get(tenant, 0) + 1)
+            self._m_t_shed.inc(tenant=tenant)
+            self._m_t_requests.inc(tenant=tenant, status="shed")
+            self._log(event="serve_tenant_shed", id=rid, tenant=tenant)
+
+    def on_tenant_quota(self, rid, *, tenant: str, kind: str) -> None:
+        """A submit was refused by a per-tenant quota (`kind` =
+        "queued" today; page/slot quotas block IN the queue instead of
+        refusing). Counted as a rejection for the aggregate figures
+        but — like shed — never fed to the error-rate SLO: the
+        refusal IS the isolation mechanism protecting the other
+        tenants, not the service failing."""
+        self.rejected += 1
+        self._m_requests.inc(status="rejected")
+        self.tenant_quota_rejections[tenant] = (
+            self.tenant_quota_rejections.get(tenant, 0) + 1)
+        self._m_t_quota.inc(tenant=tenant, kind=kind)
+        self._m_t_requests.inc(tenant=tenant, status="rejected")
+        self._log(event="serve_tenant_quota_reject", id=rid,
+                  tenant=tenant, kind=kind)
+
+    def on_tenant_cycle(self, names, *, depths: dict, slots: dict,
+                        pages: dict) -> None:
+        """Per-cycle tenant occupancy gauges — every registered tenant
+        gets an explicit point (zero included) so a tenant that just
+        drained reads 0, not its stale last value."""
+        for name in names:
+            self._m_t_queue.set(depths.get(name, 0), tenant=name)
+            self._m_t_slots.set(slots.get(name, 0), tenant=name)
+            self._m_t_pages.set(pages.get(name, 0), tenant=name)
 
     def on_clamp(self, rid, *, asked: int, clamp: int) -> None:
         """The brownout clamp shortened an admission's budget."""
@@ -488,6 +592,27 @@ class ServingMetrics:
                 else round(self.kv_tokens_per_byte_peak, 6)),
             "serve_page_exhaustions": self.page_exhaustions,
         }
+        if self.tenancy is not None:
+            # per-tenant rollup (additive key, ISSUE 14): one record
+            # per REGISTERED tenant — zeros included, so "tenant B was
+            # untouched by A's flood" is readable straight off the
+            # summary
+            out["serve_tenants"] = {
+                name: {
+                    "requests": self.tenant_finished.get(name, 0),
+                    "tokens": self.tenant_tokens.get(name, 0),
+                    "ttft_ms_p50": _r(
+                        _pct(self.tenant_ttft_s.get(name, []), 50),
+                        1e3),
+                    "ttft_ms_p95": _r(
+                        _pct(self.tenant_ttft_s.get(name, []), 95),
+                        1e3),
+                    "shed": self.tenant_shed.get(name, 0),
+                    "quota_rejections":
+                        self.tenant_quota_rejections.get(name, 0),
+                    "slo_breached": self.tenancy.breached(name),
+                }
+                for name in self.tenancy.names()}
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.summary())
         return out

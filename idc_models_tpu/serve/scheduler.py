@@ -103,6 +103,12 @@ class Entry:
     retried: bool = False
     not_before: float = 0.0
     clamped: bool = False            # brownout shortened the budget
+    # tenancy (serve/tenancy.py, ISSUE 14): the resolved tenant name
+    # (None = no tenancy armed), its engine gather index, and the
+    # admission-time page reservation the per-tenant KV budget charges
+    tenant: str | None = None
+    tid: int = 0
+    pages_reserved: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,6 +177,19 @@ class AdmissionQueue:
         its backoff."""
         self._q.appendleft(entry)
 
+    def entries(self) -> tuple:
+        """FIFO-order snapshot for the tenancy-aware admission scan: a
+        quota-blocked HEAD must not starve other tenants (the whole
+        point of per-tenant quotas), so admission may look past it —
+        FIFO order is preserved WITHIN each tenant because the scan
+        always takes the earliest admissible entry."""
+        return tuple(self._q)
+
+    def take(self, entry: Entry) -> None:
+        """Remove a specific entry the admission scan picked (identity
+        match — entries are identity-eq dataclasses)."""
+        self._q.remove(entry)
+
     def expire(self, now: float) -> list[Entry]:
         """Drop queued entries past their deadline (they never reach a
         slot); returns them for result bookkeeping."""
@@ -192,7 +211,7 @@ class Scheduler:
                  admit_after_collect: bool = True, clock=time.monotonic,
                  retry=None, fault_plan=None,
                  health_checks: bool | None = None, journal=None,
-                 brownout=None, drafter=None):
+                 brownout=None, drafter=None, tenancy=None):
         if window < 1:
             raise ValueError(f"need window >= 1, got {window}")
         self.engine = engine
@@ -221,6 +240,11 @@ class Scheduler:
         self.fault_plan = fault_plan
         self.journal = journal
         self.brownout = brownout
+        # tenancy (serve/tenancy.py): per-tenant quotas gate admission,
+        # per-tenant brownouts shed one tenant's flood while its
+        # neighbors stay normal, and each tenant's ttft:<name> SLO is
+        # evaluated once per cycle — the built Tenancy runtime
+        self.tenancy = tenancy
         if health_checks is None:
             health_checks = retry is not None or fault_plan is not None
         self.health_checks = bool(health_checks)
@@ -292,21 +316,57 @@ class Scheduler:
             entry.eos_id = self.engine.eos_id
         if entry.eos_id is not None and entry.eos_id < 0:
             entry.eos_id = None
+        tenant_bc = None
+        if self.tenancy is not None:
+            # an unknown tenant tag is a caller error taught loudly —
+            # silently billing the default tenant would charge one
+            # tenant's quota for another's traffic
+            t = self.tenancy.resolve(entry.tenant)
+            entry.tenant, entry.tid = t.name, t.tid
+            tenant_bc = self.tenancy.brownouts.get(t.name)
         entry.t_submit = self.clock()
         # brownout shed beats backpressure: an explicit, honest
         # refusal (Result.status == "shed") the client can act on,
         # recorded BEFORE the queue is consulted so shedding actually
-        # relieves the queue instead of racing it
-        if self.brownout is not None and self.brownout.shedding:
+        # relieves the queue instead of racing it. The TENANT's own
+        # controller sheds first: one tenant's flood refuses that
+        # tenant's submits while every other tenant stays normal.
+        shedding = self.brownout is not None and self.brownout.shedding
+        tenant_shed = tenant_bc is not None and tenant_bc.shedding
+        if shedding or tenant_shed:
             entry.status, entry.finish_reason = "shed", "shed"
             entry.t_done = entry.t_submit
             if entry.trace_id is None:
                 entry.trace_id = _next_trace_id()
+            kw = ({"tenant": entry.tenant}
+                  if entry.tenant is not None else {})
             trace.point("serve.shed", rid=entry.rid,
-                        trace_id=entry.trace_id)
+                        trace_id=entry.trace_id, **kw)
             if self.metrics:
-                self.metrics.on_shed(entry.rid)
+                # tenant attribution ONLY when the tenant's OWN
+                # controller shed it: billing a server-wide shed to
+                # the per-tenant "own brownout" counters would make a
+                # victim tenant read as degraded by its own flood
+                self.metrics.on_shed(
+                    entry.rid,
+                    tenant=entry.tenant if tenant_shed else None)
             return False
+        if self.tenancy is not None and entry.tenant is not None:
+            # per-tenant queue quota: refused WITHOUT touching the
+            # shared queue budget, so a flooding tenant cannot fill
+            # the FIFO other tenants admit from. Deliberately not fed
+            # to the error-rate SLO — like shed, the refusal IS the
+            # isolation mechanism working, and scoring it as an error
+            # would make protection look like failure. (On a tenancy-
+            # LESS server a request's tenant tag is inert bookkeeping
+            # — the cluster router still uses it for affinity.)
+            q = self.tenancy.quota(entry.tenant).max_queued
+            if q is not None and self._tenant_queued(entry.tenant) >= q:
+                entry.status = "rejected"
+                if self.metrics:
+                    self.metrics.on_tenant_quota(
+                        entry.rid, tenant=entry.tenant, kind="queued")
+                return False
         deadline_rel = entry.deadline
         if entry.deadline is not None:
             entry.deadline = entry.t_submit + entry.deadline
@@ -325,14 +385,55 @@ class Scheduler:
         # as a detached child closed at admission. Every span in the
         # chain carries rid, so one grep over the export reconstructs
         # the request's full timeline.
+        tkw = ({"tenant": entry.tenant}
+               if entry.tenant is not None else {})
         entry.span = trace.start_span("serve.request", rid=entry.rid,
-                                      trace_id=entry.trace_id)
+                                      trace_id=entry.trace_id, **tkw)
         entry.queue_span = trace.start_span(
             "serve.queued", parent=entry.span.span_id, rid=entry.rid,
             trace_id=entry.trace_id)
         if self.metrics:
-            self.metrics.on_submit(entry.rid, entry.t_submit)
+            self.metrics.on_submit(entry.rid, entry.t_submit,
+                                   tenant=entry.tenant)
         return True
+
+    def _tenant_queued(self, tenant: str) -> int:
+        """Queued entries a tenant holds right now — derived from the
+        queue itself (never an incrementally maintained counter, so
+        there is nothing to drift out of sync)."""
+        return sum(1 for e in self.queue.entries()
+                   if e.tenant == tenant)
+
+    def _page_gate(self, entry: Entry, eff: int) -> bool:
+        """The ONE page-aware admission gate both the FIFO-head path
+        and the tenancy scan consult: True when the paged engine can
+        grant pages for (prompt, effective budget) right now; on False
+        records the exhaustion backpressure (the brownout 'pages'
+        signal + the serve_page_exhausted event). Always True on
+        contiguous engines."""
+        can_admit = getattr(self.engine, "can_admit_pages", None)
+        if can_admit is None or can_admit(len(entry.prompt), eff):
+            return True
+        self._page_pressure = True
+        on_exh = getattr(self.metrics, "on_page_exhausted", None)
+        if on_exh is not None:
+            on_exh(rid=entry.rid,
+                   needed=len(entry.prompt) + entry.budget)
+        return False
+
+    def _tenant_residency(self) -> tuple[dict, dict]:
+        """(slots, pages) each tenant holds across running +
+        prefilling entries — derived on demand from the live tracking
+        dicts, O(n_slots), the per-tenant admission-quota ledger."""
+        slots: dict[str, int] = {}
+        pages: dict[str, int] = {}
+        for e in list(self._running.values()) + list(
+                self._prefilling.values()):
+            if e.tenant is None:
+                continue
+            slots[e.tenant] = slots.get(e.tenant, 0) + 1
+            pages[e.tenant] = pages.get(e.tenant, 0) + e.pages_reserved
+        return slots, pages
 
     def _admit_free_slots(self) -> int:
         """Pop queued entries into free slots, at most
@@ -346,7 +447,8 @@ class Scheduler:
         free = self.engine.free_slots()
         clamp = (self.brownout.token_clamp if self.brownout is not None
                  else None)
-        can_admit = getattr(self.engine, "can_admit_pages", None)
+        if self.tenancy is not None:
+            slots_used, pages_used = self._tenant_residency()
         while (admitted < self.max_prefills_per_cycle and free
                and len(self.queue)):
             # page-aware admission (paged engines): the HEAD request
@@ -355,7 +457,14 @@ class Scheduler:
             # (no skipping ahead of a starved head: that would starve
             # long requests forever); the exhaustion is recorded as
             # backpressure and feeds the brownout signal below.
-            if can_admit is not None:
+            # With TENANCY armed the scan may look past entries whose
+            # TENANT-LOCAL quota (resident slots, page budget) blocks
+            # them — a flooding tenant's backlog must not starve its
+            # neighbors, and FIFO holds within each tenant — but a
+            # GLOBAL page exhaustion still freezes the whole scan:
+            # skipping past it would starve long requests forever.
+            e = t_clamp = None
+            if self.tenancy is None:
                 head = self.queue.peek()
                 # gate on the EFFECTIVE budget: brownout stage 2 clamps
                 # it at admission below, and the clamp is exactly the
@@ -365,24 +474,59 @@ class Scheduler:
                 # unwedge it
                 eff = (head.budget if clamp is None
                        else min(head.budget, clamp))
-                if not can_admit(len(head.prompt), eff):
-                    self._page_pressure = True
-                    on_exh = getattr(self.metrics, "on_page_exhausted",
-                                     None)
-                    if on_exh is not None:
-                        on_exh(rid=head.rid,
-                               needed=len(head.prompt) + head.budget)
+                if not self._page_gate(head, eff):
                     break
-            e = self.queue.pop()
+                e = self.queue.pop()
+            else:
+                stop = False
+                for cand in self.queue.entries():
+                    quota = self.tenancy.quota(cand.tenant)
+                    if (quota.max_resident_slots is not None
+                            and slots_used.get(cand.tenant, 0)
+                            >= quota.max_resident_slots):
+                        continue         # tenant-local: skip, no HOL
+                    bc = self.tenancy.brownouts.get(cand.tenant)
+                    cand_clamp = (bc.token_clamp if bc is not None
+                                  else None)
+                    eff = cand.budget
+                    for c in (clamp, cand_clamp):
+                        if c is not None:
+                            eff = min(eff, c)
+                    need = self.engine.pages_for_admission(
+                        len(cand.prompt), eff)
+                    if (quota.kv_page_budget is not None
+                            and pages_used.get(cand.tenant, 0) + need
+                            > quota.kv_page_budget):
+                        continue         # tenant-local page budget:
+                        #                  waits for its own releases
+                    if not self._page_gate(cand, eff):
+                        stop = True      # GLOBAL exhaustion freezes
+                        break            # the scan — no skipping
+                    e, t_clamp = cand, cand_clamp
+                    break
+                if stop or e is None:
+                    break
+                self.queue.take(e)
             slot = free.pop(0)
-            if clamp is not None and e.budget > clamp:
-                # brownout stage 2: shorter answers for everyone beats
-                # no answers for some — recorded per request so the
-                # truncated budget is visible next to the finish
+            eff_clamp = clamp
+            if t_clamp is not None:
+                eff_clamp = (t_clamp if eff_clamp is None
+                             else min(eff_clamp, t_clamp))
+            if eff_clamp is not None and e.budget > eff_clamp:
+                # brownout stage 2 (server-wide AND/OR the tenant's
+                # own): shorter answers for everyone beats no answers
+                # for some — recorded per request so the truncated
+                # budget is visible next to the finish
                 if self.metrics:
                     self.metrics.on_clamp(e.rid, asked=e.budget,
-                                          clamp=clamp)
-                e.budget, e.clamped = clamp, True
+                                          clamp=eff_clamp)
+                e.budget, e.clamped = eff_clamp, True
+            if self.tenancy is not None:
+                e.pages_reserved = self.engine.pages_for_admission(
+                    len(e.prompt), e.budget)
+                slots_used[e.tenant] = slots_used.get(e.tenant, 0) + 1
+                pages_used[e.tenant] = (pages_used.get(e.tenant, 0)
+                                        + e.pages_reserved)
             eos = e.eos_id if e.eos_id is not None else -1
             e.slot, e.status, e.t_admit = slot, "running", self.clock()
             # registered BEFORE the engine call: if the engine raises
@@ -393,11 +537,11 @@ class Scheduler:
                 self._prefilling[slot] = e
                 self.engine.start_prefill(slot, e.prompt, e.budget,
                                           rng=e.rng, eos_id=eos,
-                                          tag=e.rid)
+                                          tag=e.rid, tid=e.tid)
             else:
                 self._running[slot] = e
                 self.engine.admit(slot, e.prompt, e.budget, rng=e.rng,
-                                  eos_id=eos, tag=e.rid)
+                                  eos_id=eos, tag=e.rid, tid=e.tid)
             # recorded only AFTER the engine accepted the request — an
             # admit that raises must not leave a phantom queue-wait
             # sample (and _wait_by_rid entry) behind
@@ -832,6 +976,25 @@ class Scheduler:
         if self.brownout is not None:
             self.brownout.evaluate(queue_depth=len(self.queue),
                                    pressure=page_pressure)
+        # per-tenant brownouts run every cycle like the global one
+        # (drain ticks included — recovery hysteresis needs to watch
+        # each tenant's queue empty out), each fed only ITS tenant's
+        # queue depth and ttft:<name> SLO; one tenant escalating
+        # leaves its neighbors' controllers at normal (gated by test)
+        if self.tenancy is not None:
+            depths: dict[str, int] = {}
+            for e in self.queue.entries():
+                if e.tenant is not None:
+                    depths[e.tenant] = depths.get(e.tenant, 0) + 1
+            for name, bc in self.tenancy.brownouts.items():
+                bc.evaluate(queue_depth=depths.get(name, 0))
+            self.tenancy.evaluate()
+            if self.metrics:
+                slots_used, pages_used = self._tenant_residency()
+                on_tc = getattr(self.metrics, "on_tenant_cycle", None)
+                if on_tc is not None:
+                    on_tc(self.tenancy.names(), depths=depths,
+                          slots=slots_used, pages=pages_used)
         if (self._running or admitted or chunk_steps) and self.metrics:
             self.metrics.on_cycle(queue_depth=len(self.queue),
                                   occupancy=occupancy, tokens=emitted,
@@ -941,7 +1104,9 @@ class Scheduler:
                     rid=e.rid,
                     ttft_ms=round((t_now - e.t_submit) * 1e3, 3))
                 if self.metrics:
-                    self.metrics.on_first_token(e.rid, t_now - e.t_submit)
+                    self.metrics.on_first_token(e.rid,
+                                                t_now - e.t_submit,
+                                                tenant=e.tenant)
             e.tokens.extend(toks)
             emitted += len(toks)
             if progress is not None and toks:
@@ -1018,4 +1183,5 @@ class Scheduler:
             self.metrics.on_finish(
                 e.rid, n_tokens=len(e.tokens), ttft_s=ttft,
                 decode_s=decode_s,
-                reason=(e.finish_reason or e.status), t=e.t_done)
+                reason=(e.finish_reason or e.status), t=e.t_done,
+                tenant=e.tenant)

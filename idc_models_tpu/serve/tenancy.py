@@ -1,0 +1,356 @@
+"""Multi-tenant serving: N tenants resident on ONE engine — shared
+base model, per-tenant adapter deltas, per-tenant quotas, SLOs, and
+brownout stages (ROADMAP item 5a).
+
+The deployment story the paper implies — many hospitals/clients served
+by one trained service — needs several tenants' models resident at
+once without N forked engines. Two shapes exist:
+
+- **Full checkpoint per tenant**: build one `LMServer` per tenant
+  (serve/cluster already routes across servers). Right when tenants'
+  models genuinely differ (different architectures, deltas that touch
+  attention/MLP weights) — and priced accordingly: N copies of
+  params + KV + compiled programs. docs/MULTITENANCY.md spells out
+  when this is still the better trade.
+- **Shared base + per-tenant adapter deltas** (this module, the
+  S-LoRA/Punica-shaped path): ONE parameter tree, one KV pool, one
+  set of compiled programs; each tenant optionally carries a low-rank
+  HEAD adapter, and a mixed-tenant decode batch stays ONE dispatch —
+  the engine gathers each slot's tenant delta by a traced `[n_slots]`
+  tenant-index array inside the fused window/verify programs, so
+  tenant arrival patterns are VALUES, not shapes, and compile nothing
+  (gated by test).
+
+**Adapter semantics** (the one deliberate design decision here): a
+tenant's adapter is a LOGIT-SPACE low-rank delta — effective logits =
+`logits + (logits @ U_t) @ V_t`, i.e. an effective head
+`W(I + U_t V_t)` — applied at SAMPLING time inside the fused
+window/verify programs (`models/lm.make_adapter_head_hook`, the one
+definition both programs share). Because the delta is a pure function
+of the BASE logits, every piece of stored state stays tenant-agnostic:
+prefill programs are unchanged, the engine's per-slot logits state
+holds base logits, and prefix-cache snapshots (K/V + boundary logits)
+remain shareable across tenants — a hospital's system prompt prefills
+once for everyone, with zero cross-tenant state. An adapter that must
+touch attention/MLP projections cannot take this form; that is the
+full-checkpoint-per-tenant boundary (docs/MULTITENANCY.md).
+
+**Isolation** (the noisy-neighbor story): per-tenant quotas — resident
+slots, queued requests, KV pages — are enforced at admission by the
+scheduler; a tenant's TTFT SLO (`observe/slo.py` burn-rate alerting,
+objective name ``ttft:<tenant>``) drives that tenant's OWN brownout
+controller, so a flooding tenant clamps and then sheds while its
+neighbors stay at stage ``normal``. `SLOEngine.breached("ttft:<t>")`
+is exactly the admission signal PR 7 built it to be.
+
+Cross-tenant discipline: every accessor on this module's classes takes
+ONE tenant and reads only that tenant's state; the few methods that
+legitimately see all tenants (registration, the stacked-adapter build,
+fleet rollups) are enumerated in
+`tests/test_static_robustness.TENANCY_CROSS_TENANT_ALLOWLIST` and the
+AST scan fails on any new cross-tenant read outside it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant admission bounds; None = unlimited on that axis.
+
+    - `max_resident_slots`: decode slots (running + prefilling) the
+      tenant may hold at once — the floor other tenants keep under a
+      flood.
+    - `max_queued`: admission-queue entries; beyond it the tenant's
+      submits are refused (status ``rejected``) without touching the
+      shared queue budget. Doubles as the tenant brownout's queue
+      watermark.
+    - `kv_page_budget`: KV pool pages the tenant's ADMISSION
+      reservations may hold (paged engines; exact under the default
+      full-budget decode reserve — mid-decode grant growth under an
+      optimistic `kv_decode_reserve` is not re-charged, documented in
+      docs/MULTITENANCY.md).
+    """
+
+    max_resident_slots: int | None = None
+    max_queued: int | None = None
+    kv_page_budget: int | None = None
+
+    def __post_init__(self):
+        for field in ("max_resident_slots", "max_queued",
+                      "kv_page_budget"):
+            v = getattr(self, field)
+            if v is not None and (not isinstance(v, int) or v < 1):
+                raise ValueError(
+                    f"TenantQuota.{field} must be None (unlimited) or "
+                    f"an int >= 1, got {v!r} — a quota of 0 would "
+                    f"admit nothing ever; unregister the tenant "
+                    f"instead")
+
+
+@dataclasses.dataclass(frozen=True)
+class Tenant:
+    """One registered tenant: stable integer id (the engine's gather
+    index), quota, optional adapter factors, optional TTFT SLO."""
+
+    name: str
+    tid: int
+    quota: TenantQuota
+    adapter: tuple | None = None         # (u [V, r], v [r, V]) host
+    slo_ttft_p95_ms: float | None = None
+
+    @property
+    def slo_name(self) -> str | None:
+        return (f"ttft:{self.name}"
+                if self.slo_ttft_p95_ms is not None else None)
+
+
+class TenantRegistry:
+    """Declarative tenant set: `register(...)` each tenant, then
+    `build(...)` once into the runtime `Tenancy` the server wires in.
+    The FIRST registered tenant is the default for untagged requests
+    (override with ``default=`` at construction)."""
+
+    def __init__(self, *, default: str | None = None):
+        self._tenants: dict[str, Tenant] = {}
+        self._default = default
+        self._built = False
+
+    def register(self, name: str, *, adapter=None, quota=None,
+                 slo_ttft_p95_ms: float | None = None) -> Tenant:
+        """Add one tenant. `adapter` is an optional `(u, v)` pair of
+        low-rank logit-adapter factors with shapes ``[V, r]`` /
+        ``[r, V]`` (every registered adapter must agree on both V and
+        r — the engine stacks them into one gather table);
+        `slo_ttft_p95_ms` declares the tenant's TTFT p95 objective
+        (burn-rate alerted, and the tenant's brownout trigger)."""
+        if self._built:
+            raise ValueError(
+                "TenantRegistry is already built — tenants register "
+                "before build(); a running server's tenant set is "
+                "fixed (rebuild the server to change it)")
+        if not isinstance(name, str) or not name:
+            raise ValueError(f"tenant name must be a non-empty string, "
+                             f"got {name!r}")
+        if name in self._tenants:
+            raise ValueError(
+                f"tenant {name!r} is already registered — tenant "
+                f"names are identities; re-registering would silently "
+                f"replace its adapter/quota")
+        if quota is None:
+            quota = TenantQuota()
+        elif not isinstance(quota, TenantQuota):
+            raise ValueError(f"quota must be a TenantQuota, got "
+                             f"{type(quota).__name__}")
+        if slo_ttft_p95_ms is not None and slo_ttft_p95_ms <= 0:
+            raise ValueError(f"slo_ttft_p95_ms must be > 0, got "
+                             f"{slo_ttft_p95_ms}")
+        if adapter is not None:
+            adapter = self._check_adapter(name, adapter)
+        t = Tenant(name=name, tid=len(self._tenants), quota=quota,
+                   adapter=adapter, slo_ttft_p95_ms=slo_ttft_p95_ms)
+        self._tenants[name] = t
+        return t
+
+    def _check_adapter(self, name: str, adapter) -> tuple:
+        """Shape discipline at REGISTRATION (build re-checks against
+        the model's vocab): (u [V, r], v [r, V]) with one (V, r)
+        across every tenant — the stacked gather table needs one
+        shape."""
+        try:
+            u, v = adapter
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"tenant {name!r}: adapter must be a (u, v) pair of "
+                f"arrays with shapes [V, r] and [r, V], got "
+                f"{type(adapter).__name__}") from None
+        u = np.asarray(u, np.float32)
+        v = np.asarray(v, np.float32)
+        if u.ndim != 2 or v.ndim != 2 or u.shape[::-1] != v.shape:
+            raise ValueError(
+                f"tenant {name!r}: adapter shapes must be u [V, r] "
+                f"and v [r, V] (transposes of each other), got "
+                f"{u.shape} / {v.shape}")
+        for other in self._tenants.values():
+            if other.adapter is None:
+                continue
+            ou = other.adapter[0]
+            if ou.shape != u.shape:
+                raise ValueError(
+                    f"tenant {name!r}: adapter shape {u.shape} != "
+                    f"tenant {other.name!r}'s {ou.shape} — every "
+                    f"tenant's adapter must share one [V, r] so the "
+                    f"engine can stack them into a single slot-"
+                    f"indexed gather table (pad the rank or register "
+                    f"a zero adapter)")
+            break
+        return (u, v)
+
+    def names(self) -> list[str]:
+        """Registration order — tid order by construction."""
+        return list(self._tenants)
+
+    def build(self, *, vocab: int | None = None, logger=None,
+              registry=None, clock=time.monotonic,
+              slo_short_window_s: float = 60.0,
+              slo_burn_threshold: float = 2.0,
+              slo_min_samples: int = 10,
+              brownout_dwell_s: float = 0.25,
+              brownout_clear_s: float = 1.0,
+              brownout_clamp_tokens: int = 8) -> "Tenancy":
+        """Freeze the tenant set into the runtime `Tenancy`: the
+        stacked adapter bank (validated against the model's `vocab`
+        when given), one SLOEngine holding every tenant's
+        ``ttft:<name>`` objective, and one brownout controller per
+        tenant that declared an SLO or a queue quota (tenants with
+        neither never shed — nothing could ever signal)."""
+        from idc_models_tpu.observe.slo import SLO, SLOEngine
+        from idc_models_tpu.serve.brownout import BrownoutController
+
+        if not self._tenants:
+            raise ValueError("TenantRegistry.build() with no tenants "
+                             "registered — register at least one")
+        if self._default is not None and self._default not in self._tenants:
+            raise ValueError(
+                f"default tenant {self._default!r} is not registered "
+                f"(registered: {self.names()})")
+        self._built = True
+        bank = None
+        with_adapter = [t for t in self._tenants.values()
+                        if t.adapter is not None]
+        if with_adapter:
+            V, r = with_adapter[0].adapter[0].shape
+            if vocab is not None and V != vocab:
+                raise ValueError(
+                    f"adapter vocab dim {V} != model vocab {vocab} — "
+                    f"the logit-space adapter maps [V] -> [V] for "
+                    f"THIS model's head")
+            u = np.zeros((len(self._tenants), V, r), np.float32)
+            v = np.zeros((len(self._tenants), r, V), np.float32)
+            for t in self._tenants.values():
+                if t.adapter is not None:
+                    u[t.tid], v[t.tid] = t.adapter
+            # adapter-less tenants keep zero rows: their delta is
+            # exactly zero, so they decode the base model through the
+            # same gathered program
+            bank = AdapterBank(u=u, v=v, rank=r, vocab=V)
+        slo = None
+        objectives = [SLO.latency(t.slo_name,
+                                  threshold_s=t.slo_ttft_p95_ms / 1e3)
+                      for t in self._tenants.values()
+                      if t.slo_ttft_p95_ms is not None]
+        if objectives:
+            slo = SLOEngine(
+                objectives, short_window_s=slo_short_window_s,
+                long_window_s=5.0 * slo_short_window_s,
+                burn_threshold=slo_burn_threshold,
+                min_samples=slo_min_samples, logger=logger,
+                registry=registry, clock=clock)
+        brownouts = {}
+        for t in self._tenants.values():
+            if t.slo_name is None and t.quota.max_queued is None:
+                continue
+            # the brownout watermark sits BELOW the hard max_queued
+            # quota: at the quota itself submits are already refused,
+            # so the queue can never reach it after an admission and
+            # a watermark there would never fire (found by drill)
+            qh = (None if t.quota.max_queued is None
+                  else max((3 * t.quota.max_queued) // 4, 1))
+            brownouts[t.name] = BrownoutController(
+                slo=slo if t.slo_name is not None else None,
+                slo_name=t.slo_name,
+                queue_high=qh,
+                clamp_tokens=brownout_clamp_tokens,
+                escalate_dwell_s=brownout_dwell_s,
+                clear_after_s=brownout_clear_s, logger=logger,
+                registry=registry, clock=clock, tenant=t.name)
+        default = self._default or next(iter(self._tenants))
+        return Tenancy(dict(self._tenants), default=default, bank=bank,
+                       slo=slo, brownouts=brownouts)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterBank:
+    """The stacked per-tenant adapter factors the engine gathers from:
+    ``u [T, V, r]`` / ``v [T, r, V]`` host float32 (the engine places
+    them replicated on its mesh once). Tenants without an adapter hold
+    zero rows — their gathered delta is exactly zero."""
+
+    u: np.ndarray
+    v: np.ndarray
+    rank: int
+    vocab: int
+
+
+class Tenancy:
+    """The built runtime the server wires through engine, scheduler,
+    and metrics. Frozen tenant set; all lookups are by ONE tenant
+    name (the cross-tenant scan discipline — see the module
+    docstring)."""
+
+    def __init__(self, tenants: dict[str, Tenant], *, default: str,
+                 bank: AdapterBank | None, slo, brownouts: dict):
+        self._tenants = tenants
+        self.default = default
+        self.bank = bank
+        self.slo = slo
+        self.brownouts = brownouts
+
+    def resolve(self, name: str | None) -> Tenant:
+        """The tenant a request tag names (None = the default). An
+        unknown tag is a caller error, taught loudly — silently
+        lumping it into the default would charge one tenant's quota
+        for another's traffic."""
+        if name is None:
+            name = self.default
+        t = self._tenants.get(name)
+        if t is None:
+            raise ValueError(
+                f"unknown tenant {name!r} (registered: "
+                f"{self.names()}) — requests carry tenant= tags that "
+                f"must name a registered tenant")
+        return t
+
+    def names(self) -> list[str]:
+        return list(self._tenants)
+
+    def n_tenants(self) -> int:
+        return len(self._tenants)
+
+    def quota(self, name: str) -> TenantQuota:
+        return self.resolve(name).quota
+
+    def brownout(self, name: str):
+        """The tenant's own brownout controller (None when the tenant
+        declared neither an SLO nor a queue quota)."""
+        return self.brownouts.get(self.resolve(name).name)
+
+    def breached(self, name: str) -> bool:
+        """The per-tenant admission signal — `SLOEngine.breached` on
+        the tenant's ``ttft:<name>`` objective (False when the tenant
+        declared no SLO): True while the tenant's TTFT burn-rate
+        alert is active."""
+        t = self.resolve(name)
+        if self.slo is None or t.slo_name is None:
+            return False
+        return self.slo.breached(t.slo_name)
+
+    def observe_ttft(self, name: str, ttft_s: float) -> None:
+        """Feed one TTFT sample into the tenant's objective (no-op for
+        tenants without one) — called by the serving metrics hooks."""
+        t = self.resolve(name)
+        if self.slo is not None and t.slo_name is not None:
+            self.slo.observe(t.slo_name, ttft_s)
+
+    def evaluate(self) -> None:
+        """One burn-rate evaluation over every tenant objective —
+        the scheduler calls this once per cycle (the SLOEngine
+        evaluates all its objectives in one pass; per-tenant iteration
+        lives inside it, not here)."""
+        if self.slo is not None:
+            self.slo.evaluate()

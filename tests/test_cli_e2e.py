@@ -847,3 +847,69 @@ def test_cli_serve_faulted_lifecycle_and_journal_recovery(tmp_path,
         cli.main(["serve", *model, "--serve-faults", "meteor:1"])
     with pytest.raises(SystemExit):
         cli.main(["serve", *model, "--max-retries", "-1"])
+
+
+def test_cli_serve_tenants_e2e(tmp_path, capsys):
+    """ISSUE-14: the multi-tenant serve verb end to end — round-robin
+    tenant tagging, per-tenant quota + TTFT SLO wiring, per-tenant
+    epilogue lines, the serve_tenants summary rollup, and the tenant
+    events in the run jsonl."""
+    import json
+
+    out = _run([
+        "serve", "--path", str(tmp_path), "--requests", "10",
+        "--t-max", "32", "--vocab", "12", "--embed-dim", "16",
+        "--num-heads", "2", "--mlp-dim", "32", "--num-blocks", "1",
+        "--slots", "3", "--window", "4",
+        "--tenants", "acme,globex",
+        "--tenant-quota", "acme=2:6:-",
+        "--tenant-slo-ttft-ms", "acme=200"], capsys)
+    assert "tenant acme:" in out and "tenant globex:" in out
+    assert "brownout_max_stage=" in out and "slo_alerts=" in out
+    summary = json.loads(
+        [ln for ln in out.splitlines()
+         if ln.startswith("serve summary:")][0].split(":", 1)[1])
+    tenants = summary["serve_tenants"]
+    assert set(tenants) == {"acme", "globex"}
+    assert tenants["acme"]["requests"] == 5
+    assert tenants["globex"]["requests"] == 5
+    recs = [json.loads(ln) for ln in
+            open(tmp_path / "logs" / "serve.jsonl")]
+    tenant_fin = [r for r in recs
+                  if r.get("event") == "serve_tenant_finish"]
+    assert len(tenant_fin) == 10
+    assert {r["tenant"] for r in tenant_fin} == {"acme", "globex"}
+
+
+def test_cli_serve_tenant_usage_errors(capsys):
+    """ISSUE-14: every bad tenancy knob dies as a TEACHING usage error
+    that states the grammar — never a traceback."""
+    base = ["serve", "--requests", "1", "--t-max", "32"]
+    with pytest.raises(SystemExit, match="--tenant-quota needs "
+                                         "--tenants"):
+        cli.main(base + ["--tenant-quota", "a=2"])
+    with pytest.raises(SystemExit, match="--tenant-slo-ttft-ms needs"):
+        cli.main(base + ["--tenant-slo-ttft-ms", "250"])
+    with pytest.raises(SystemExit, match="duplicate tenant"):
+        cli.main(base + ["--tenants", "a,a"])
+    with pytest.raises(SystemExit, match="empty tenant name"):
+        cli.main(base + ["--tenants", "a,,b"])
+    with pytest.raises(SystemExit, match="unknown tenant 'ghost'"):
+        cli.main(base + ["--tenants", "a", "--tenant-quota",
+                         "ghost=2"])
+    with pytest.raises(SystemExit, match="grammar"):
+        cli.main(base + ["--tenants", "a", "--tenant-quota", "a=x"])
+    with pytest.raises(SystemExit, match="admit nothing ever"):
+        cli.main(base + ["--tenants", "a", "--tenant-quota", "a=0"])
+    with pytest.raises(SystemExit, match="already has a quota"):
+        cli.main(base + ["--tenants", "a", "--tenant-quota", "a=2",
+                         "--tenant-quota", "a=3"])
+    with pytest.raises(SystemExit, match="must be > 0"):
+        cli.main(base + ["--tenants", "a", "--tenant-slo-ttft-ms",
+                         "a=0"])
+    with pytest.raises(SystemExit, match="already has a TTFT SLO"):
+        cli.main(base + ["--tenants", "a", "--tenant-slo-ttft-ms",
+                         "150", "--tenant-slo-ttft-ms", "a=250"])
+    with pytest.raises(SystemExit, match="is not a number"):
+        cli.main(base + ["--tenants", "a", "--tenant-slo-ttft-ms",
+                         "a=fast"])

@@ -554,3 +554,39 @@ def test_cli_serve_cluster_smoke(devices, capsys, tmp_path):
     assert summary["cluster_replicas_dead"] == 1
     assert summary["cluster_timed_out"] == 0
     assert summary["cluster_handoffs"] >= 1
+
+
+def test_router_tenant_affinity_and_rehoming(params):
+    """ISSUE-14: tenant-tagged requests stick to the replica that last
+    served the tenant (prefix-cache/adapter warmth) while the slack
+    holds, never override admissibility (a drained home loses the
+    tenant), and a dead home rehomes on a survivor."""
+    reps = [_replica(params, "r0"), _replica(params, "r1"),
+            _replica(params, "r2")]
+    router = Router(reps, tenant_affinity_slack=4)
+
+    def place(rid, tenant=None):
+        assert router.submit(Request(id=rid, prompt=(1, 2, 3),
+                                     max_new_tokens=3, tenant=tenant))
+        rep = router._owner[rid]
+        router.drain()
+        return rep.replica_id
+
+    home = place("a0", "acme")
+    # drained between placements, load is equal — affinity (not load)
+    # must keep acme where it landed, repeatedly
+    assert place("a1", "acme") == home
+    assert place("a2", "acme") == home
+    # an untagged request still follows pure least-loaded placement
+    place("u0")
+    # a draining home is not admissible: the tenant moves AND rehomes
+    router.drain_replica(home, wait=True)
+    other = place("a3", "acme")
+    assert other != home
+    assert router._tenant_home["acme"].replica_id == other
+    # a dead home is forgotten entirely, and the tenant rehomes on a
+    # survivor
+    router.kill_replica(other)
+    assert "acme" not in router._tenant_home
+    survivor = place("a4", "acme")
+    assert survivor not in (home, other)

@@ -165,3 +165,32 @@ def test_default_registry_is_process_registry():
 
     exp = MetricsExporter(port=0)
     assert exp.registry is REGISTRY
+
+
+def test_healthz_grows_tenant_block_when_tenant_gauges_exist():
+    """ISSUE 14: the per-tenant health block — queue depth, slots,
+    page reservations, the tenant's OWN brownout stage — appears only
+    when the tenant-labeled gauges exist (tenant-less servers keep the
+    historical document byte-identical, gated above)."""
+    reg = MetricsRegistry()
+    with MetricsExporter(reg, port=0) as exp:
+        doc = json.loads(_get(exp.url + "/healthz")[2])
+        assert "tenants" not in doc
+        q = reg.gauge("serve_tenant_queue_depth", "per-tenant depth",
+                      labels=("tenant",))
+        s = reg.gauge("serve_tenant_slots_used", "per-tenant slots",
+                      labels=("tenant",))
+        b = reg.gauge("serve_tenant_brownout_stage", "per-tenant stage",
+                      labels=("tenant",))
+        q.set(4, tenant="acme")
+        s.set(2, tenant="acme")
+        b.set(3, tenant="acme")
+        q.set(0, tenant="globex")
+        doc = json.loads(_get(exp.url + "/healthz")[2])
+        assert set(doc["tenants"]) == {"acme", "globex"}
+        assert doc["tenants"]["acme"] == {
+            "queue_depth": 4.0, "slots_used": 2.0,
+            "kv_pages_used": None, "brownout_stage": 3}
+        assert isinstance(doc["tenants"]["acme"]["brownout_stage"], int)
+        assert doc["tenants"]["globex"]["queue_depth"] == 0.0
+        assert doc["tenants"]["globex"]["brownout_stage"] is None

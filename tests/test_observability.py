@@ -800,3 +800,72 @@ def test_fit_epoch_jsonl_schema_unchanged(tmp_path, devices):
     assert len(eps) == 1
     assert set(eps[0]) == {"ts", "event", "epoch", "loss", "accuracy",
                            "val_loss", "val_accuracy"}
+
+
+def test_tenant_jsonl_schemas_frozen_from_day_one(tmp_path):
+    """ISSUE-14: the four tenant-labeled event shapes — finish, shed,
+    quota rejection, per-tenant brownout transition — are frozen from
+    day one, and every HISTORICAL serve event stays byte-untouched
+    (the hooks above prove that; here the tenant twins prove theirs).
+    The summary grows ONE additive key: serve_tenants, one record per
+    registered tenant with zeros included."""
+    from idc_models_tpu.observe.stats import format_summary
+    from idc_models_tpu.serve.metrics import ServingMetrics
+    from idc_models_tpu.serve.tenancy import TenantQuota, TenantRegistry
+
+    reg = TenantRegistry()
+    reg.register("acme", quota=TenantQuota(max_queued=4),
+                 slo_ttft_p95_ms=200.0)
+    reg.register("globex")
+    log = tmp_path / "serve.jsonl"
+    with JsonlLogger(log) as logger:
+        mreg = MetricsRegistry()
+        ten = reg.build(logger=logger, registry=mreg,
+                        brownout_dwell_s=0.0)
+        m = ServingMetrics(logger, registry=mreg, tenancy=ten)
+        m.on_submit("r0", 10.0, tenant="acme")
+        m.on_first_token("r0", 0.05, tenant="acme")
+        m.on_finish("r0", n_tokens=3, ttft_s=0.05, decode_s=0.1,
+                    reason="budget", t=10.3, tenant="acme")
+        m.on_shed("r1", tenant="acme")
+        m.on_tenant_quota("r2", tenant="acme", kind="queued")
+        m.on_tenant_cycle(["acme", "globex"], depths={"acme": 2},
+                          slots={"acme": 1}, pages={})
+        ten.brownouts["acme"].force_stage(1, reason="drill")
+    recs = [json.loads(l) for l in open(log)]
+    by_event = {r["event"]: r for r in recs}
+    # tenant events are NEW types; the historical serve_* shapes they
+    # ride next to keep their exact frozen key sets
+    assert set(by_event["serve_submit"]) == {"ts", "event", "id"}
+    assert set(by_event["serve_finish"]) == {"ts", "event", "id",
+                                             "tokens", "reason",
+                                             "ttft_ms"}
+    assert set(by_event["serve_shed"]) == {"ts", "event", "id"}
+    # the ISSUE-14 tenant events, frozen from day one
+    assert set(by_event["serve_tenant_finish"]) == {
+        "ts", "event", "id", "tenant", "tokens", "reason", "ttft_ms"}
+    assert set(by_event["serve_tenant_shed"]) == {"ts", "event", "id",
+                                                  "tenant"}
+    assert set(by_event["serve_tenant_quota_reject"]) == {
+        "ts", "event", "id", "tenant", "kind"}
+    assert set(by_event["serve_tenant_brownout"]) == {
+        "ts", "event", "tenant", "stage", "stage_name", "direction",
+        "reason"}
+    # the additive summary key: one record per REGISTERED tenant,
+    # zeros included (globex untouched reads as explicit zeros)
+    s = m.summary()
+    assert set(s["serve_tenants"]) == {"acme", "globex"}
+    assert s["serve_tenants"]["acme"] == {
+        "requests": 1, "tokens": 3, "ttft_ms_p50": 50.0,
+        "ttft_ms_p95": 50.0, "shed": 1, "quota_rejections": 1,
+        "slo_breached": False}
+    assert s["serve_tenants"]["globex"]["requests"] == 0
+    # the offline stats rollup reads the tenant events into its own
+    # per-tenant table
+    st = summarize_jsonl(log)
+    assert st["tenants"]["acme"]["requests"] == 1
+    assert st["tenants"]["acme"]["shed"] == 1
+    assert st["tenants"]["acme"]["quota_rejections"] == 1
+    assert st["tenants"]["acme"]["by_reason"] == {"budget": 1}
+    rendered = format_summary(st)
+    assert "tenants:" in rendered and "acme" in rendered

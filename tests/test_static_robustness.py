@@ -634,3 +634,133 @@ def test_serve_handlers_quarantine_or_reraise():
     stale = set(SERVE_EXCEPT_ALLOWLIST) - live
     assert not stale, (
         f"serve except allowlist entries match no code: {stale}")
+
+
+# -- ISSUE 14: multi-tenant discipline ----------------------------------
+#
+# 1. Every TENANT-FACING metric registration must carry the `tenant`
+#    label: an unlabeled "serve_tenant_*" series would aggregate every
+#    tenant into one number — exactly the blindness the tenancy layer
+#    exists to remove — and a dashboard built on it could never answer
+#    "WHICH tenant is burning".
+# 2. Cross-tenant state reads inside serve/tenancy.py are banned
+#    outside a documented allowlist: the isolation story is only
+#    auditable if every method provably touches ONE tenant's state,
+#    with the few legitimately-global sites (registration, the stacked
+#    adapter build, fleet rollups) enumerated and explained.
+
+def _scan_tenant_metric_labels(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(PACKAGE)).replace("\\", "/")
+    violations = []
+
+    def has_tenant_label(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg != "labels":
+                continue
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                return any(isinstance(e, ast.Constant)
+                           and e.value == "tenant"
+                           for e in kw.value.elts)
+        return False
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _METRIC_FACTORIES
+                    and child.args
+                    and isinstance(child.args[0], ast.Constant)
+                    and isinstance(child.args[0].value, str)
+                    and "tenant" in child.args[0].value
+                    and not has_tenant_label(child)):
+                violations.append((rel, child.lineno,
+                                   child.args[0].value))
+            walk(child)
+
+    walk(tree)
+    return violations
+
+
+def test_tenant_metric_registrations_carry_tenant_label():
+    violations = []
+    for f in sorted(PACKAGE.rglob("*.py")):
+        if f.name == "metrics_registry.py":
+            continue      # the factory definitions, not registrations
+        violations.extend(_scan_tenant_metric_labels(f))
+    assert not violations, (
+        "tenant-facing metric registered WITHOUT the tenant label — an "
+        "unlabeled serve_tenant_* series aggregates every tenant into "
+        "one number, which can never answer 'which tenant is burning': "
+        f"{violations}")
+
+
+# function name in serve/tenancy.py -> why it legitimately sees every
+# tenant (anything NOT here must read exactly one tenant's state)
+TENANCY_CROSS_TENANT_ALLOWLIST = {
+    "register": "duplicate-name check is the identity contract",
+    "_check_adapter": "shape agreement is a property OF the set — one "
+                      "[V, r] across every tenant's adapter",
+    "build": "the one freeze point: stacks every adapter into the "
+             "gather table, declares every SLO, builds every brownout",
+    "names": "the documented fleet-rollup accessor (registration "
+             "order = tid order)",
+    "n_tenants": "set SIZE only — reads no tenant's state",
+}
+
+_TENANT_MAPS = {"_tenants", "brownouts"}
+
+
+def _scan_cross_tenant_reads(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    violations, live = [], set()
+
+    def names_tenant_map(node) -> bool:
+        # self._tenants / self.brownouts, or a .values()/.items()/
+        # .keys() view over them
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("values", "items", "keys")):
+            node = node.func.value
+        return (isinstance(node, ast.Attribute)
+                and node.attr in _TENANT_MAPS)
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            iter_sites = []
+            if isinstance(child, (ast.For, ast.comprehension)):
+                iter_sites.append(child.iter)
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Name)
+                    and child.func.id in ("list", "sorted", "len",
+                                          "dict", "set", "tuple",
+                                          "any", "all")):
+                iter_sites.extend(child.args)
+            for site in iter_sites:
+                if not names_tenant_map(site):
+                    continue
+                fn = _enclosing_function(stack)
+                live.add(fn)
+                if fn not in TENANCY_CROSS_TENANT_ALLOWLIST:
+                    violations.append(
+                        (fn, getattr(child, "lineno",
+                                     getattr(site, "lineno", 0))))
+            walk(child, stack + [child])
+
+    walk(tree, [])
+    return violations, live
+
+
+def test_no_cross_tenant_reads_in_tenancy():
+    violations, live = _scan_cross_tenant_reads(
+        PACKAGE / "serve" / "tenancy.py")
+    assert not violations, (
+        "cross-tenant state read in serve/tenancy.py outside the "
+        "documented allowlist — tenancy methods must read ONE "
+        "tenant's state so the isolation story stays auditable "
+        "(extend TENANCY_CROSS_TENANT_ALLOWLIST only for genuinely "
+        f"set-level operations, with the why): {violations}")
+    stale = set(TENANCY_CROSS_TENANT_ALLOWLIST) - live
+    assert not stale, (
+        f"tenancy cross-tenant allowlist entries match no code: "
+        f"{stale}")
