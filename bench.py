@@ -1000,6 +1000,96 @@ def bench_lm_decode(on_accelerator: bool):
             "decode_tokens_per_sec": round(n_dec / best, 1)}
 
 
+def bench_lm_sharded(on_accelerator: bool):
+    """ISSUE 15: rule-based GSPMD sharding (partition.py) — CAPACITY
+    keys, per the CPU-container measurement policy (multi-device
+    wall-clock scaling is not measurable on 2-core virtual devices;
+    per-device memory footprint is).
+
+    One LM train-step config accounted three ways — replicated,
+    FSDP (params + optimizer moments over "data"), and TP (Megatron
+    orientation over "model", registry rule set 'lm') — reporting each
+    layout's per-device `peak_hbm_bytes` from XLA program accounting
+    (memory_analysis is per-device: a sharded program's argument
+    buffers are the shards) plus the sharded step times for the
+    regression trail. Headline: the hbm ratios sharded/replicated,
+    strictly < 1 when the rules actually shard (the ROADMAP item 2
+    capacity gate, also asserted in tests/test_partition.py). With
+    fewer than 2 devices only the replicated account is recorded."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models import registry
+    from idc_models_tpu.models.lm import attention_lm, next_token_loss
+    from idc_models_tpu.observe import profile as prof
+    from idc_models_tpu.train import (
+        TrainState, jit_data_parallel, make_train_step, rmsprop,
+        shard_batch,
+    )
+    from idc_models_tpu.train.step import place_state
+
+    if on_accelerator:
+        vocab, e, mlp, heads, blocks, seq_len, batch = (
+            8192, 1024, 4096, 8, 4, 512, 8)
+    else:
+        vocab, e, mlp, heads, blocks, seq_len, batch = (
+            512, 128, 512, 4, 2, 64, 4)
+    rng = np.random.default_rng(0)
+    seqs = (rng.integers(0, vocab, (batch, 1))
+            + np.arange(seq_len)) % vocab
+
+    def account(mesh, rules, tag):
+        model = attention_lm(vocab, seq_len, embed_dim=e,
+                             num_heads=heads, mlp_dim=mlp,
+                             num_blocks=blocks, mesh=mesh)
+        opt = rmsprop(3e-3)
+        v = model.init(jax.random.key(0))
+        state = TrainState(step=jnp.zeros((), jnp.int32),
+                           params=v.params, model_state=v.state,
+                           opt_state=opt.init(v.params))
+        step = jit_data_parallel(
+            make_train_step(model, opt, next_token_loss), mesh,
+            axis=meshlib.DATA_AXIS,
+            state_shardings=(rules.shardings(mesh, state)
+                             if rules is not None else None))
+        state = place_state(mesh, state, rules=rules)
+        x = shard_batch(mesh, jnp.asarray(seqs, jnp.int32),
+                        axis=meshlib.DATA_AXIS)
+        key = jax.random.key(1)
+        compiled = step.lower(state, x, x, key).compile()
+        cost = prof.program_report(compiled, name=f"lm_sharded.{tag}")
+        windows = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _i in range(2):
+                key, sub = jax.random.split(key)
+                state, m = compiled(state, x, x, sub)
+            _ = float(m["loss"])             # the fence
+            windows.append((time.perf_counter() - t0) / 2)
+        return cost.peak_hbm_bytes, min(windows)
+
+    rules = registry.get_partition_rules("lm")
+    rep_hbm, rep_s = account(meshlib.fsdp_tp_mesh(1, 1, 1), None,
+                             "replicated")
+    out = {"lm_sharded_peak_hbm_replicated_mb":
+           round(rep_hbm / 2**20, 3) if rep_hbm else None}
+    if len(jax.devices()) < 2 or not rep_hbm:
+        return out
+    fsdp_hbm, fsdp_s = account(meshlib.fsdp_tp_mesh(2, 1, 1), rules,
+                               "fsdp")
+    tp_hbm, tp_s = account(meshlib.fsdp_tp_mesh(1, 2, 1), rules, "tp")
+    out.update({
+        "lm_sharded_peak_hbm_fsdp_mb": round(fsdp_hbm / 2**20, 3),
+        "lm_sharded_peak_hbm_tp_mb": round(tp_hbm / 2**20, 3),
+        "lm_sharded_hbm_ratio_fsdp": round(fsdp_hbm / rep_hbm, 4),
+        "lm_sharded_hbm_ratio_tp": round(tp_hbm / rep_hbm, 4),
+        "lm_sharded_step_ms_fsdp": round(fsdp_s * 1e3, 3),
+        "lm_sharded_step_ms_tp": round(tp_s * 1e3, 3),
+    })
+    return out
+
+
 def bench_serving(on_accelerator: bool):
     """The continuous-batching engine (serve/) vs the serial PR-1
     `Generator` on the SAME trace — the serving scenario record.
@@ -2219,6 +2309,8 @@ HIGHER_IS_BETTER = (
 LOWER_IS_BETTER = (
     "fed_round_s", "fed_round_32_s", "secure_round_s",
     "prefill_ms", "decode_ms_per_token",
+    "lm_sharded_hbm_ratio_fsdp", "lm_sharded_hbm_ratio_tp",
+    "lm_sharded_step_ms_fsdp", "lm_sharded_step_ms_tp",
     "serve_ttft_ms_p50", "serve_ttft_ms_p95",
     "serve_ttft_ms_p95_shared_prefix", "cluster_ttft_ms_p95_2r",
     "serve_chunked_prefill_decode_stall_ms",
@@ -2257,7 +2349,8 @@ def _load_bench_record(path: Path) -> dict | None:
     return None
 
 
-def bench_compare(bench_dir=".", *, tolerance: float = 0.10) -> dict:
+def bench_compare(bench_dir=".", *, tolerance: float = 0.10,
+                  allow_cross_device: bool = False) -> dict:
     """Diff the NEWEST BENCH_rNN.json against the previous one and flag
     headline-key regressions beyond `tolerance` (default 10%).
 
@@ -2269,7 +2362,15 @@ def bench_compare(bench_dir=".", *, tolerance: float = 0.10) -> dict:
     human table; the caller decides what a regression is worth (the
     recorded windows drift ±10% on the shared chip — see BASELINE.md —
     so treat a single flagged key as a re-measure prompt, not a
-    verdict)."""
+    verdict).
+
+    Records from DIFFERENT `device_kind`s are refused outright unless
+    `allow_cross_device=True` (CLI: --allow-cross-device): a CPU
+    record diffed against a TPU trail measures the hardware swap, not
+    a code regression — every key would flag and the table would be
+    noise dressed as signal. With the override the comparison runs but
+    is stamped loudly (a `cross_device` field plus a WARNING line),
+    so it can never silently pass for a same-hardware diff."""
     # order by the integer run index — lexicographic order misplaces
     # r100 between r10 and r11 once the trail passes two digits
     files = sorted(
@@ -2285,6 +2386,22 @@ def bench_compare(bench_dir=".", *, tolerance: float = 0.10) -> dict:
     (old_path, old), (new_path, new) = pairs[-2], pairs[-1]
     out: dict = {"old": str(old_path), "new": str(new_path), "keys": {},
                  "regressions": []}
+    dk_old, dk_new = old.get("device_kind"), new.get("device_kind")
+    if dk_old and dk_new and dk_old != dk_new:
+        if not allow_cross_device:
+            raise ValueError(
+                f"refusing to compare across device kinds: "
+                f"{old_path.name} was measured on {dk_old!r} but "
+                f"{new_path.name} on {dk_new!r} — the diff would "
+                f"measure the hardware swap, not a regression "
+                f"(docs/BENCHMARKS.md caveats the r06 cpu record for "
+                f"exactly this). Re-measure on one kind, or pass "
+                f"--allow-cross-device / allow_cross_device=True to "
+                f"proceed with the comparison loudly flagged")
+        out["cross_device"] = [dk_old, dk_new]
+        print(f"WARNING: cross-device comparison ({dk_old!r} -> "
+              f"{dk_new!r}) — ratios measure the hardware swap, not "
+              f"code; regressions below are NOT actionable")
     rows = []
     for key in HIGHER_IS_BETTER + LOWER_IS_BETTER:
         a, b = old.get(key), new.get(key)
@@ -2319,9 +2436,19 @@ def bench_compare(bench_dir=".", *, tolerance: float = 0.10) -> dict:
 def main() -> None:
     if "--compare" in sys.argv:
         i = sys.argv.index("--compare")
-        bench_dir = (sys.argv[i + 1] if len(sys.argv) > i + 1
-                     else str(Path(__file__).parent))
-        result = bench_compare(bench_dir)
+        args = [a for a in sys.argv[i + 1:]
+                if a != "--allow-cross-device"]
+        bench_dir = args[0] if args else str(Path(__file__).parent)
+        try:
+            result = bench_compare(
+                bench_dir,
+                allow_cross_device="--allow-cross-device" in sys.argv)
+        except ValueError as e:
+            # exit 2, NOT 1: 1 means "regressions found" — a refusal
+            # (cross-device records, unparseable trail) is a usage/
+            # data problem and must not read as a perf regression
+            print(f"bench --compare: {e}", file=sys.stderr)
+            sys.exit(2)
         sys.exit(1 if result["regressions"] else 0)
     import jax
 
@@ -2343,6 +2470,7 @@ def main() -> None:
     ring.update(bench_flash_train(on_accelerator))
     ring.update(bench_attention_model_step(on_accelerator))
     ring.update(bench_lm_decode(on_accelerator))
+    ring.update(bench_lm_sharded(on_accelerator))
     ring.update(bench_serving(on_accelerator))
     ring.update(bench_serving_shared_prefix(on_accelerator))
     ring.update(bench_serving_speculative(on_accelerator))

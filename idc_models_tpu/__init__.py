@@ -10,6 +10,7 @@ of tf.distribute strategies and NCCL.
 Layering (bottom-up):
 
 - `mesh` / `collectives`    device mesh + XLA collective wrappers (ICI/DCN)
+- `partition`               regex->PartitionSpec sharding rules (FSDP/TP)
 - `tp`                      channel-wise tensor parallelism ("model" axis)
 - `ring_attention`          exact long-context attention, "seq"-sharded ring
 - `ring_decode`             ring-sharded KV-cache single-token decoding
@@ -25,5 +26,5 @@ Layering (bottom-up):
 __version__ = "0.1.0"
 
 from idc_models_tpu import (  # noqa: F401
-    collectives, mesh, ring_attention, ring_decode, tp,
+    collectives, mesh, partition, ring_attention, ring_decode, tp,
 )

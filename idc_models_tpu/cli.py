@@ -297,6 +297,18 @@ def _parse(argv):
     sp.add_argument("--mlp-dim", type=int, default=128)
     sp.add_argument("--num-blocks", type=int, default=2)
     sp.add_argument("--steps", type=int, default=200)
+    sp.add_argument("--fsdp", type=int, default=0,
+                    help="FSDP degree: shard params AND optimizer "
+                         "state over a 'data' mesh axis of this size "
+                         "(partition.py rules, registry rule set "
+                         "'lm'); 0 = off (replicated state, the "
+                         "historical layout)")
+    sp.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel degree: shard the attention/"
+                         "MLP/head weights over a 'model' mesh axis of "
+                         "this size (Megatron orientation, "
+                         "docs/SHARDING.md); composes with --fsdp on a "
+                         "('data', 'model', 'seq') mesh; 0 = off")
     sp.add_argument("--seq-parallel", type=int, default=0,
                     help="ring size over the 'seq' mesh axis (0 = "
                          "largest dividing power of two, capped at 4)")
@@ -348,6 +360,20 @@ def _parse(argv):
     sp.add_argument("--seq-parallel", type=int, default=1,
                     help="ring size over the 'seq' mesh axis for the "
                          "serving mesh (caches shard over it)")
+    sp.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel degree: serve with the "
+                         "model's weights sharded over a 'model' mesh "
+                         "axis of this size (partition.py rule set "
+                         "'lm') while the KV caches keep their seq-"
+                         "ring layout — params and KV shard "
+                         "independently; 0 = off (replicated params)")
+    sp.add_argument("--fsdp", type=int, default=0,
+                    help="accepted for symmetry with the lm/profile "
+                         "verbs but must stay 0 here: FSDP shards the "
+                         "optimizer+param state over the batch axis at "
+                         "TRAIN time; a serving engine holds no "
+                         "optimizer state and prefills [1, P] batches "
+                         "— use --tp for serving-side param sharding")
     sp.add_argument("--train-steps", type=int, default=0,
                     help="train the counting task this many steps "
                          "before serving (0 = serve from random init; "
@@ -619,12 +645,26 @@ def _parse(argv):
              "watchdog's findings; writes frozen-schema "
              "profile_program/profile_step jsonl (rendered by `stats`)")
     sp.add_argument("--model", required=True,
-                    choices=("vgg", "mobile", "dense", "small", "serve"),
+                    choices=("vgg", "mobile", "dense", "small", "serve",
+                             "lm"),
                     help="which hot loop to profile: a backbone's "
                          "fine-tune train step (vgg/mobile/dense, the "
                          "bench.py configurations; `small` is the tiny "
-                         "CPU-smoke CNN) or the continuous-batching "
-                         "serve decode loop")
+                         "CPU-smoke CNN), the continuous-batching "
+                         "serve decode loop, or the LM train step "
+                         "(`lm` — composes with --fsdp/--tp to "
+                         "account the SHARDED step's per-device peak "
+                         "HBM against the replicated figure)")
+    sp.add_argument("--fsdp", type=int, default=0,
+                    help="with --model lm: FSDP degree (params + "
+                         "optimizer state shard over a 'data' axis of "
+                         "this size; partition.py rule set 'lm'); the "
+                         "epilogue reports per-device peak HBM from "
+                         "XLA program accounting")
+    sp.add_argument("--tp", type=int, default=0,
+                    help="with --model lm: tensor-parallel degree "
+                         "(weights shard over a 'model' axis); "
+                         "composes with --fsdp")
     sp.add_argument("--steps", type=int, default=None,
                     help="measured steps/windows (default: 30 on an "
                          "accelerator, 4 on CPU)")
@@ -875,6 +915,13 @@ def _run_profile(ns):
     if (ns.peak_tflops is None) != (ns.peak_gbps is None):
         sys.exit("profile: --peak-tflops and --peak-gbps declare the "
                  "two axes of one roofline — pass both or neither")
+    if ns.fsdp < 0 or ns.tp < 0:
+        sys.exit(f"profile: --fsdp/--tp must be >= 0 (0 = off), got "
+                 f"{ns.fsdp}/{ns.tp}")
+    if (ns.fsdp > 1 or ns.tp > 1) and ns.model != "lm":
+        sys.exit(f"profile: --fsdp/--tp shard the LM's rule-based "
+                 f"partition layout (--model lm); the {ns.model} "
+                 f"model's default rules are replicated")
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
     if ns.peak_tflops is not None:
@@ -893,6 +940,8 @@ def _run_profile(ns):
     try:
         if ns.model == "serve":
             progs, mark = _profile_serve(ns, on_accel)
+        elif ns.model == "lm":
+            progs, mark = _profile_lm(ns, on_accel, dev)
         else:
             progs, mark = _profile_train_step(ns, on_accel, dev)
         if ns.churn_drill:
@@ -1050,6 +1099,113 @@ def _profile_train_step(ns, on_accel, dev):
           f"{batch}/chip x {n_dev} device(s), {steps} steps)")
     print(f"  throughput {pps:.1f} patches/sec/chip, "
           f"{step_s * 1e3:.2f} ms/step")
+    return {"train.step": (cost, roofline, step_s * 1e3)}, mark
+
+
+def _profile_lm(ns, on_accel, dev):
+    """Profile the LM train step — replicated or rule-sharded
+    (--fsdp/--tp, partition.py): the acceptance surface for ROADMAP
+    item 2, driveable from the command line. The epilogue's
+    per-device peak-HBM line comes from XLA program accounting
+    (memory_analysis reports the PER-DEVICE argument/temp footprint,
+    so a sharded step's figure drops below the replicated one on the
+    same config — capacity, not wall-clock, per the CPU measurement
+    policy)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idc_models_tpu import mesh as meshlib
+    from idc_models_tpu.models import registry
+    from idc_models_tpu.models.lm import attention_lm, next_token_loss
+    from idc_models_tpu.observe import profile as prof
+    from idc_models_tpu.observe import trace
+    from idc_models_tpu.train import (
+        TrainState, jit_data_parallel, make_train_step, rmsprop,
+        shard_batch,
+    )
+    from idc_models_tpu.train.step import place_state
+
+    if on_accel:
+        vocab, e, mlp, heads, blocks, seq_len = 8192, 1024, 4096, 8, 4, 512
+    else:
+        vocab, e, mlp, heads, blocks, seq_len = 512, 128, 512, 4, 2, 64
+    sharded = ns.fsdp > 1 or ns.tp > 1
+    f, t = max(ns.fsdp, 1), max(ns.tp, 1)
+    n_dev = len(jax.devices())
+    if f * t > n_dev:
+        sys.exit(f"profile: --fsdp {f} x --tp {t} needs {f * t} "
+                 f"devices, have {n_dev} (use --host-devices)")
+    mesh = meshlib.fsdp_tp_mesh(f, t, 1)
+    rules = registry.get_partition_rules("lm") if sharded else None
+    batch = ns.batch_size or (8 if on_accel else 4)
+    if batch % f:
+        sys.exit(f"profile: --batch-size {batch} must divide by "
+                 f"--fsdp {f} (the batch shards over the same 'data' "
+                 f"axis the params shard over)")
+    steps = ns.steps or (30 if on_accel else 4)
+    model = attention_lm(vocab, seq_len, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks, mesh=mesh)
+    opt = rmsprop(3e-3)
+    variables = model.init(jax.random.key(ns.seed))
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    step = jit_data_parallel(
+        make_train_step(model, opt, next_token_loss), mesh,
+        axis=meshlib.DATA_AXIS,
+        state_shardings=(rules.shardings(mesh, state)
+                         if rules is not None else None))
+    state = place_state(mesh, state, rules=rules)
+    rng = np.random.default_rng(ns.seed + 1)
+    seqs = jnp.asarray((rng.integers(0, vocab, (batch, 1))
+                        + np.arange(seq_len)) % vocab, jnp.int32)
+    x = shard_batch(mesh, seqs, axis=meshlib.DATA_AXIS)
+    with prof.compiling("train.step"):
+        compiled = step.lower(state, x, x,
+                              jax.random.key(ns.seed + 2)).compile()
+    cost = prof.register_program("train.step", compiled)
+    digest = jax.jit(lambda st: jnp.sum(
+        st.params["embed"].astype(jnp.float32)))
+    box = {"s": state, "k": jax.random.key(ns.seed + 2)}
+
+    def one_step():
+        box["k"], sub = jax.random.split(box["k"])
+        box["s"], _ = compiled(box["s"], x, x, sub)
+
+    def fence():
+        return float(digest(box["s"]))
+
+    one_step()
+    one_step()
+    fence()                                  # warm + fence
+    mark = prof.trace_mark(trace.get_tracer())
+    t0 = time.perf_counter()                 # throughput window
+    for _ in range(steps):
+        one_step()
+    fence()
+    step_s = (time.perf_counter() - t0) / steps
+    for _ in range(steps):                   # fenced attribution pass
+        with trace.span("profile.step"):
+            one_step()
+            with trace.span("device.sync"):
+                fence()
+    roofline = prof.roofline_verdict(cost, step_s, dev,
+                                     n_dev=mesh.devices.size)
+    layout = (f"fsdp={f}, tp={t} (rule set 'lm': params + optimizer "
+              f"state sharded)" if sharded else "replicated")
+    print(f"profile: train.step (lm {e}x{blocks}, vocab {vocab}, seq "
+          f"{seq_len}, batch {batch} global, {steps} steps) — {layout}")
+    print(f"  {step_s * 1e3:.2f} ms/step")
+    if cost.peak_hbm_bytes is not None:
+        # THE acceptance line: per-device resident footprint of the
+        # compiled step (args + outputs + temps - donated aliases)
+        print(f"  per-device peak HBM: "
+              f"{cost.peak_hbm_bytes / 2**20:.2f} MiB over "
+              f"{mesh.devices.size} device(s)")
     return {"train.step": (cost, roofline, step_s * 1e3)}, mark
 
 
@@ -1468,26 +1624,58 @@ def _run_lm(ns):
     from idc_models_tpu.models.lm import attention_lm, next_token_loss
     from idc_models_tpu.observe import Timer, profile_trace
     from idc_models_tpu.train import (
-        TrainState, jit_data_parallel, make_train_step, replicate,
-        rmsprop, shard_batch,
+        TrainState, jit_data_parallel, make_train_step, rmsprop,
+        shard_batch,
     )
+    from idc_models_tpu.train.step import place_state
 
     if not 0.0 <= ns.dropout < 1.0:
         sys.exit(f"--dropout {ns.dropout} must be in [0, 1)")
+    if ns.fsdp < 0 or ns.tp < 0:
+        sys.exit(f"--fsdp/--tp must be >= 0 (0 = off), got "
+                 f"{ns.fsdp}/{ns.tp}")
     n_dev = len(jax.devices())
-    n_seq = ns.seq_parallel or max(
-        p for p in (4, 2, 1) if n_dev % p == 0)
-    if n_seq < 1 or n_dev % n_seq:
-        sys.exit(f"--seq-parallel {n_seq} must be a positive divisor "
-                 f"of the device count ({n_dev})")
+    sharded = ns.fsdp > 1 or ns.tp > 1
+    if sharded:
+        # rule-sharded mesh (partition.py): FSDP over "data", TP over
+        # "model", the ring over "seq"; --seq-parallel defaults to 1
+        # here (the three axes share the device budget)
+        f, t = max(ns.fsdp, 1), max(ns.tp, 1)
+        n_seq = ns.seq_parallel or 1
+        if f * t * n_seq > n_dev:
+            sys.exit(f"--fsdp {f} x --tp {t} x --seq-parallel {n_seq} "
+                     f"needs {f * t * n_seq} devices, have {n_dev} "
+                     f"(use --host-devices to grow the virtual pod)")
+        batch = ns.batch_size or 32
+        if batch % f:
+            sys.exit(f"--batch-size {batch} must divide by --fsdp {f} "
+                     f"(the batch shards over the same 'data' axis the "
+                     f"params shard over)")
+        mesh = meshlib.fsdp_tp_mesh(f, t, n_seq)
+    else:
+        n_seq = ns.seq_parallel or max(
+            p for p in (4, 2, 1) if n_dev % p == 0)
+        if n_seq < 1 or n_dev % n_seq:
+            sys.exit(f"--seq-parallel {n_seq} must be a positive "
+                     f"divisor of the device count ({n_dev})")
+        mesh = meshlib.data_seq_mesh(n_seq)
     stripes = 2 * n_seq if ns.layout == "zigzag" else n_seq
     if ns.seq_len % stripes:
         sys.exit(f"--seq-len {ns.seq_len} must divide into {stripes} "
                  f"equal stripes for --layout {ns.layout} at ring "
                  f"size {n_seq}")
-    mesh = meshlib.data_seq_mesh(n_seq)
-    print(f"Number of devices: {mesh.devices.size} "
-          f"(data={mesh.shape[meshlib.DATA_AXIS]}, seq={n_seq})")
+    rules = None
+    if sharded:
+        from idc_models_tpu.models import registry
+
+        rules = registry.get_partition_rules("lm")
+        print(f"Number of devices: {mesh.devices.size} "
+              f"(fsdp={mesh.shape[meshlib.DATA_AXIS]}, "
+              f"tp={mesh.shape[meshlib.MODEL_AXIS]}, seq={n_seq}; "
+              f"params + optimizer state sharded by rule set 'lm')")
+    else:
+        print(f"Number of devices: {mesh.devices.size} "
+              f"(data={mesh.shape[meshlib.DATA_AXIS]}, seq={n_seq})")
 
     model = attention_lm(
         ns.vocab, ns.seq_len, embed_dim=ns.embed_dim,
@@ -1505,8 +1693,10 @@ def _run_lm(ns):
                        opt_state=opt.init(variables.params))
     step = jit_data_parallel(
         make_train_step(model, opt, next_token_loss), mesh,
-        axis=meshlib.DATA_AXIS)
-    state = replicate(mesh, state)
+        axis=meshlib.DATA_AXIS,
+        state_shardings=(rules.shardings(mesh, state)
+                         if rules is not None else None))
+    state = place_state(mesh, state, rules=rules)
     logger = _logger(ns)
     rng = np.random.default_rng(ns.seed + 1)
     key = jax.random.key(ns.seed + 2)
@@ -1600,6 +1790,18 @@ def _run_serve(ns):
     if ns.t_max % ns.seq_parallel:
         sys.exit(f"--t-max {ns.t_max} must divide by --seq-parallel "
                  f"{ns.seq_parallel}")
+    if ns.fsdp not in (0, 1):
+        sys.exit(f"--fsdp {ns.fsdp}: FSDP shards the optimizer+param "
+                 f"state over the batch axis at TRAIN time; a serving "
+                 f"engine holds no optimizer state and prefills [1, P] "
+                 f"batches — use --tp for serving-side param sharding")
+    if ns.tp < 0:
+        sys.exit(f"--tp {ns.tp} must be >= 0 (0 = off)")
+    if ns.tp > 1 and ns.tp * ns.seq_parallel > n_dev:
+        sys.exit(f"--tp {ns.tp} x --seq-parallel {ns.seq_parallel} "
+                 f"needs {ns.tp * ns.seq_parallel} devices, have "
+                 f"{n_dev} (use --host-devices to grow the virtual "
+                 f"pod)")
     if ns.temperature < 0.0:
         sys.exit(f"--temperature {ns.temperature} must be >= 0")
     # fail fast — BEFORE any --train-steps pre-training runs
@@ -1678,7 +1880,18 @@ def _run_serve(ns):
                 ns.serve_faults, seed=ns.seed)
         except ValueError as e:
             sys.exit(f"--serve-faults: {e}")
-    mesh = meshlib.seq_mesh(ns.seq_parallel)
+    serve_rules = None
+    if ns.tp > 1:
+        # tensor-parallel serving (partition.py): weights shard over
+        # "model", the KV ring keeps "seq" — independent axes
+        from idc_models_tpu.models import registry as model_registry
+
+        serve_rules = model_registry.get_partition_rules("lm")
+        mesh = meshlib.fsdp_tp_mesh(1, ns.tp, ns.seq_parallel)
+        print(f"serving mesh: tp={ns.tp} x seq={ns.seq_parallel} "
+              f"(params sharded by rule set 'lm'; KV on the seq ring)")
+    else:
+        mesh = meshlib.seq_mesh(ns.seq_parallel)
     # the model trains through the SAME ring the serving mesh uses —
     # omitting mesh here would silently train single-device full
     # attention ([B, H, t_max, t_max] scores) at exactly the sizes
@@ -1727,7 +1940,7 @@ def _run_serve(ns):
         print(f"metrics: {exporter.url}/metrics  healthz: "
               f"{exporter.url}/healthz")
     try:
-        _serve_body(ns, mesh, params, logger)
+        _serve_body(ns, mesh, params, logger, serve_rules)
     finally:
         if exporter is not None:
             exporter.close()
@@ -1818,7 +2031,7 @@ def _parse_tenant_flags(ns):
     return names, quotas, slos
 
 
-def _serve_body(ns, mesh, params, logger) -> None:
+def _serve_body(ns, mesh, params, logger, rules=None) -> None:
     import json
 
     import jax.numpy as jnp
@@ -1911,7 +2124,7 @@ def _serve_body(ns, mesh, params, logger) -> None:
         kv_page_size=ns.kv_page_size or None,
         kv_pages=ns.kv_pages or None,
         kv_decode_reserve=ns.kv_decode_reserve or None,
-        tenancy=tenancy)
+        tenancy=tenancy, partition_rules=rules)
     if n_pending:
         readmitted = server.resubmit_pending(ns.journal)
         line = (f"journal: re-admitted {len(readmitted)} in-flight "

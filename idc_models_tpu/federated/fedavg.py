@@ -181,6 +181,7 @@ def make_fedavg_round(
     drop_nonfinite: bool = True,
     aggregator=None,
     faults=None,
+    rules=None,
 ):
     """Build the jitted one-round FedAvg program.
 
@@ -219,10 +220,30 @@ def make_fedavg_round(
       (depth = the plan's max staleness);
     - metrics are the example-weighted means of per-client local-training
       loss/accuracy over all local steps (the `train_metrics` half of the
-      reference's per-round CSV print, fed_model.py:229).
+      reference's per-round CSV print, fed_model.py:229);
+    - ``rules`` (partition.PartitionRules) routes the server state's
+      placement through the shared regex->PartitionSpec layer
+      (partition.shard_tree) instead of the caller's ad-hoc replicate:
+      on the 1-D "client" mesh every rule adapts to replicated (bit-
+      identical to the historical layout), so federated placement and
+      train/serve placement resolve through ONE point.
     """
-    from idc_models_tpu import faults as faults_lib
+    from idc_models_tpu import faults as faults_lib, partition
     from idc_models_tpu.federated import robust
+
+    _server_sh: dict[str, object] = {}   # resolved ONCE, reused per round
+
+    def place_server(server: ServerState) -> ServerState:
+        if rules is None:
+            return server
+        tree = {"params": server.params,
+                "model_state": server.model_state}
+        if "sh" not in _server_sh:
+            _server_sh["sh"] = rules.shardings(mesh, tree)
+        placed = jax.tree.map(meshlib.put_with_sharding, tree,
+                              _server_sh["sh"])
+        return server.replace(params=placed["params"],
+                              model_state=placed["model_state"])
 
     agg_fn = robust.get_aggregator(aggregator)
     n_devices = mesh.shape[meshlib.CLIENT_AXIS]
@@ -302,7 +323,8 @@ def make_fedavg_round(
     )
 
     if not with_faults:
-        def round_fn(server: ServerState, images, labels, weights, rng):
+        def round_body(server: ServerState, images, labels, weights,
+                       rng):
             _check_client_shapes(images, weights, n_devices)
             params, model_state, metrics = mapped(
                 server.params, server.model_state, images, labels,
@@ -312,7 +334,17 @@ def make_fedavg_round(
                 model_state=model_state)
             return new_server, metrics
 
-        return jax.jit(round_fn, donate_argnums=(0,))
+        jitted_round = jax.jit(round_body, donate_argnums=(0,))
+        if rules is None:
+            return jitted_round   # the historical product, bit-for-bit
+
+        def round_fn(server: ServerState, images, labels, weights, rng):
+            # placement (host-side: device_put must not trace) through
+            # the one shared resolution point, then the jitted round
+            return jitted_round(place_server(server), images, labels,
+                                weights, rng)
+
+        return round_fn
 
     def round_core(server, images, labels, weights, rng, codes, scales,
                    stale_params, stale_state):
@@ -331,6 +363,7 @@ def make_fedavg_round(
     def faulty_round_fn(server: ServerState, images, labels, weights,
                         rng, *, round_idx: int | None = None):
         _check_client_shapes(images, weights, n_devices)
+        server = place_server(server)
         c = images.shape[0]
         if faults.n_clients > c:
             raise ValueError(

@@ -312,6 +312,7 @@ def make_population_round(
     barrier_sleep: bool = False,
     logger=None,
     log_from_round: int = -1,
+    rules=None,
 ):
     """Build the streamed population round.
 
@@ -501,13 +502,42 @@ def make_population_round(
     # explicit in/out shardings the accumulator's sharding drifts
     # between wave 0 (fresh zeros) and wave 1 (program output), which
     # recompiles the wave program mid-round — minutes per round on a
-    # big model
+    # big model. The server-shaped pins (params, model_state, and the
+    # wave ACCUMULATORS mirroring them) resolve through the shared
+    # partition layer when `rules` is given — the accumulators inherit
+    # the rules' shardings instead of a pinned ad-hoc replicate; on the
+    # 1-D client mesh every rule adapts to replicated (bit-identical).
     rep = meshlib.replicated(mesh)
     csh = meshlib.sharding(mesh, meshlib.CLIENT_AXIS)
-    wave_in_sh = (rep, rep, rep, rep, rep, csh, csh, csh, csh,
-                  rep) + ((csh, csh, rep, rep) if with_faults else ())
-    wave_jit = jax.jit(mapped, in_shardings=wave_in_sh,
-                       out_shardings=rep, donate_argnums=(2, 3, 4))
+    _jits: dict[str, object] = {}
+
+    def _server_shardings(server):
+        if rules is None:
+            return rep, rep
+        sh = rules.shardings(
+            mesh, {"params": server.params,
+                   "model_state": server.model_state})
+        return sh["params"], sh["model_state"]
+
+    def _get_jits(server):
+        # built on FIRST use: rules resolve against the server's tree
+        # structure, which the builder does not hold
+        if "wave" not in _jits:
+            p_sh, m_sh = _server_shardings(server)
+            acc_sh = {"params": p_sh, "model_state": m_sh}
+            wave_in_sh = (p_sh, m_sh, acc_sh, rep, rep, csh, csh, csh,
+                          csh, rep) + ((csh, csh, p_sh, m_sh)
+                                       if with_faults else ())
+            _jits["wave"] = jax.jit(
+                mapped, in_shardings=wave_in_sh,
+                out_shardings=(acc_sh, rep, rep),
+                donate_argnums=(2, 3, 4))
+            _jits["finalize"] = jax.jit(
+                finalize, in_shardings=(p_sh, m_sh, acc_sh, rep, rep),
+                out_shardings=(p_sh, m_sh, rep), donate_argnums=(2,))
+            # the placement tree too: resolved once, reused per round
+            _jits["place_sh"] = acc_sh if rules is not None else None
+        return _jits["wave"], _jits["finalize"]
 
     def finalize(params, model_state, acc, acc_w, acc_m):
         total = jnp.maximum(acc_w, jnp.float32(1e-30))
@@ -535,9 +565,6 @@ def make_population_round(
             lambda n, o: jnp.where(any_alive, n, o), new, old)
         return new["params"], new["model_state"], metrics
 
-    finalize_jit = jax.jit(finalize, in_shardings=(rep,) * 5,
-                           out_shardings=rep, donate_argnums=(2,))
-
     def _acc_metrics_init():
         m = {"wloss": jnp.zeros((), jnp.float32),
              "wacc": jnp.zeros((), jnp.float32),
@@ -557,6 +584,17 @@ def make_population_round(
 
     def round_fn(server: ServerState, images=None, labels=None,
                  weights=None, rng=None, *, round_idx: int | None = None):
+        wave_jit, finalize_jit = _get_jits(server)
+        if rules is not None:
+            # placement through the shared resolution point's CACHED
+            # shardings (no-op once the server carries the layout)
+            placed = jax.tree.map(
+                meshlib.put_with_sharding,
+                {"params": server.params,
+                 "model_state": server.model_state},
+                _jits["place_sh"])
+            server = server.replace(params=placed["params"],
+                                    model_state=placed["model_state"])
         r = int(server.round) if round_idx is None else int(round_idx)
         ids = sampler.cohort(r)
         mask = (np.ones((cohort_size,), np.float32) if weights is None

@@ -172,9 +172,61 @@ def batch_seq_spec(mesh: Mesh, axis: str = SEQ_AXIS,
     `trailing` unsharded dims after it. Shared by the ring op's
     shard_map specs ([B,T,H,D]: trailing=2), the attention model's
     residual-stream pin ([B,T,E]: trailing=1), and the decode cache
-    sharding — one definition so the three surfaces cannot diverge."""
-    others = tuple(a for a in mesh.axis_names if a != axis)
-    return P(others if others else None, axis, *([None] * trailing))
+    sharding — one definition so the three surfaces cannot diverge.
+
+    The "model" axis is excluded from the batch group: it is reserved
+    for WEIGHT sharding (tp.py, partition.py rules), so activations
+    and KV caches stay unsharded over it — params and KV shard
+    independently on a ("data", "model", "seq") mesh."""
+    bo = batch_axes(mesh, axis)
+    return P(bo, axis, *([None] * trailing))
+
+
+def batch_axes(mesh: Mesh, axis: str = SEQ_AXIS):
+    """The axis group a leading batch dimension shards over on a
+    sequence-parallel mesh: every axis except the ring `axis` and the
+    weight-reserved "model" axis — None when no such axis exists. The
+    one definition `batch_seq_spec` and the ring folds' shard_map
+    specs share, so activations/KV and weights cannot end up fighting
+    over "model"."""
+    others = tuple(a for a in mesh.axis_names
+                   if a not in (axis, MODEL_AXIS))
+    return others if others else None
+
+
+def batch_seq_sharding(mesh: Mesh, axis: str = SEQ_AXIS,
+                       trailing: int = 2) -> NamedSharding:
+    """`batch_seq_spec` as a NamedSharding — the one construction site
+    for the [B, T, ...] activation/cache layout (the ring model's
+    residual pin, ring_decode's cache layout, the serve engine's
+    canonical cache spelling all call this)."""
+    return NamedSharding(mesh, batch_seq_spec(mesh, axis, trailing))
+
+
+def fsdp_tp_mesh(fsdp: int = 1, tp: int = 1, seq: int = 1) -> Mesh:
+    """3-D ("data", "model", "seq") mesh for sharded LM configs: FSDP
+    shards params + optimizer state over "data" (the batch axis — the
+    gradient allreduce becomes reduce-scatter/all-gather), tensor
+    parallelism shards them over "model" (partition.py rules), and
+    "seq" carries the ring. Size-1 axes are kept in the mesh — the
+    partition rules drop them at adaptation time, so one rule set
+    serves every (fsdp, tp, seq) combination.
+
+    Uses exactly fsdp*tp*seq devices — the degrees are the caller's
+    EXPLICIT request (no -1/absorb axis), so leftover devices idle by
+    design. Don't compare wall-clock against an all-devices
+    `data_seq_mesh` run: the device counts differ; the sharded-config
+    comparisons this mesh exists for are per-device CAPACITY
+    (peak_hbm_bytes) and same-mesh step time (bench_lm_sharded)."""
+    for name, v in (("fsdp", fsdp), ("tp", tp), ("seq", seq)):
+        if v < 1:
+            raise ValueError(f"{name} degree must be >= 1, got {v}")
+    n = len(jax.devices())
+    if fsdp * tp * seq > n:
+        raise ValueError(
+            f"mesh fsdp={fsdp} x tp={tp} x seq={seq} needs "
+            f"{fsdp * tp * seq} devices, have {n}")
+    return make_mesh({DATA_AXIS: fsdp, MODEL_AXIS: tp, SEQ_AXIS: seq})
 
 
 def batch_axis(mesh: Mesh, axis: str | None = None) -> str:

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from idc_models_tpu import mesh as meshlib
 from idc_models_tpu.models import core
@@ -44,9 +44,9 @@ from idc_models_tpu.ring_attention import (
 def residual_sharding(mesh: Mesh, axis: str = meshlib.SEQ_AXIS):
     """The [B, T, E] residual-stream sharding on `mesh` — the same
     layout the ring op forces at its shard_map boundary
-    (`mesh.batch_seq_spec`, one definition for all SP surfaces)."""
-    return NamedSharding(mesh, meshlib.batch_seq_spec(mesh, axis,
-                                                      trailing=1))
+    (`mesh.batch_seq_sharding`, one construction site for all SP
+    surfaces)."""
+    return meshlib.batch_seq_sharding(mesh, axis, trailing=1)
 
 
 def _seq_pin(mesh: Mesh | None, axis: str = meshlib.SEQ_AXIS):

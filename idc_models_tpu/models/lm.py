@@ -152,8 +152,12 @@ class _ServeConfig(NamedTuple):
     top_k: int | None
 
 
-def _place_params(params, mesh):
-    """Bind a parameter tree to the SERVING mesh, replicated.
+def _place_params(params, mesh, rules=None):
+    """Bind a parameter tree to the SERVING mesh: replicated by
+    default, or under partition `rules` (regex->PartitionSpec,
+    models/registry.py) — the tensor-parallel serving path, where
+    params shard over "model" while activations and the KV ring keep
+    their own (independent) axes.
 
     Host (numpy) trees are fine to pass in — e.g. a checkpoint straight
     from device_get/restore — and so are device trees living on a
@@ -161,6 +165,15 @@ def _place_params(params, mesh):
     served on a sub-mesh): the serving programs pin activations to the
     serving mesh, so the parameters must live there too, not wherever
     training left them."""
+    if rules is not None:
+        # raw leaves straight into their SHARDED placements — an
+        # asarray pass first would commit every param whole to one
+        # device, transiently needing the replicated footprint the
+        # rules path exists to avoid (put_with_sharding takes host
+        # arrays directly)
+        from idc_models_tpu import partition
+
+        return partition.shard_tree(mesh, rules, params)
     sh = meshlib.replicated(mesh)
     return jax.tree.map(
         lambda a: meshlib.put_with_sharding(jnp.asarray(a), sh), params)
@@ -691,14 +704,19 @@ class Generator:
                  num_blocks: int, t_max: int, mesh: Mesh | None = None,
                  cache_dtype=jnp.bfloat16, block_impl: str = "jnp",
                  temperature: float = 0.0, top_k: int | None = None,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 partition_rules=None):
         self._cfg = _serve_config(
             params, embed_dim=embed_dim, num_heads=num_heads,
             num_blocks=num_blocks, t_max=t_max, mesh=mesh,
             cache_dtype=cache_dtype, block_impl=block_impl,
             temperature=temperature, top_k=top_k)
         self._fns = _serving_fns(self._cfg)
-        self._params = _place_params(params, self._cfg.mesh)
+        # partition_rules shard the params over the mesh's weight axes
+        # ("model"/"data" — registry.LM_RULES) while the KV caches keep
+        # their seq-ring layout: params and KV shard INDEPENDENTLY
+        self._params = _place_params(params, self._cfg.mesh,
+                                     rules=partition_rules)
         self.t_max = t_max
         self.temperature = float(temperature)
         # chunked prefill: the prompt runs through the chunk program C

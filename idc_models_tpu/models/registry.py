@@ -1,7 +1,11 @@
-"""Model registry: name -> (builder, head-only mask, fine-tune mask).
+"""Model registry: name -> (builder, head-only mask, fine-tune mask,
+partition rules).
 
 Gives the CLI/configs one lookup for the reference's model zoo
-(keras.applications in the reference; SURVEY.md C5/C6).
+(keras.applications in the reference; SURVEY.md C5/C6), and — since the
+rule-based sharding layer (partition.py, ISSUE 15) — each model's
+DEFAULT partition-rule set: the regex->PartitionSpec policy train,
+federated, and serve all resolve placement through.
 """
 
 from __future__ import annotations
@@ -9,8 +13,61 @@ from __future__ import annotations
 import dataclasses
 from collections.abc import Callable
 
+from jax.sharding import PartitionSpec as P
+
+from idc_models_tpu import mesh as meshlib, partition
 from idc_models_tpu.models import densenet, mobilenet, small_cnn as small_cnn_mod, vgg
 from idc_models_tpu.models.core import Module
+
+# The classifier zoo replicates by default — DP alone is fastest at the
+# reference's 50x50 scale (tp.py docstring), and replicated rules are
+# bit-compatible with the pre-rules layout.
+REPLICATED_RULES = partition.PartitionRules.replicated()
+
+_D, _M = meshlib.DATA_AXIS, meshlib.MODEL_AXIS
+
+# The decoder-only LM (models/lm.py attention_lm): FSDP over "data"
+# (params AND the rmsprop moments mirroring them — re.search matches
+# the nu/... suffix paths), tensor parallelism over "model" in the
+# Megatron orientation (qkv/fc1/head column-parallel, wo/fc2
+# row-parallel), biases riding their kernel's output sharding. On a
+# mesh without one of the axes the rules degrade to the other; on a
+# seq-only serve mesh they degrade to replicated. Order matters: first
+# match wins, the catch-all replicates LN scales/biases and the rest.
+# docs/SHARDING.md walks every rule.
+LM_RULES = partition.PartitionRules((
+    (r"mha/w[qkv]$", P(_D, _M)),       # [E, E] column-parallel
+    (r"mha/wo$", P(_M, _D)),           # [E, E] row-parallel
+    (r"fc1/kernel$", P(_D, _M)),       # [E, mlp] column-parallel
+    (r"fc1/bias$", P(_M)),             # [mlp] rides fc1's out shard
+    (r"fc2/kernel$", P(_M, _D)),       # [mlp, E] row-parallel
+    (r"head/kernel$", P(_D, _M)),      # [E, vocab] column-parallel
+    (r"head/bias$", P(_M)),            # [vocab] rides the head shard
+    (r"embed$", P(None, _D)),          # [vocab, E] FSDP on E
+    (r"pos$", P(None, _D)),            # [T, E] FSDP on E
+    (r".*", P()),                      # LN scale/bias, bo, fc2/bias,
+    #                                    step counter: replicated
+))
+
+# name -> default rule set; "lm" serves attention_lm trees (train AND
+# serve resolve through it), classifier names alias their ModelSpec's
+# rules so both lookups agree.
+PARTITION_RULES: dict[str, partition.PartitionRules] = {
+    "replicated": REPLICATED_RULES,
+    "lm": LM_RULES,
+}
+
+
+def get_partition_rules(name: str) -> partition.PartitionRules:
+    """Default partition rules for a registered model (or the "lm" /
+    "replicated" rule-set names)."""
+    if name in PARTITION_RULES:
+        return PARTITION_RULES[name]
+    if name in REGISTRY:
+        return REGISTRY[name].partition_rules
+    raise KeyError(
+        f"no partition rules for {name!r}; have "
+        f"{sorted(set(PARTITION_RULES) | set(REGISTRY))}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -24,6 +81,9 @@ class ModelSpec:
     # KERAS_LAYER_INDEX); consumers: fine-tune boundary lookups such as
     # the frozen-prefix feature cache. None for models without one.
     layer_index: dict[str, int] | None = None
+    # the model's default regex->PartitionSpec policy (partition.py);
+    # replicated for the zoo — see LM_RULES for a sharded example
+    partition_rules: partition.PartitionRules = REPLICATED_RULES
 
 
 def _always_trainable(params, fine_tune_at=0):

@@ -1,0 +1,263 @@
+"""Rule-based GSPMD sharding: regex over named param paths -> PartitionSpec.
+
+Before this layer, every subsystem hand-wired its own placement: the
+train step pinned states replicated, tp.py carried a shape-based channel
+rule, the serve engine replicated params next to its ring-sharded KV,
+and the federated wave accumulators pinned ad-hoc shardings. One model
+could not say "shard my attention weights over 'model' and my optimizer
+moments with their params" in a single place — which is exactly what
+FSDP / tensor-parallel LM configs need (ROADMAP item 2; the
+`match_partition_rules` pattern of SNIPPETS.md [1]).
+
+This module is THE resolution point (a static scan in
+tests/test_static_robustness.py bans `NamedSharding(`/`PartitionSpec(`
+construction outside the sharding layers):
+
+- `PartitionRules` — ordered ``(regex, PartitionSpec)`` pairs, resolved
+  against `jax.tree_util` key paths joined with "/" (e.g.
+  ``params/block0/mha/wq``). FIRST match wins, so specific rules go
+  before catch-alls. `re.search` semantics mean a rule written for a
+  param path also matches the optimizer moments mirroring it
+  (``opt_state/.../nu/block0/mha/wq``) — optimizer state shards with
+  its param (FSDP) with zero extra rules.
+- Specs are RIGHT-ALIGNED against each leaf's shape: ``P("model")`` on
+  a [E, M] kernel shards M, on a [M] bias shards M — one rule covers a
+  kernel and its bias. Missing leading dims are replicated.
+- Mesh adaptation: axes absent from the mesh (or of size 1) are
+  dropped, and a dim not divisible by its axis falls back to
+  replication — one rule set serves every mesh, from a single-device
+  serve ring to an ("data", "model", "seq") pod, degenerating to the
+  pre-rules replicated layout where the axes don't exist.
+- Teaching errors: a non-scalar leaf no rule matches raises (add a
+  rule or the ``(r".*", P())`` catch-all); a rule that matches NO leaf
+  raises too (a param rename silently killing a rule is the failure
+  mode the golden param-path test freezes at CI time).
+- `shard_tree` / `gather_tree` — the one place/unplace pair shared by
+  train, federated, and serve.
+
+Scalars (and 1-element leaves) always replicate, matching the
+`match_partition_rules` reference pattern.
+"""
+
+from __future__ import annotations
+
+import re
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from idc_models_tpu import mesh as meshlib
+
+SEP = "/"
+
+
+class PartitionError(ValueError):
+    """A rules/tree mismatch with a teaching message."""
+
+
+def _key_str(entry) -> str:
+    """One key-path entry -> its bare name (no brackets/dots)."""
+    for attr in ("key", "name", "idx"):
+        v = getattr(entry, attr, None)
+        if v is not None:
+            return str(v)
+    return str(entry)
+
+
+def path_str(path) -> str:
+    """A jax key path -> "a/b/0/c" (the name rules match against)."""
+    return SEP.join(_key_str(k) for k in path)
+
+
+def tree_paths(tree) -> list[tuple[str, object]]:
+    """[(name, leaf)] for every leaf, names in "a/b/c" form."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(path_str(p), leaf) for p, leaf in leaves]
+
+
+def _leaf_shape(leaf) -> tuple:
+    shape = getattr(leaf, "shape", None)
+    return tuple(shape) if shape is not None else np.shape(leaf)
+
+
+def _axes_of(entry) -> tuple:
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+def _adapt(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Fit a (right-aligned) spec onto a concrete shape and mesh: drop
+    axes the mesh lacks (or holds at size 1) and fall back to
+    replication on non-dividing dims. Trailing Nones are stripped so
+    every surface spells one layout one way (the jit cache keys on
+    spec EQUALITY — the engine's trailing-None-free discipline)."""
+    entries = (None,) * (len(shape) - len(spec)) + tuple(spec)
+    out = []
+    for dim, entry in zip(shape, entries):
+        kept = [a for a in _axes_of(entry)
+                if a in mesh.axis_names and mesh.shape[a] > 1]
+        n = int(np.prod([mesh.shape[a] for a in kept])) if kept else 1
+        if not kept or dim % n:
+            out.append(None)
+        else:
+            out.append(kept[0] if len(kept) == 1 else tuple(kept))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+class PartitionRules:
+    """Ordered ``(regex, PartitionSpec)`` pairs resolved against named
+    param-tree paths — the whole sharding policy of a model in one
+    object (see module docstring for matching/adaptation semantics)."""
+
+    def __init__(self, rules: Sequence[tuple[str, P]]):
+        if not rules:
+            raise PartitionError(
+                "PartitionRules needs at least one (regex, "
+                "PartitionSpec) pair — for all-replicated use "
+                "PartitionRules.replicated()")
+        compiled = []
+        for i, pair in enumerate(rules):
+            if len(pair) != 2:
+                raise PartitionError(
+                    f"rule {i} must be a (regex, PartitionSpec) pair, "
+                    f"got {pair!r}")
+            pattern, spec = pair
+            if not isinstance(spec, P):
+                raise PartitionError(
+                    f"rule {i} ({pattern!r}) maps to {spec!r} — the "
+                    f"right side must be a jax.sharding.PartitionSpec")
+            axes = [a for e in spec for a in _axes_of(e)]
+            if len(axes) != len(set(axes)):
+                raise PartitionError(
+                    f"rule {i} ({pattern!r}) names a mesh axis twice "
+                    f"in {spec} — a tensor dim pair cannot share one "
+                    f"axis")
+            try:
+                rx = re.compile(pattern)
+            except re.error as e:
+                raise PartitionError(
+                    f"rule {i} regex {pattern!r} does not compile: "
+                    f"{e}") from e
+            compiled.append((pattern, rx, spec))
+        self._rules = tuple(compiled)
+
+    @classmethod
+    def replicated(cls) -> "PartitionRules":
+        """The degenerate rule set: everything replicated — the layout
+        every subsystem used before rules existed (bit-compatible)."""
+        return cls(((r".*", P()),))
+
+    @property
+    def patterns(self) -> tuple[str, ...]:
+        return tuple(pattern for pattern, _, _ in self._rules)
+
+    def __repr__(self) -> str:
+        body = ", ".join(f"({pattern!r}, {spec})"
+                         for pattern, _, spec in self._rules)
+        return f"PartitionRules(({body}))"
+
+    def _match(self, name: str):
+        for i, (_, rx, spec) in enumerate(self._rules):
+            if rx.search(name) is not None:
+                return i, spec
+        return None, None
+
+    def _resolve_leaf(self, name: str, shape):
+        """(matched rule index | None, un-adapted spec) for one leaf —
+        ONE regex scan per leaf. Scalars (and 1/0-element leaves)
+        always replicate, matched or not; only a NON-scalar leaf no
+        rule matches raises."""
+        i, spec = self._match(name)
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            return i, P()   # scalars, 1-element and ZERO-size leaves
+        if i is None:
+            raise PartitionError(self._unmatched_msg(name, shape))
+        if len(spec) > len(shape):
+            raise PartitionError(
+                f"rule {self.patterns[i]!r} carries a rank-{len(spec)} "
+                f"spec {spec} but matched the rank-{len(shape)} param "
+                f"{name!r} (shape {tuple(shape)}) — specs right-align "
+                f"against the leaf shape and may not exceed its rank; "
+                f"write a more specific rule for this leaf")
+        return i, spec
+
+    def spec_for(self, name: str, shape) -> P:
+        """The (un-adapted) spec for one named leaf: first matching
+        rule wins; scalars/1-element leaves always replicate. `shape`
+        is required — without it every leaf would read as a scalar
+        and resolve replicated."""
+        return self._resolve_leaf(name, shape)[1]
+
+    def _unmatched_msg(self, name, shape) -> str:
+        return (f"no partition rule matches param {name!r} (shape "
+                f"{tuple(shape)}); rules tried, in order: "
+                f"{list(self.patterns)}. Add a rule for it, or end "
+                f"the rule set with the catch-all (r'.*', "
+                f"PartitionSpec()) to replicate everything unmatched")
+
+    def specs(self, tree, *, mesh: Mesh | None = None,
+              check_dead: bool = True):
+        """Pytree of PartitionSpec for `tree` — adapted to `mesh` when
+        given (axis dropping + divisibility fallback), raw otherwise.
+        With `check_dead`, a rule matching NO leaf raises: a dead rule
+        means a param was renamed out from under it, and the sharding
+        it described is silently gone."""
+        live = set()
+        names_seen = []
+
+        def resolve(path, leaf):
+            name = path_str(path)
+            shape = _leaf_shape(leaf)
+            i, spec = self._resolve_leaf(name, shape)
+            if i is not None:
+                live.add(i)
+            names_seen.append(name)
+            return _adapt(spec, shape, mesh) if mesh is not None else spec
+
+        out = jax.tree_util.tree_map_with_path(resolve, tree)
+        if check_dead and names_seen:
+            dead = [self.patterns[i] for i in range(len(self._rules))
+                    if i not in live]
+            if dead:
+                raise PartitionError(
+                    f"dead partition rule(s) {dead}: they match none "
+                    f"of the {len(names_seen)} leaves of this tree — "
+                    f"a param rename has probably orphaned them "
+                    f"(tests/test_partition.py freezes the golden "
+                    f"param paths; update the rule or the model, "
+                    f"or resolve with check_dead=False for a "
+                    f"deliberately partial tree)")
+        return out
+
+    def shardings(self, mesh: Mesh, tree, *, check_dead: bool = True):
+        """Pytree of NamedSharding over `mesh` — the jit
+        in/out_shardings form of `specs`."""
+        return jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec),
+            self.specs(tree, mesh=mesh, check_dead=check_dead),
+            is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_tree(mesh: Mesh, rules: PartitionRules, tree, *,
+               check_dead: bool = True):
+    """Place a pytree on `mesh` under `rules` — THE shard half of the
+    place/unplace pair every subsystem routes through. Multi-process
+    safe (each host feeds only its addressable shards), and a leaf
+    already under its resolved sharding is left untouched."""
+    sh = rules.shardings(mesh, tree, check_dead=check_dead)
+    return jax.tree.map(meshlib.put_with_sharding, tree, sh)
+
+
+def gather_tree(mesh: Mesh, tree):
+    """Re-place a (possibly sharded) pytree fully replicated on `mesh`
+    — the gather half: the layout checkpointing, cross-mesh handoff
+    (train -> serve), and host fetches expect. XLA inserts the
+    all-gathers; already-replicated leaves are untouched."""
+    rep = meshlib.replicated(mesh)
+    return jax.tree.map(lambda a: meshlib.put_with_sharding(a, rep),
+                        tree)

@@ -57,10 +57,9 @@ _MASKED = -1e30  # same finite sentinel as ring_attention._MASKED
 
 def cache_sharding(mesh: Mesh, axis: str = meshlib.SEQ_AXIS) -> NamedSharding:
     """[B, T_max, H, D] cache layout — identical to the training-side
-    q/k/v sharding (`mesh.batch_seq_spec`, the one shared definition),
-    so trained K/V drops in with no relayout."""
-    return NamedSharding(mesh, meshlib.batch_seq_spec(mesh, axis,
-                                                      trailing=2))
+    q/k/v sharding (`mesh.batch_seq_sharding`, the one construction
+    site), so trained K/V drops in with no relayout."""
+    return meshlib.batch_seq_sharding(mesh, axis, trailing=2)
 
 
 def init_cache(mesh: Mesh, batch: int, t_max: int, heads: int, dim: int,
@@ -144,8 +143,7 @@ def make_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]  # [B,H,D]
         return out[:, None].astype(q.dtype), kc, vc  # [B,1,H,D]
 
-    others = tuple(a for a in mesh.axis_names if a != axis)
-    bo = others if others else None
+    bo = meshlib.batch_axes(mesh, axis)   # "model" stays weight-only
     cache_spec = P(bo, axis, None, None)
     tok_spec = P(bo, None, None, None)
     mapped = shard_map(
@@ -302,8 +300,7 @@ def make_batched_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]
         return out[:, None].astype(q.dtype), kc, vc
 
-    others = tuple(a for a in mesh.axis_names if a != axis)
-    bo = others if others else None
+    bo = meshlib.batch_axes(mesh, axis)   # "model" stays weight-only
     cache_spec = P(bo, axis, None, None)
     tok_spec = P(bo, None, None, None)
     # scales are per (row, head): the batch dim shards with the caches'
@@ -461,8 +458,7 @@ def make_batched_chunk_ring_decode(mesh: Mesh, *,
         out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]
         return jnp.moveaxis(out, 1, 2).astype(q.dtype), kc, vc
 
-    others = tuple(a for a in mesh.axis_names if a != axis)
-    bo = others if others else None
+    bo = meshlib.batch_axes(mesh, axis)   # "model" stays weight-only
     cache_spec = P(bo, axis, None, None)
     tok_spec = P(bo, None, None, None)
     scale_specs = (P(bo, None), P(bo, None)) if quantized else ()
@@ -652,7 +648,7 @@ def make_paged_batched_ring_decode(mesh: Mesh, *, page_size: int,
         return out[:, None].astype(q.dtype), kp, vp
 
     pool_spec, rep, scale_specs = _paged_specs(mesh, axis, quantized)
-    tok_spec = P(tuple(a for a in mesh.axis_names if a != axis) or None,
+    tok_spec = P(meshlib.batch_axes(mesh, axis),
                  None, None, None)
     mapped = shard_map(
         per_device, mesh=mesh,
@@ -833,7 +829,7 @@ def make_paged_chunk_ring_decode(mesh: Mesh, *, page_size: int,
         return out, kp, vp
 
     pool_spec, rep, scale_specs = _paged_specs(mesh, axis, quantized)
-    tok_spec = P(tuple(a for a in mesh.axis_names if a != axis) or None,
+    tok_spec = P(meshlib.batch_axes(mesh, axis),
                  None, None, None)
     mapped = shard_map(
         per_device, mesh=mesh,
@@ -947,7 +943,7 @@ def make_paged_batched_chunk_ring_decode(mesh: Mesh, *, page_size: int,
         return jnp.moveaxis(out, 1, 2).astype(q.dtype), kp, vp
 
     pool_spec, rep, scale_specs = _paged_specs(mesh, axis, quantized)
-    tok_spec = P(tuple(a for a in mesh.axis_names if a != axis) or None,
+    tok_spec = P(meshlib.batch_axes(mesh, axis),
                  None, None, None)
     mapped = shard_map(
         per_device, mesh=mesh,
@@ -1055,8 +1051,7 @@ def make_chunk_ring_decode(mesh: Mesh, *, axis: str = meshlib.SEQ_AXIS,
         out = acc_glob / jnp.maximum(l_glob, 1e-37)[..., None]  # [B,H,C,D]
         return jnp.moveaxis(out, 1, 2).astype(q.dtype), kc, vc  # [B,C,H,D]
 
-    others = tuple(a for a in mesh.axis_names if a != axis)
-    bo = others if others else None
+    bo = meshlib.batch_axes(mesh, axis)   # "model" stays weight-only
     cache_spec = P(bo, axis, None, None)
     tok_spec = P(bo, None, None, None)
     mapped = shard_map(

@@ -110,7 +110,7 @@ class LMServer:
                  kv_page_size: int | None = None,
                  kv_pages: int | None = None,
                  kv_decode_reserve: int | None = None,
-                 registry=None, tenancy=None):
+                 registry=None, tenancy=None, partition_rules=None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
@@ -186,7 +186,8 @@ class LMServer:
             kv_page_size=kv_page_size, kv_pages=kv_pages,
             kv_decode_reserve=kv_decode_reserve,
             adapter_bank=(tenancy.bank if tenancy is not None
-                          else None))
+                          else None),
+            partition_rules=partition_rules)
         # slo: an optional observe.slo.SLOEngine — the metrics hooks
         # feed its declared objectives (ttft/queue_wait/error_rate) and
         # evaluate burn rates once per scheduler cycle

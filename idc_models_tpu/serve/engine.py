@@ -350,8 +350,7 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
     # P(None, "seq") describe one layout but are different keys, which
     # would recompile the window once when its input caches switch from
     # init_cache's spelling to a previous program's output (observed)
-    cache_sh = jax.sharding.NamedSharding(
-        mesh, meshlib.batch_seq_spec(mesh, trailing=0))
+    cache_sh = meshlib.batch_seq_sharding(mesh, trailing=0)
     rep = meshlib.replicated(mesh)
 
     def pin_state(caches, logits):
@@ -557,8 +556,7 @@ def _paged_engine_fns(cfg, pad_id: int, quant: bool, draft_k,
         mesh, page_size=page_size, jit=False, quantized=quant)
     ln = core.layer_norm(cfg.embed_dim)
     pick = _make_pick(cfg)
-    pool_sh = jax.sharding.NamedSharding(
-        mesh, jax.sharding.PartitionSpec(meshlib.SEQ_AXIS))
+    pool_sh = meshlib.sharding(mesh, meshlib.SEQ_AXIS)
     rep = meshlib.replicated(mesh)
 
     def pin_state(pools, logits):
@@ -800,7 +798,7 @@ class SlotEngine:
                  kv_page_size: int | None = None,
                  kv_pages: int | None = None,
                  kv_decode_reserve: int | None = None,
-                 adapter_bank=None):
+                 adapter_bank=None, partition_rules=None):
         if n_slots < 1:
             raise ValueError(f"need n_slots >= 1, got {n_slots}")
         # paged KV mode (ISSUE 11): the per-slot [t_max, H, D] ring
@@ -923,14 +921,30 @@ class SlotEngine:
                 return jax.tree.map(grow, caches)
 
             prefix_cache.set_packer(_pack, _unpack)
+        # the "model" axis is legal WITH partition rules: weights shard
+        # over it (registry.LM_RULES) while batch_seq_spec keeps the
+        # slot/KV layout off it — params and KV shard independently.
+        # Batch-bearing axes stay banned: requests prefill one at a
+        # time and [1, P] batches cannot shard.
         non_seq = [a for a in self._cfg.mesh.axis_names
-                   if a != meshlib.SEQ_AXIS
+                   if a not in (meshlib.SEQ_AXIS, meshlib.MODEL_AXIS)
                    and self._cfg.mesh.shape[a] > 1]
         if non_seq:
             raise ValueError(
-                f"serving mesh must be seq-only: requests prefill one at "
-                f"a time ([1, P] batches cannot shard over axes "
-                f"{non_seq}); build the engine on mesh.seq_mesh(n)")
+                f"serving mesh must be seq-only (plus an optional "
+                f"'model' weight axis): requests prefill one at a time "
+                f"([1, P] batches cannot shard over axes {non_seq}); "
+                f"build the engine on mesh.seq_mesh(n) or "
+                f"mesh.fsdp_tp_mesh(1, tp, seq)")
+        if (meshlib.MODEL_AXIS in self._cfg.mesh.axis_names
+                and self._cfg.mesh.shape[meshlib.MODEL_AXIS] > 1
+                and partition_rules is None):
+            raise ValueError(
+                "a 'model' mesh axis without partition_rules would "
+                "idle every device past the first ring: pass the "
+                "model's rule set (models/registry.py "
+                "get_partition_rules) so the params actually shard "
+                "over it")
         self._sfns = _serving_fns(self._cfg)
         self._n_ring = self._cfg.mesh.shape[meshlib.SEQ_AXIS]
         if self.paged:
@@ -945,7 +959,8 @@ class SlotEngine:
         else:
             self._efns = _engine_fns(self._cfg, int(pad_id),
                                      self.kv_int8, self.draft_k)
-        self._params = _place_params(params, self._cfg.mesh)
+        self._params = _place_params(params, self._cfg.mesh,
+                                     rules=partition_rules)
         self.t_max = t_max
         self.n_slots = n_slots
         self.pad_id = int(pad_id)

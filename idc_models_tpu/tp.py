@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from idc_models_tpu import mesh as meshlib
 
@@ -56,6 +56,10 @@ def channel_spec(x, n_model: int) -> P:
     """The sharding rule: split the last (output-channel) axis over
     "model" when it divides evenly and is non-trivial; replicate
     everything else (scalars, the Dense(1) head, odd-sized leaves).
+
+    Kept as the readable shape-form of the rule; `state_shardings`
+    resolves through `CHANNEL_RULES` (partition.py) — the two are
+    pinned equivalent by tests/test_partition.py.
     """
     shape = np.shape(x)
     if (len(shape) >= 1 and shape[-1] > 1 and shape[-1] % n_model == 0):
@@ -63,14 +67,24 @@ def channel_spec(x, n_model: int) -> P:
     return P()
 
 
+def channel_rules():
+    """The channel rule as a `partition.PartitionRules`: one catch-all
+    whose right-aligned ``P("model")`` shards every leaf's LAST axis
+    over "model" — divisibility fallback and scalar replication are the
+    resolution layer's own semantics, so this reproduces `channel_spec`
+    exactly while sharing the one resolution point."""
+    from idc_models_tpu import partition
+
+    return partition.PartitionRules(((r".*", P(meshlib.MODEL_AXIS)),))
+
+
 def state_shardings(mesh: Mesh, tree):
     """NamedSharding pytree for a TrainState (or any param-shaped tree)
     under the channel rule. Optimizer moments share their parameter's
     shape, so the same per-leaf rule shards them consistently; scalar
-    counters come out replicated."""
-    n_model = mesh.shape[meshlib.MODEL_AXIS]
-    return jax.tree.map(
-        lambda x: NamedSharding(mesh, channel_spec(x, n_model)), tree)
+    counters come out replicated. Resolved through partition.py — the
+    regex->spec layer shared by train, federated, and serve."""
+    return channel_rules().shardings(mesh, tree)
 
 
 def place(mesh: Mesh, tree):

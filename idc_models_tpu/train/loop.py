@@ -40,6 +40,7 @@ from idc_models_tpu.observe import metrics_registry as mreg
 from idc_models_tpu.observe import profile as prof
 from idc_models_tpu.observe import trace
 from idc_models_tpu.train import metrics as metrics_lib
+from idc_models_tpu.train import step as step_mod
 from idc_models_tpu.train.state import TrainState, create_train_state, rmsprop
 from idc_models_tpu.train.step import (
     jit_data_parallel, make_eval_step, make_train_step, place_state,
@@ -61,14 +62,19 @@ class Evaluator:
 
     def __init__(self, model: core.Module, loss_fn, mesh: Mesh, *,
                  batch_size: int = 32, compute_dtype=jnp.float32,
-                 with_auroc: bool = False):
+                 with_auroc: bool = False, rules=None):
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.batch_size = batch_size
         self.with_auroc = with_auroc
+        self.rules = rules
+        # under partition rules the state keeps its placed (sharded)
+        # layout — FOLLOW leaves the eval step's state pin to placement
         self._step = jit_data_parallel(
             make_eval_step(model, loss_fn, compute_dtype=compute_dtype),
-            mesh, donate_state=False)
+            mesh, donate_state=False,
+            state_shardings=(step_mod.FOLLOW if rules is not None
+                             else None))
         # multi-host: batch-sharded logits span other processes' devices
         # and cannot be fetched directly; this identity jit re-places them
         # fully replicated (XLA all-gather over ICI/DCN) first
@@ -77,7 +83,7 @@ class Evaluator:
 
     def __call__(self, state: TrainState, ds: ArrayDataset, *,
                  steps: int | None = None) -> dict[str, float]:
-        state = place_state(self.mesh, state)
+        state = place_state(self.mesh, state, rules=self.rules)
         logits = jnp.asarray(batched_forward(
             self.mesh, self._gather, ds, self.batch_size, steps,
             lambda x, y: self._step(state, x, y)["logits"]))
@@ -113,10 +119,11 @@ def batched_forward(mesh: Mesh, gather, ds: ArrayDataset, batch_size: int,
 def evaluate(model: core.Module, state: TrainState, ds: ArrayDataset,
              loss_fn, mesh: Mesh, *, batch_size: int = 32,
              steps: int | None = None, compute_dtype=jnp.float32,
-             with_auroc: bool = False) -> dict[str, float]:
+             with_auroc: bool = False, rules=None) -> dict[str, float]:
     """One-shot evaluation (builds a throwaway Evaluator)."""
     ev = Evaluator(model, loss_fn, mesh, batch_size=batch_size,
-                   compute_dtype=compute_dtype, with_auroc=with_auroc)
+                   compute_dtype=compute_dtype, with_auroc=with_auroc,
+                   rules=rules)
     return ev(state, ds, steps=steps)
 
 
@@ -157,7 +164,8 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         batch_size: int = 32, initial_epoch: int = 0, seed: int = 0,
         logger=None, verbose: bool = True, central_storage: bool = False,
         compute_dtype=jnp.float32, repeats: int = 1,
-        checkpoint_dir: str | None = None) -> tuple[TrainState, History]:
+        checkpoint_dir: str | None = None,
+        rules=None) -> tuple[TrainState, History]:
     """Keras-`fit`-shaped epoch loop over the jitted DP train step.
 
     Returns the final state and a Keras-style history dict
@@ -178,11 +186,27 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
     broadcast to the devices each step, with the updated state fetched
     back — numerically identical to the mirrored mode, paying a host
     round-trip per step exactly like variables-on-CPU compute-on-device.
+
+    `rules` (partition.PartitionRules) shards the FULL state — params,
+    BN stats, optimizer moments — by the regex->PartitionSpec policy
+    (FSDP over "data", TP over "model"; models/registry.py holds the
+    per-model defaults). The resolved shardings pin the step's state in
+    AND out, so the layout is stable across donated steps (zero jit
+    growth, gated by test) and the optimizer state shards with its
+    param.
     """
+    state_sh = (rules.shardings(mesh, state) if rules is not None
+                else None)
     base_step = jit_data_parallel(
         make_train_step(model, optimizer, loss_fn,
-                        compute_dtype=compute_dtype), mesh)
+                        compute_dtype=compute_dtype), mesh,
+        state_shardings=state_sh)
     if central_storage:
+        if rules is not None:
+            raise NotImplementedError(
+                "central_storage broadcasts a host-resident replica "
+                "each step and cannot keep a rule-sharded (FSDP/TP) "
+                "layout; drop partition rules or central_storage")
         if jax.process_count() > 1:
             raise NotImplementedError(
                 "central_storage is a single-host parity mode (the "
@@ -203,7 +227,7 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
             return jax.device_get(out), m
     else:
         step_fn = base_step
-        state = place_state(mesh, state)
+        state = place_state(mesh, state, rules=rules)
     # repeats>1 reproduces the reference CIFAR pipeline's `.repeat(2)`
     # (dist_model_tf_dense.py:122-123): each epoch passes over the train
     # set `repeats` times, freshly shuffled per pass. A Loader-shaped
@@ -218,7 +242,7 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         loader = train_ds.replace(batch_size=batch_size, shuffle=True,
                                   seed=seed, repeat=repeats)
     evaluator = (Evaluator(model, loss_fn, mesh, batch_size=batch_size,
-                           compute_dtype=compute_dtype)
+                           compute_dtype=compute_dtype, rules=rules)
                  if val_ds is not None else None)
     history: History = {"loss": [], "accuracy": [],
                         "val_loss": [], "val_accuracy": []}

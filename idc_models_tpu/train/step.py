@@ -91,8 +91,15 @@ def make_eval_step(model: core.Module, loss_fn: LossFn, *,
 # data-parallel jit wrappers
 # ---------------------------------------------------------------------------
 
+#: sentinel for `jit_data_parallel(state_shardings=...)`: leave the
+#: state's shardings unpinned so the step follows whatever layout
+#: `place_state` installed (the eval path under partition rules).
+FOLLOW = "follow"
+
+
 def jit_data_parallel(step_fn, mesh: Mesh, *, donate_state: bool = True,
-                      extra_batch_args: int = 0, axis: str | None = None):
+                      extra_batch_args: int = 0, axis: str | None = None,
+                      state_shardings=None):
     """Jit `step_fn(state, images, labels, *rest)` with DP shardings.
 
     State replicated; images/labels (and `extra_batch_args` further
@@ -101,15 +108,23 @@ def jit_data_parallel(step_fn, mesh: Mesh, *, donate_state: bool = True,
     a "client" mesh too). This is the whole MirroredStrategy replacement
     for D1.
 
-    On a 2-D ("data", "model") mesh the state's sharding is left to
-    follow its placement instead of being pinned replicated, so a state
-    placed by `place_state` keeps its channel-wise tensor-parallel
-    layout and GSPMD partitions the step accordingly (tp.py).
+    `state_shardings` overrides the state pin: a NamedSharding pytree
+    (from `partition.PartitionRules.shardings`, resolved over the full
+    TrainState so optimizer moments shard with their params) pins the
+    state in AND out — FSDP/TP layouts stay stable across donated
+    steps; the `FOLLOW` sentinel leaves the state unpinned to follow
+    its placement. On a 2-D ("data", "model") mesh without an explicit
+    override the state follows its `place_state` channel layout
+    (tp.py), as before.
     """
     from idc_models_tpu import tp
 
     repl = meshlib.replicated(mesh)
-    state_sh = None if tp.has_model_axis(mesh) else repl
+    if state_shardings is None:
+        state_sh = None if tp.has_model_axis(mesh) else repl
+    else:
+        state_sh = (None if isinstance(state_shardings, str)
+                    and state_shardings == FOLLOW else state_shardings)
     batch = meshlib.sharding(mesh, _batch_axis(mesh, axis))
     n_batch = 2 + extra_batch_args
     in_shardings = (state_sh,) + (batch,) * n_batch
@@ -155,12 +170,16 @@ def replicate(mesh: Mesh, tree):
     return jax.tree.map(lambda a: meshlib.put_with_sharding(a, sh), tree)
 
 
-def place_state(mesh: Mesh, tree):
+def place_state(mesh: Mesh, tree, rules=None):
     """Put a TrainState (or any param-shaped tree) on `mesh` in the
-    layout the jitted step expects: replicated on DP/client meshes,
-    channel-wise model-sharded on a ("data", "model") mesh (tp.py)."""
-    from idc_models_tpu import tp
+    layout the jitted step expects: under `rules`
+    (partition.PartitionRules — the FSDP/TP path) when given, else
+    channel-wise model-sharded on a ("data", "model") mesh (tp.py),
+    else replicated (DP/client meshes)."""
+    from idc_models_tpu import partition, tp
 
+    if rules is not None:
+        return partition.shard_tree(mesh, rules, tree)
     if tp.has_model_axis(mesh):
         return tp.place(mesh, tree)
     return replicate(mesh, tree)

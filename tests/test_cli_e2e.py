@@ -913,3 +913,89 @@ def test_cli_serve_tenant_usage_errors(capsys):
     with pytest.raises(SystemExit, match="is not a number"):
         cli.main(base + ["--tenants", "a", "--tenant-slo-ttft-ms",
                          "a=fast"])
+
+
+def test_cli_profile_lm_sharded(tmp_path, capsys):
+    """ISSUE-15 acceptance from the product surface: `profile --model
+    lm --fsdp 2` accounts the rule-sharded LM train step and prints
+    the per-device peak-HBM epilogue line; the replicated run prints
+    the same line so the two figures are comparable from the command
+    line (the gate itself — sharded < replicated — is asserted in
+    tests/test_partition.py)."""
+    import json
+    import re as _re
+
+    def peak_of(out):
+        m = _re.search(r"per-device peak HBM: ([0-9.]+) MiB over "
+                       r"(\d+) device", out)
+        assert m, out
+        return float(m.group(1)), int(m.group(2))
+
+    out = _run(["profile", "--model", "lm", "--host-devices", "8",
+                "--steps", "2", "--path", str(tmp_path)], capsys)
+    assert "profile: train.step (lm" in out and "replicated" in out
+    rep_mib, n = peak_of(out)
+    assert n == 1
+
+    out = _run(["profile", "--model", "lm", "--host-devices", "8",
+                "--steps", "2", "--fsdp", "2", "--tp", "2"], capsys)
+    assert "fsdp=2, tp=2 (rule set 'lm'" in out
+    sh_mib, n = peak_of(out)
+    assert n == 4
+    assert sh_mib < rep_mib          # the CLI surfaces the capacity win
+    jsonl = tmp_path / "logs" / "profile.jsonl"
+    recs = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    prog = [r for r in recs if r["event"] == "profile_program"][0]
+    assert prog["program"] == "train.step"
+    assert prog["peak_hbm_bytes"] == pytest.approx(rep_mib * 2**20,
+                                                   rel=1e-3)
+
+    # usage gates: the flags teach
+    with pytest.raises(SystemExit, match="--model lm"):
+        cli.main(["profile", "--model", "small", "--host-devices", "8",
+                  "--fsdp", "2"])
+    with pytest.raises(SystemExit, match="devices"):
+        cli.main(["profile", "--model", "lm", "--host-devices", "8",
+                  "--fsdp", "16"])
+    with pytest.raises(SystemExit, match="must be >= 0"):
+        cli.main(["profile", "--model", "lm", "--host-devices", "8",
+                  "--fsdp", "-1"])
+    with pytest.raises(SystemExit, match="divide by --fsdp"):
+        cli.main(["profile", "--model", "lm", "--host-devices", "8",
+                  "--fsdp", "2", "--batch-size", "3"])
+
+
+def test_cli_lm_fsdp_tp(capsys):
+    """The lm train verb on a rule-sharded ('data', 'model', 'seq')
+    mesh: trains, reports the sharded mesh line, and the compiled
+    serving path still generates — plus the usage gates."""
+    out = _run(["lm", "--host-devices", "8", "--fsdp", "2", "--tp",
+                "2", "--steps", "30", "--seq-len", "32",
+                "--generate", "4"], capsys)
+    assert "fsdp=2, tp=2" in out
+    assert "sharded by rule set 'lm'" in out
+    assert "generate:" in out
+    with pytest.raises(SystemExit, match="devices"):
+        cli.main(["lm", "--host-devices", "8", "--fsdp", "8", "--tp",
+                  "2", "--steps", "1"])
+    with pytest.raises(SystemExit, match="divide by --fsdp"):
+        cli.main(["lm", "--host-devices", "8", "--fsdp", "2",
+                  "--batch-size", "5", "--steps", "1"])
+
+
+def test_cli_serve_tp(tmp_path, capsys):
+    """The serve verb with --tp 2: params shard over 'model' (rule set
+    'lm'), KV keeps the seq ring, the trace completes — and --fsdp on
+    serve teaches toward --tp instead of shrugging."""
+    out = _run(["serve", "--host-devices", "8", "--tp", "2",
+                "--requests", "4", "--slots", "2", "--window", "4",
+                "--path", str(tmp_path)], capsys)
+    assert "serving mesh: tp=2 x seq=1" in out
+    assert "params sharded by rule set 'lm'" in out
+    assert "served: ok=4" in out
+    with pytest.raises(SystemExit, match="use --tp"):
+        cli.main(["serve", "--host-devices", "8", "--fsdp", "2",
+                  "--requests", "1"])
+    with pytest.raises(SystemExit, match="needs"):
+        cli.main(["serve", "--host-devices", "8", "--tp", "16",
+                  "--requests", "1"])

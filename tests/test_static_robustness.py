@@ -341,6 +341,11 @@ SERVE_EXCEPT_ALLOWLIST = {
         "the cleanup itself: release() may fail on the already-broken "
         "engine, but every in-flight slot must still be marked failed "
         "while the ORIGINAL engine error propagates to the caller",
+    ("api.py", "resubmit_pending"):
+        "journal recovery's documented skip: a WAL entry the rebuilt "
+        "server can never serve (decommissioned tenant, shrunken "
+        "t_max) is warned about and LEFT IN THE WAL for a rerun — "
+        "aborting would block every other tenant's recovery",
 }
 
 
@@ -763,4 +768,96 @@ def test_no_cross_tenant_reads_in_tenancy():
     stale = set(TENANCY_CROSS_TENANT_ALLOWLIST) - live
     assert not stale, (
         f"tenancy cross-tenant allowlist entries match no code: "
+        f"{stale}")
+
+
+# -- ISSUE 15: one sharding-resolution layer ----------------------------
+#
+# Placement policy lives in partition.py (regex->PartitionSpec rules)
+# and the mesh/tp helpers; before this PR ten files constructed
+# `NamedSharding(` / `PartitionSpec(` ad hoc, which is exactly how
+# subsystems drift apart (the serve engine's trailing-None recompile
+# was one symptom). The scan resolves `from jax.sharding import ...`
+# aliases (including `PartitionSpec as P`) plus attribute-form
+# `jax.sharding.X(` calls, and fails on any construction outside the
+# documented allowlist. shard_map in/out specs are fold INTERNALS —
+# per-device views of one program, not placement policy — so the
+# explicit-collective files are allowlisted as such.
+
+_SHARDING_CTORS = {"NamedSharding", "PartitionSpec"}
+
+# relative path -> why constructing sharding objects there is correct
+SHARDING_CTOR_ALLOWLIST = {
+    "partition.py":
+        "THE rule->spec resolution layer: adapts rule specs to leaf "
+        "shapes/meshes and builds the resolved NamedShardings",
+    "mesh.py":
+        "the axis-aware construction helpers (sharding, replicated, "
+        "batch_seq_spec/batch_seq_sharding) every other file calls",
+    "tp.py":
+        "the channel rule's readable shape-form (channel_spec) and "
+        "its rules instance",
+    "models/registry.py":
+        "the per-model DEFAULT rule sets: rule definitions are "
+        "(regex, PartitionSpec) pairs by construction",
+    "ring_decode.py":
+        "ring fold internals: shard_map per-device specs and the "
+        "cache/pool layouts the folds are written against",
+    "federated/fedavg.py":
+        "explicit-collective shard_map in/out specs of the round "
+        "program (client-axis fold internals)",
+    "federated/population.py":
+        "explicit-collective shard_map specs of the streamed wave "
+        "program (client-axis fold internals)",
+    "secure/fedavg.py":
+        "explicit-collective shard_map specs of the secure-masking "
+        "round (client-axis fold internals)",
+}
+
+
+def _scan_sharding_ctors(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(PACKAGE)).replace("\\", "/")
+    aliases = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom)
+                and node.module == "jax.sharding"):
+            for a in node.names:
+                if a.name in _SHARDING_CTORS:
+                    aliases[a.asname or a.name] = a.name
+    violations, live = [], set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        ctor = None
+        if isinstance(fn, ast.Name) and fn.id in aliases:
+            ctor = aliases[fn.id]
+        elif (isinstance(fn, ast.Attribute)
+              and fn.attr in _SHARDING_CTORS):
+            ctor = fn.attr
+        if ctor is None:
+            continue
+        live.add(rel)
+        if rel not in SHARDING_CTOR_ALLOWLIST:
+            violations.append((rel, node.lineno, ctor))
+    return violations, live
+
+
+def test_sharding_construction_single_layer():
+    violations, live = [], set()
+    for f in sorted(PACKAGE.rglob("*.py")):
+        v, l = _scan_sharding_ctors(f)
+        violations.extend(v)
+        live.update(l)
+    assert not violations, (
+        "NamedSharding(/PartitionSpec( constructed outside the "
+        "sharding layers — resolve placement through "
+        "partition.PartitionRules (models/registry.py holds the "
+        "per-model defaults) or the mesh.py helpers; extend the "
+        "documented SHARDING_CTOR_ALLOWLIST only for fold-internal "
+        f"shard_map specs: {violations}")
+    stale = set(SHARDING_CTOR_ALLOWLIST) - live
+    assert not stale, (
+        f"sharding-constructor allowlist entries match no code: "
         f"{stale}")
