@@ -355,6 +355,124 @@ def bench_backbone_throughput(model_name: str, on_accelerator: bool):
     return pps, tfs
 
 
+def bench_backbone_fused(on_accelerator: bool):
+    """ISSUE 16: the fused-backbone record — MobileNetV2 with the Pallas
+    depthwise+BN+relu6 chain (`depthwise_impl="fused"`) and DenseNet201
+    with concat-free packed blocks (`block_impl="packed"`) vs each
+    model's unfused baseline, SAME fine-tune train-step methodology as
+    `bench_backbone_throughput` (the variants come from
+    registry.FUSED_BUILD_KWARGS / UNFUSED_BUILD_KWARGS, the one
+    definition the profile verb and experiments/fused_backbone.py share).
+
+    Emits `{mobile,dense}_fused_patches_per_sec`, `*_fused_speedup`
+    (fused/unfused throughput) and — only where a roofline is known, so
+    TPU device kinds — `*_fused_hbm_utilization`, the achieved fraction
+    of peak HBM bytes/s. The mobile byte count merges the analytic
+    Pallas-kernel cost (ops/fused_conv.depthwise_call_cost via
+    mobilenet.fused_call_shapes) into XLA's accounting, which cannot
+    see inside pallas_call (docs/BENCHMARKS.md MFU-attribution note);
+    DenseNet's packed blocks are ordinary XLA ops, fully accounted.
+
+    Structural gates run on EVERY backend: both variants of each model
+    must agree on a forward pass (fp-close; bit-close for the packed
+    DenseNet) from identical init params — on CPU the Pallas kernel
+    runs in interpret mode, so this is the same-code-path parity the
+    tier-1 suite banks on. The speedup >= 1 PERF gate is asserted only
+    on TPU device kinds: interpret-mode Pallas on CPU is a correctness
+    vehicle, not a performance claim."""
+    import jax
+    import jax.numpy as jnp
+
+    from idc_models_tpu.configs import BENCH_TRAIN_CONFIGS
+    from idc_models_tpu.models import registry
+    from idc_models_tpu.observe.profile import roofline_for
+    from idc_models_tpu.train import rmsprop
+    from idc_models_tpu.train.losses import (
+        binary_cross_entropy, sparse_categorical_cross_entropy,
+    )
+
+    dev = jax.devices()[0]
+    n_dev = len(jax.devices())
+    spec_roof = roofline_for(dev) if on_accelerator else None
+    out = {}
+    for model_name, tag in (("mobilenet_v2", "mobile"),
+                            ("densenet201", "dense")):
+        cfg = BENCH_TRAIN_CONFIGS[model_name]
+        per_chip = cfg["batch_per_chip"] if on_accelerator else 1
+        batch = per_chip * n_dev
+        size = cfg["image_size"]
+        spec = registry.get_model(model_name)
+        loss_fn = (binary_cross_entropy if cfg["num_outputs"] == 1
+                   else sparse_categorical_cross_entropy)
+        rng = np.random.default_rng(0)
+        imgs = rng.random((batch, size, size, 3)).astype(np.float32)
+        labels = rng.integers(0, max(cfg["num_outputs"], 2),
+                              batch).astype(np.int32)
+
+        # forward parity gate: identical init (deterministic from the
+        # module structure + key) through both data paths, eval mode so
+        # the mobile fused chain engages on every depthwise layer
+        fused_kw = registry.FUSED_BUILD_KWARGS[model_name]
+        base_kw = registry.UNFUSED_BUILD_KWARGS[model_name]
+        m_fused = spec.build(cfg["num_outputs"], 3,
+                             bn_frozen_below=cfg["fine_tune_at"],
+                             **fused_kw)
+        m_base = spec.build(cfg["num_outputs"], 3,
+                            bn_frozen_below=cfg["fine_tune_at"],
+                            **base_kw)
+        v = m_fused.init(jax.random.key(0))
+        xp = jnp.asarray(imgs[: min(batch, 2)])
+        y_f, _ = jax.jit(lambda p, s, a: m_fused.apply(p, s, a,
+                                                       train=False))(
+            v.params, v.state, xp)
+        y_b, _ = jax.jit(lambda p, s, a: m_base.apply(p, s, a,
+                                                      train=False))(
+            v.params, v.state, xp)
+        np.testing.assert_allclose(
+            np.asarray(y_f), np.asarray(y_b), rtol=1e-4, atol=1e-4,
+            err_msg=f"{model_name}: fused forward disagrees with the "
+                    f"unfused baseline — the fused record would be "
+                    f"measuring a different model")
+
+        pps = {}
+        bytes_per_step = None
+        for variant, model in (("fused", m_fused), ("base", m_base)):
+            opt = rmsprop(cfg["lr"], trainable_mask=spec.fine_tune_mask(
+                model.init(jax.random.key(0)).params,
+                cfg["fine_tune_at"]))
+            r = _timed_train_step(model, opt, loss_fn, imgs, labels,
+                                  on_accelerator)
+            pps[variant] = r["steps"] * batch / r["dt"] / n_dev
+            if variant == "fused":
+                from idc_models_tpu.observe.profile import program_report
+
+                cost = program_report(r["compiled"], name=f"{tag}.fused")
+                bytes_per_step = cost.bytes_accessed
+                if model_name == "mobilenet_v2":
+                    from idc_models_tpu.models import mobilenet
+                    from idc_models_tpu.ops import fused_conv
+
+                    _, k_bytes = fused_conv.depthwise_chain_cost(
+                        mobilenet.fused_call_shapes(batch, size))
+                    bytes_per_step = (bytes_per_step or 0.0) + k_bytes
+                step_s_fused = r["dt"] / r["steps"]
+        speedup = pps["fused"] / pps["base"]
+        out[f"{tag}_fused_patches_per_sec"] = round(pps["fused"], 2)
+        out[f"{tag}_fused_speedup"] = round(speedup, 3)
+        if spec_roof is not None and bytes_per_step:
+            achieved_gbps = bytes_per_step / n_dev / step_s_fused / 1e9
+            out[f"{tag}_fused_hbm_utilization"] = round(
+                achieved_gbps / spec_roof.peak_hbm_gbps, 4)
+        if on_accelerator and dev.platform == "tpu":
+            assert speedup >= 1.0, (
+                f"{model_name}: fused backbone is SLOWER than the "
+                f"unfused baseline on {dev.device_kind} "
+                f"({pps['fused']:.0f} vs {pps['base']:.0f} patches/s) — "
+                f"the fused default must not ship a regression "
+                f"(ISSUE 16 perf gate)")
+    return out
+
+
 def bench_zigzag_schedule(on_accelerator: bool):
     """Zigzag vs contiguous causal ring COMPUTE schedule (emulated
     ring-of-8 per-device schedule, pallas blocks, t_local=16384) — the
@@ -2294,6 +2412,10 @@ HIGHER_IS_BETTER = (
     "cached_fine_tune_patches_per_sec_per_chip",
     "mobile_patches_per_sec_per_chip", "mobile_mfu",
     "dense_patches_per_sec_per_chip", "dense_mfu",
+    "mobile_fused_patches_per_sec", "mobile_fused_speedup",
+    "mobile_fused_hbm_utilization",
+    "dense_fused_patches_per_sec", "dense_fused_speedup",
+    "dense_fused_hbm_utilization",
     "decode_tokens_per_sec", "serve_tokens_per_sec",
     "serve_speedup_vs_serial", "serve_slot_occupancy",
     "serve_prefix_hit_rate", "serve_int8_kv_slot_capacity_ratio",
@@ -2462,6 +2584,7 @@ def main() -> None:
         "mobilenet_v2", on_accelerator)
     dense_pps, dense_tfs = bench_backbone_throughput(
         "densenet201", on_accelerator)
+    fused = bench_backbone_fused(on_accelerator)
     fed_round_s = bench_fed_round(on_accelerator)
     fed_round_32_s = bench_fed_round(on_accelerator, n_clients=32)
     secure_round_s = bench_secure_round(on_accelerator)
@@ -2546,6 +2669,8 @@ def main() -> None:
         "dense_patches_per_sec_per_chip": round(dense_pps, 2),
         "dense_mfu": (round(dense_tfs / peak, 4)
                       if peak and dense_tfs else None),
+        # ISSUE 16: fused Pallas backbone variants vs their baselines
+        **fused,
         "fed_round_s": round(fed_round_s, 4),
         "fed_round_32_s": round(fed_round_32_s, 4),
         "secure_round_s": round(secure_round_s, 4),

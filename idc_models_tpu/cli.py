@@ -693,6 +693,17 @@ def _parse(argv):
     sp.add_argument("--peak-gbps", type=float, default=None,
                     help="override/declare the backend's peak memory "
                          "bandwidth in GB/s")
+    sp.add_argument("--depthwise-impl", default="grouped",
+                    choices=("grouped", "taps", "fused"),
+                    help="with --model mobile: the depthwise lowering "
+                         "(models/core.py depthwise_conv2d). 'fused' "
+                         "runs the Pallas depthwise+BN+relu6 chain "
+                         "(ops/fused_conv.py) and merges its analytic "
+                         "FLOPs/bytes into the train.step account — "
+                         "Pallas calls are opaque to XLA "
+                         "cost_analysis, so without the merge the "
+                         "roofline verdict would read from "
+                         "under-counted zeros")
     sp.add_argument("--churn-drill", action="store_true",
                     help="end the run with a deliberately "
                          "shape-varying jitted loop so the "
@@ -1041,6 +1052,8 @@ def _profile_train_step(ns, on_accel, dev):
         # BN-freeze only exists on the BN backbones (VGG has none)
         build_kw = ({"bn_frozen_below": cfg["ft"]}
                     if ns.model in ("mobile", "dense") else {})
+        if ns.model == "mobile":
+            build_kw["depthwise_impl"] = ns.depthwise_impl
         model = spec.build(cfg["outputs"], 3, **build_kw)
         variables = model.init(jax.random.key(ns.seed))
         opt = rmsprop(cfg["lr"],
@@ -1066,7 +1079,20 @@ def _profile_train_step(ns, on_accel, dev):
     with prof.compiling("train.step"):
         compiled = step.lower(state, x, y,
                               jax.random.key(ns.seed + 1)).compile()
-    cost = prof.register_program("train.step", compiled)
+    cost = prof.program_report(compiled, name="train.step")
+    if ns.model == "mobile" and ns.depthwise_impl == "fused":
+        # the fused depthwise chains run as Pallas custom calls, which
+        # XLA's cost_analysis reports at zero — merge their analytic
+        # account so the roofline verdict reads real intensity instead
+        # of silently under-counted figures
+        from idc_models_tpu.models import mobilenet
+        from idc_models_tpu.ops import fused_conv
+
+        k_flops, k_bytes = fused_conv.depthwise_chain_cost(
+            mobilenet.fused_call_shapes(total, cfg["image"]))
+        cost = prof.augment_cost(cost, flops=k_flops,
+                                 bytes_accessed=k_bytes)
+    cost = prof.register_cost("train.step", cost)
     digest = jax.jit(
         lambda st: jnp.sum(jax.tree.leaves(
             st.params)[0].astype(jnp.float32)))

@@ -177,14 +177,21 @@ def depthwise_conv2d(features: int, kernel_size: int | tuple = 3, *,
       v5e): the native grouped lowering WINS — 234k vs 138k patches/s
       at batch 2048 — so "grouped" stays the default and "taps" remains
       as the measured ablation that closed the question.
+    - "fused": the Pallas kernel (ops/fused_conv.py) — the taps math
+      computed on a VMEM-resident tile (interpreted off-TPU, so the
+      same code path runs in tier-1 on CPU). Standalone it runs with an
+      identity affine; its point is the cross-LAYER fusion
+      models/mobilenet.py drives through it (depthwise+BN+relu6 in one
+      kernel, see unit_backbone's `run` attributes). Stays opt-in until
+      the perf gate holds on TPU (ISSUE 16 acceptance).
     """
     kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
               else kernel_size)
     strides = (stride, stride) if isinstance(stride, int) else stride
-    if impl not in ("grouped", "taps"):
-        raise ValueError(f"impl must be grouped|taps, got {impl!r}")
-    if impl == "taps" and padding != "SAME":
-        raise ValueError("impl='taps' implements SAME padding only")
+    if impl not in ("grouped", "taps", "fused"):
+        raise ValueError(f"impl must be grouped|taps|fused, got {impl!r}")
+    if impl in ("taps", "fused") and padding != "SAME":
+        raise ValueError(f"impl={impl!r} implements SAME padding only")
 
     def init(rng):
         fan_in = kh * kw
@@ -196,6 +203,15 @@ def depthwise_conv2d(features: int, kernel_size: int | tuple = 3, *,
 
     def apply(params, state, x, *, train=False, rng=None):
         w = params["kernel"].astype(x.dtype)
+        if impl == "fused":
+            from idc_models_tpu.ops import fused_conv
+
+            ones = jnp.ones((features,), jnp.float32)
+            add = (params["bias"].astype(jnp.float32) if use_bias
+                   else jnp.zeros((features,), jnp.float32))
+            y = fused_conv.fused_depthwise_affine(
+                x, w, ones, add, stride=strides, clamp6=False)
+            return y, state
         if impl == "taps":
             sh, sw = strides
             _, h_in, w_in, _ = x.shape
@@ -485,6 +501,17 @@ def unit_backbone(units: Sequence[tuple[list[str], Callable]],
     returned Module's `splitter(fine_tune_at)` cuts at the first unit
     containing a layer with Keras index >= fine_tune_at (indices are
     monotone in creation order, so everything before it is frozen).
+
+    `run` exposes the section's traced trees as attributes —
+    `run.params`, `run.state`, `run.train` — so a unit may implement a
+    lowering that SPANS layer boundaries (e.g. mobilenet's fused
+    depthwise+BN+relu6 Pallas chain, which needs the BN layer's
+    params/stats alongside the conv kernel) while the param/state
+    namespace stays flat per-layer (pretrained loading, masks, and
+    summary never see the fusion). A unit taking that path must be
+    value-equivalent to the per-layer `run` composition and may only
+    bypass `run` for layers whose state it provably leaves unchanged
+    (frozen/eval BN returns its state untouched).
     """
 
     def section(lo: int, hi: int, sec_name: str, splitter=None) -> Module:
@@ -512,6 +539,7 @@ def unit_backbone(units: Sequence[tuple[list[str], Callable]],
                     new_state[n] = s2
                 return y
 
+            run.params, run.state, run.train = params, state, train
             for _, unit_fn in units[lo:hi]:
                 x = unit_fn(run, x)
             return x, new_state

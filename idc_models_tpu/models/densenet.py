@@ -12,6 +12,21 @@ transitions, final BN+ReLU. All convs bias-free; BN eps=1.001e-5. Total
 params (incl. BN moving stats) = 18,321,984, matching Keras
 include_top=False.
 
+Dense blocks are CONCAT-FREE by default (`block_impl="packed"`, ISSUE
+16): the literal `concat(h, f(h))` re-reads and re-writes the whole
+growing feature map at every layer — the PR 14 MFU attribution measured
+2.3 GB moved for 4.7 GFLOP, arithmetic intensity 2.0 against the v5e
+ridge of ~240 — so instead the block's full [N, H, W, C_final] buffer
+is allocated ONCE at the block's first layer and each layer
+`dynamic_update_slice`s its 32-channel output into the next free
+channel range, reading its input as a static slice of the buffer.
+Channel layout ([input, y_1, y_2, ...]) is exactly the iterated-concat
+layout, and every conv/BN sees bit-identical inputs, so pretrained
+weight loading, golden outputs, and param counts are unchanged —
+pinned by tests/test_fused_conv.py against `block_impl="concat"`, the
+reference implementation kept for that parity test (and allowlisted as
+such by the test_static_robustness concat ban).
+
 `KERAS_LAYER_INDEX` reproduces Keras' flat layer numbering so the
 reference's `fine_tune_at=150` (an index into `base_model.layers`, landing
 inside conv4_block2) selects the same parameters here.
@@ -74,15 +89,40 @@ KERAS_LAYER_INDEX = _build_index()
 FREEZE_ALL = 10**9
 
 
-def _units(in_channels: int, bn_frozen_below: int):
+def _units(in_channels: int, bn_frozen_below: int,
+           block_impl: str = "packed"):
     """The backbone as topology units (stem, one unit per dense layer,
     one per transition, final BN) over the flat Keras-layer-name params:
-    a dense layer is `h -> concat(h, f(h))` — a pure function of its
-    input — so every unit edge is a valid split point for the
-    frozen-backbone feature cache despite the dense-concat topology.
+    a dense layer is `h -> concat(h, f(h))` semantically — a pure
+    function of its input — so every unit edge is a valid split point
+    for the frozen-backbone feature cache despite the dense topology.
     Module-level (like mobilenet._units) so per-stage attribution
     microbenches (experiments/backbone_mfu.py) can build stage
-    sub-models from unit ranges."""
+    sub-models from unit ranges.
+
+    `block_impl` picks the dense-block data movement, same values
+    either way:
+
+    - "packed" (default): the block's [N, H, W, C_final] buffer is
+      allocated once at the block's first layer; each layer reads the
+      static slice [:, :, :, :c_in] and dynamic_update_slices its
+      32-channel output at c_in. Between the block's unit edges the
+      activation carries C_final channels with the not-yet-written
+      tail zero-filled — downstream layers never read it, and by the
+      last layer the buffer is exactly full, so transitions and split
+      points see the ordinary fully-valid tensor. (A mid-block split
+      caches the partially-filled buffer; prefix-then-suffix
+      composition stays bit-exact since each layer touches only its
+      static channel ranges.)
+    - "concat": the literal `concat(h, f(h))` — the parity reference
+      the packed path is pinned bit-close against
+      (tests/test_fused_conv.py) and the bench_backbone_fused
+      baseline. Not for production use: it re-materializes the whole
+      growing feature map every layer.
+    """
+    if block_impl not in ("packed", "concat"):
+        raise ValueError(
+            f"block_impl must be packed|concat, got {block_impl!r}")
     specs: list[tuple[str, core.Module]] = []
 
     def reg(m) -> str:
@@ -112,6 +152,15 @@ def _units(in_channels: int, bn_frozen_below: int):
 
     units.append((stem_names, stem))
 
+    def bottleneck(run, x, *, p):
+        """One dense layer's BN-relu-conv1x1-BN-relu-conv3x3 trunk —
+        shared by both block impls; they differ only in how its
+        32-channel output joins the feature map."""
+        y = jax.nn.relu(run(f"{p}_0_bn", x))
+        y = run(f"{p}_1_conv", y)
+        y = jax.nn.relu(run(f"{p}_1_bn", y))
+        return run(f"{p}_2_conv", y)
+
     c = 64
     for stage, n_layers in enumerate(_BLOCKS, start=2):
         for l in range(1, n_layers + 1):
@@ -125,14 +174,32 @@ def _units(in_channels: int, bn_frozen_below: int):
                                 name=f"{p}_2_conv")),
             ]
 
-            def dense_layer(run, h, *, p=p):
-                y = jax.nn.relu(run(f"{p}_0_bn", h))
-                y = run(f"{p}_1_conv", y)
-                y = jax.nn.relu(run(f"{p}_1_bn", y))
-                y = run(f"{p}_2_conv", y)
-                return jnp.concatenate([h, y], axis=-1)
+            def dense_layer_packed(run, h, *, p=p,
+                                   c_in=c + (l - 1) * _GROWTH,
+                                   c_final=c + n_layers * _GROWTH,
+                                   first=(l == 1)):
+                # all channel offsets are static, so reads/writes lower
+                # to in-place slices instead of whole-map concat copies
+                if first:
+                    buf = jnp.zeros(h.shape[:3] + (c_final,), h.dtype)
+                    buf = jax.lax.dynamic_update_slice_in_dim(
+                        buf, h, 0, axis=3)
+                else:
+                    buf = h
+                y = bottleneck(
+                    run, jax.lax.slice_in_dim(buf, 0, c_in, axis=3), p=p)
+                return jax.lax.dynamic_update_slice_in_dim(
+                    buf, y.astype(buf.dtype), c_in, axis=3)
 
-            units.append((names, dense_layer))
+            def dense_layer_concat(run, h, *, p=p):
+                # parity reference ONLY (test_static_robustness bans
+                # concatenate in this file outside this function)
+                return jnp.concatenate([h, bottleneck(run, h, p=p)],
+                                       axis=-1)
+
+            units.append((names, dense_layer_packed
+                          if block_impl == "packed"
+                          else dense_layer_concat))
         c = c + n_layers * _GROWTH
         if stage < 5:
             names = [
@@ -156,10 +223,14 @@ def _units(in_channels: int, bn_frozen_below: int):
 
 
 def densenet201_backbone(in_channels: int = 3, *,
-                         bn_frozen_below: int = 0) -> core.Module:
+                         bn_frozen_below: int = 0,
+                         block_impl: str = "packed") -> core.Module:
     """`bn_frozen_below`: BN layers with Keras index < this run in
-    permanent inference mode (Keras trainable=False semantics)."""
-    units, modules = _units(in_channels, bn_frozen_below)
+    permanent inference mode (Keras trainable=False semantics).
+    `block_impl`: dense-block data movement — "packed" (concat-free
+    default) or "concat" (the parity-reference copy chain); see
+    `_units`."""
+    units, modules = _units(in_channels, bn_frozen_below, block_impl)
     # layer_names in Keras creation order (see mobilenet.py) so secure
     # percent-selection keeps get_weights() order for this backbone
     sec = core.unit_backbone(units, modules, "densenet201",
@@ -172,9 +243,11 @@ DENSENET201_FEATURES = 1920
 
 
 def densenet201(num_outputs: int = 10, in_channels: int = 3, *,
-                bn_frozen_below: int = 0) -> core.Module:
+                bn_frozen_below: int = 0,
+                block_impl: str = "packed") -> core.Module:
     backbone = densenet201_backbone(in_channels,
-                                    bn_frozen_below=bn_frozen_below)
+                                    bn_frozen_below=bn_frozen_below,
+                                    block_impl=block_impl)
     return core.classifier(backbone, DENSENET201_FEATURES, num_outputs,
                            name="densenet201_classifier")
 

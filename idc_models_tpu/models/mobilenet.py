@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from idc_models_tpu.models import core
+from idc_models_tpu.ops import fused_conv
 
 # (expansion t, out channels c, stride s) per block, keras order
 _BLOCKS = (
@@ -109,6 +110,26 @@ def _units(in_channels: int, bn_frozen_below: int,
     def relu6(h):
         return jnp.minimum(jax.nn.relu(h), 6.0)
 
+    def dw_chain(run, h, dw_name, bn_name, *, stride):
+        """The depthwise-conv -> BN -> relu6 chain. With
+        depthwise_impl="fused" and the BN in inference mode (frozen —
+        a BUILD-time constant — or eval), the whole chain runs as one
+        Pallas kernel on the BN-folded affine (ops/fused_conv.py),
+        reading params/stats through `run`'s attribute views; both
+        layers' states are provably untouched there (frozen/eval BN
+        returns state as-is), so bypassing `run` is state-identical.
+        Unfrozen train mode needs batch statistics, so it keeps the
+        unfused per-layer composition — as does every other impl."""
+        frozen = KERAS_LAYER_INDEX[bn_name] < bn_frozen_below
+        if depthwise_impl == "fused" and (frozen or not run.train):
+            p_bn = run.params[bn_name]
+            s_bn = run.state[bn_name]
+            return fused_conv.fused_depthwise_bn_relu6(
+                h, run.params[dw_name]["kernel"].astype(h.dtype),
+                p_bn["scale"], p_bn["bias"], s_bn["mean"], s_bn["var"],
+                eps=_BN["eps"], stride=stride)
+        return relu6(run(bn_name, run(dw_name, h)))
+
     units: list[tuple[list[str], object]] = []
 
     stem_names = [
@@ -126,8 +147,8 @@ def _units(in_channels: int, bn_frozen_below: int,
 
     def stem(run, x):
         h = relu6(run("bn_Conv1", run("Conv1", x)))
-        h = relu6(run("expanded_conv_depthwise_BN",
-                      run("expanded_conv_depthwise", h)))
+        h = dw_chain(run, h, "expanded_conv_depthwise",
+                     "expanded_conv_depthwise_BN", stride=1)
         return run("expanded_conv_project_BN",
                    run("expanded_conv_project", h))
 
@@ -149,11 +170,11 @@ def _units(in_channels: int, bn_frozen_below: int,
             reg(_bn(c, f"block_{b}_project_BN")),
         ]
 
-        def block(run, h, *, b=b, residual=(s == 1 and c == c_in)):
+        def block(run, h, *, b=b, s=s, residual=(s == 1 and c == c_in)):
             inp = h
             h = relu6(run(f"block_{b}_expand_BN", run(f"block_{b}_expand", h)))
-            h = relu6(run(f"block_{b}_depthwise_BN",
-                          run(f"block_{b}_depthwise", h)))
+            h = dw_chain(run, h, f"block_{b}_depthwise",
+                         f"block_{b}_depthwise_BN", stride=s)
             h = run(f"block_{b}_project_BN", run(f"block_{b}_project", h))
             return h + inp if residual else h
 
@@ -167,6 +188,25 @@ def _units(in_channels: int, bn_frozen_below: int,
     units.append((top_names, lambda run, h: relu6(run("Conv_1_bn",
                                                       run("Conv_1", h)))))
     return units, dict(specs)
+
+
+def fused_call_shapes(batch: int, size: int) -> list[dict]:
+    """The fused depthwise chain's call schedule at an input resolution:
+    one dict of `ops.fused_conv.depthwise_call_cost` kwargs per
+    depthwise layer (stem + 16 blocks), tracking the spatial walk
+    (stride-2 stem conv, then each stride-2 depthwise halves again).
+    XLA's cost_analysis cannot see inside the Pallas calls, so
+    `profile --model mobile --depthwise-impl fused` sums these into
+    its ProgramCost (cli.py via observe.profile.augment_cost)."""
+    h = -(-size // 2)                      # after the stride-2 stem conv
+    calls = [dict(n=batch, h_in=h, w_in=h, c=32, stride=1)]
+    c_in = 16
+    for t, c, s in _BLOCKS[1:]:
+        calls.append(dict(n=batch, h_in=h, w_in=h, c=t * c_in, stride=s))
+        if s == 2:
+            h = -(-h // 2)
+        c_in = c
+    return calls
 
 
 def mobilenet_v2_backbone(in_channels: int = 3, *,

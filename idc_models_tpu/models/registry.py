@@ -117,3 +117,21 @@ def get_model(name: str) -> ModelSpec:
     if name not in REGISTRY:
         raise KeyError(f"unknown model {name!r}; have {sorted(REGISTRY)}")
     return REGISTRY[name]
+
+
+# ISSUE 16: the one place defining what "fused backbone" means per
+# model, so bench.py (bench_backbone_fused), the profile verb, and
+# experiments/fused_backbone.py build the same variants. For
+# mobilenet the fused Pallas depthwise chain is OPT-IN (default
+# "grouped" until the TPU perf gate holds — ISSUE 16 acceptance);
+# for densenet the concat-free packed blocks ARE the default (parity
+# is bit-exact, pinned on CPU), so its "unfused" baseline opts back
+# into the concat reference.
+FUSED_BUILD_KWARGS: dict[str, dict] = {
+    "mobilenet_v2": {"depthwise_impl": "fused"},
+    "densenet201": {"block_impl": "packed"},
+}
+UNFUSED_BUILD_KWARGS: dict[str, dict] = {
+    "mobilenet_v2": {"depthwise_impl": "grouped"},
+    "densenet201": {"block_impl": "concat"},
+}

@@ -199,13 +199,47 @@ PROGRAMS: dict[str, ProgramCost] = {}
 _programs_lock = threading.Lock()
 
 
-def register_program(name: str, compiled, *,
-                     registry: mreg.MetricsRegistry | None = None
-                     ) -> ProgramCost:
-    """`program_report` + file the result under `name` in `PROGRAMS`
-    and the metrics registry (`program_flops{program}` etc.), so every
-    subsystem's programs report through one table."""
-    cost = program_report(compiled, name=name)
+def augment_cost(cost: ProgramCost, *, flops: float = 0.0,
+                 bytes_accessed: float = 0.0) -> ProgramCost:
+    """Merge hand-computed FLOPs/bytes into a ProgramCost.
+
+    The accounting path for Pallas kernels: XLA's `cost_analysis`
+    cannot see inside a custom call, so a program whose hot ops are
+    Pallas (e.g. the fused depthwise chains of
+    `profile --model mobile --depthwise-impl fused`) under-reports —
+    silently poisoning every MFU/roofline figure built on it. Callers
+    add the kernels' analytic account (ops/fused_conv.py
+    `depthwise_chain_cost`) here, then file the merged record via
+    `register_cost`; `arithmetic_intensity`, `available`, and
+    `missing` are recomputed so a previously degraded record becomes a
+    real one."""
+    if not flops and not bytes_accessed:
+        return cost
+    new_flops = (cost.flops or 0.0) + float(flops)
+    new_bytes = (cost.bytes_accessed or 0.0) + float(bytes_accessed)
+    missing = tuple(m for m in cost.missing
+                    if not (m == "flops" and new_flops)
+                    and not (m == "bytes_accessed" and new_bytes))
+    return dataclasses.replace(
+        cost,
+        flops=new_flops if new_flops else None,
+        bytes_accessed=new_bytes if new_bytes else None,
+        arithmetic_intensity=(new_flops / new_bytes
+                              if new_flops and new_bytes else None),
+        available=True, missing=missing)
+
+
+def register_cost(name: str, cost: ProgramCost, *,
+                  registry: mreg.MetricsRegistry | None = None
+                  ) -> ProgramCost:
+    """File an already-built ProgramCost under `name` in `PROGRAMS` and
+    the metrics registry — the shared tail of `register_program`, and
+    the entry point for costs that are partly hand-computed
+    (`augment_cost`) rather than extracted from a compiled executable
+    (which keeps `program_report` the single cost_analysis site the
+    static scan enforces)."""
+    if cost.program != name:
+        cost = dataclasses.replace(cost, program=name)
     with _programs_lock:
         PROGRAMS[name] = cost
     reg = registry if registry is not None else mreg.REGISTRY
@@ -225,6 +259,16 @@ def register_program(name: str, compiled, *,
     if wd is not None and cost.flops is not None:
         wd.note_flops(name, cost.flops)
     return cost
+
+
+def register_program(name: str, compiled, *,
+                     registry: mreg.MetricsRegistry | None = None
+                     ) -> ProgramCost:
+    """`program_report` + file the result under `name` in `PROGRAMS`
+    and the metrics registry (`program_flops{program}` etc.), so every
+    subsystem's programs report through one table."""
+    return register_cost(name, program_report(compiled, name=name),
+                         registry=registry)
 
 
 def register_jit(name: str, fn, *args, **kw) -> ProgramCost | None:

@@ -1,0 +1,291 @@
+"""Pallas TPU kernel: fused depthwise-conv + folded-BN + ReLU6 (ISSUE 16).
+
+MobileNetV2's hot chains lower as three separate XLA ops — depthwise
+conv, batchnorm, relu6 — each materializing the full activation tensor
+in HBM between them. A depthwise conv does ~9 FLOPs per activation
+byte (no channel contraction, nothing for the MXU to reduce), so every
+unfused boundary roughly doubles the bytes per useful FLOP; the PR 14
+MFU attribution (docs/BENCHMARKS.md) measured the whole train step at
+arithmetic intensity 3.5 vs the v5e ridge of ~240 and named these
+chains as the implicated lowering. This kernel keeps the activation
+tile in VMEM across all three ops: one grid cell loads an image's
+padded activation once, runs the kh*kw shifted multiply-accumulates
+(the same taps formulation `core.depthwise_conv2d(impl="taps")` pins
+against XLA's grouped lowering), applies the FOLDED batchnorm as one
+scale/shift, clamps to [0, 6], and writes the output tile — HBM
+traffic is x in + y out, nothing between.
+
+BN folding happens OUTSIDE the kernel (and outside the custom_vjp), in
+plain jnp, so it stays differentiable for free:
+
+    mul = scale * rsqrt(var + eps)
+    add = bias - mean * mul
+    y   = relu6(dwconv(x) * mul + add)
+
+which is exactly the inference / frozen-BN composition — the paths the
+transfer-learning recipe runs (`bn_frozen_below` freezes every BN
+below the fine-tune boundary, and phase-1 freezes all of them). In
+unfrozen train mode BN needs batch statistics, so callers fall back to
+the unfused chain there (models/mobilenet.py does this per-layer,
+statically).
+
+Grid/tiling: one grid cell per (image, channel tile). Spatial tiling
+is deliberately NOT done — a 3x3 conv's spatial tiles overlap by a
+halo, and Pallas BlockSpecs cannot express overlapping blocks, so the
+per-cell block is the full padded image. Channels, by contrast, are
+fully independent in a depthwise conv, so the channel axis is the free
+tiling axis that bounds VMEM: `channel_tile` splits C when the full
+image does not fit (it must divide C; `_pick_channel_tile` records the
+sweep's choice — see experiments/fused_backbone.py). At the paper's
+50x50 patches every activation fits untiled (largest: 25x25x96 f32 =
+240 KB/image), which is the recorded default.
+
+Gradients: `_fused` carries a custom_vjp whose backward differentiates
+the pure-jnp reference at the saved inputs — the flash_block_kernel
+pattern — so `depthwise_impl="fused"` trains (the depthwise kernels
+above the fine-tune boundary still receive gradients even while their
+BNs are frozen). The backward is ordinary XLA code and fuses fine; the
+forward is where the unfused chain paid.
+
+Testing contract: `interpret=True` runs the SAME kernel body under the
+Pallas interpreter on CPU, so tier-1 parity tests exercise the real
+code path, not a stand-in; `interpret=None` (the default) resolves to
+the interpreter automatically off-TPU. XLA's `cost_analysis` cannot
+see inside a Pallas custom call, so `depthwise_chain_cost` provides
+the analytic FLOPs/bytes the profile verb merges into its ProgramCost
+(observe/profile.py `augment_cost` / `register_cost`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+# VMEM budget one image's padded activation + output may occupy before
+# the kernel insists on channel tiling (v5e has 128 MB VMEM per core;
+# staying well under leaves room for double-buffering).
+VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+# Chosen by the experiments/fused_backbone.py sweep at the paper's
+# shapes (50x50 patches, batch 8..4096): every MobileNetV2 activation
+# fits VMEM whole, so the recorded default is "no channel tiling".
+DEFAULT_CHANNEL_TILE = None
+
+
+def fold_bn(scale, bias, mean, var, eps):
+    """Fold inference-mode batchnorm into one (mul, add) affine pair:
+    ``bn(y) = (y - mean) * rsqrt(var + eps) * scale + bias
+            = y * mul + add``.
+    Plain jnp on purpose — it runs outside the kernel (and outside the
+    custom_vjp), so scale/bias gradients come from ordinary autodiff."""
+    mul = scale * lax.rsqrt(var + eps)
+    return mul, bias - mean * mul
+
+
+def _same_pad(x, kh, kw, sh, sw):
+    """TF-SAME padding (lo = total//2, hi = rest — matches XLA and the
+    core.py taps impl) plus the padded/output spatial sizes."""
+    _, h_in, w_in, _ = x.shape
+    h_out, w_out = -(-h_in // sh), -(-w_in // sw)
+    ph = max((h_out - 1) * sh + kh - h_in, 0)
+    pw = max((w_out - 1) * sw + kw - w_in, 0)
+    xp = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                     (pw // 2, pw - pw // 2), (0, 0)))
+    return xp, h_out, w_out
+
+
+def reference_impl(x, w, mul, add, *, stride=1, clamp6=True):
+    """Pure-jnp mirror of the kernel: taps depthwise conv (TF-SAME),
+    folded-BN affine, optional ReLU6. The parity target for the Pallas
+    path and the function the custom_vjp backward differentiates."""
+    kh, kw = int(w.shape[0]), int(w.shape[1])
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    xp, h_out, w_out = _same_pad(x, kh, kw, sh, sw)
+    wf = w.reshape(kh, kw, -1)
+    y = None
+    for i in range(kh):
+        for j in range(kw):
+            xs = xp[:, i:i + (h_out - 1) * sh + 1:sh,
+                    j:j + (w_out - 1) * sw + 1:sw, :]
+            t = xs.astype(jnp.float32) * wf[i, j]
+            y = t if y is None else y + t
+    y = y * mul + add
+    if clamp6:
+        y = jnp.clip(y, 0.0, 6.0)
+    return y.astype(x.dtype)
+
+
+def _kernel(xp_ref, w_ref, mul_ref, add_ref, out_ref, *,
+            kh, kw, sh, sw, h_out, w_out, clamp6):
+    """One (image, channel-tile) cell: taps MAC + affine + clamp, all
+    on the VMEM-resident tile."""
+    x = xp_ref[...].astype(jnp.float32)          # (1, Hp, Wp, ct)
+    ct = x.shape[-1]
+    acc = None
+    for i in range(kh):
+        for j in range(kw):
+            xs = lax.slice(x, (0, i, j, 0),
+                           (1, i + (h_out - 1) * sh + 1,
+                            j + (w_out - 1) * sw + 1, ct),
+                           (1, sh, sw, 1))
+            t = xs * w_ref[i * kw + j, :]
+            acc = t if acc is None else acc + t
+    y = acc * mul_ref[0] + add_ref[0]
+    if clamp6:
+        y = jnp.clip(y, 0.0, 6.0)
+    out_ref[...] = y.astype(out_ref.dtype)
+
+
+def _pick_channel_tile(h_p, w_p, h_out, w_out, c, itemsize,
+                       channel_tile):
+    """Resolve the channel-tile size: an explicit request must divide C;
+    `None` means whole-C unless the per-cell VMEM footprint (padded
+    input + output tile, f32 accumulate) busts the budget, in which
+    case the largest budget-fitting divisor of C is chosen."""
+    if channel_tile is not None:
+        if c % channel_tile:
+            raise ValueError(f"channel_tile {channel_tile} must divide "
+                             f"channel count {c}")
+        return channel_tile
+    per_chan = (h_p * w_p + h_out * w_out) * max(itemsize, 4)
+    if per_chan * c <= VMEM_BUDGET_BYTES:
+        return c
+    best = 1
+    for d in range(1, c + 1):
+        if c % d == 0 and per_chan * d <= VMEM_BUDGET_BYTES:
+            best = d
+    return best
+
+
+def _pallas_impl(x, w, mul, add, *, stride, clamp6, interpret,
+                 channel_tile):
+    kh, kw = int(w.shape[0]), int(w.shape[1])
+    sh, sw = stride
+    n, _, _, c = x.shape
+    xp, h_out, w_out = _same_pad(x, kh, kw, sh, sw)
+    _, h_p, w_p, _ = xp.shape
+    ct = _pick_channel_tile(h_p, w_p, h_out, w_out, c,
+                            jnp.dtype(x.dtype).itemsize, channel_tile)
+    wf = w.reshape(kh * kw, c).astype(jnp.float32)
+    mul2 = mul.reshape(1, c).astype(jnp.float32)
+    add2 = add.reshape(1, c).astype(jnp.float32)
+    kern = functools.partial(_kernel, kh=kh, kw=kw, sh=sh, sw=sw,
+                             h_out=h_out, w_out=w_out, clamp6=clamp6)
+    return pl.pallas_call(
+        kern,
+        grid=(n, c // ct),
+        in_specs=[
+            pl.BlockSpec((1, h_p, w_p, ct), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((kh * kw, ct), lambda i, j: (0, j)),
+            pl.BlockSpec((1, ct), lambda i, j: (0, j)),
+            pl.BlockSpec((1, ct), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, h_out, w_out, ct),
+                               lambda i, j: (i, 0, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((n, h_out, w_out, c), x.dtype),
+        interpret=interpret,
+    )(xp, wf, mul2, add2)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused(stride, clamp6, interpret, channel_tile):
+    """custom_vjp closure over the static config: Pallas forward,
+    backward = jax.vjp of the jnp reference at the saved inputs (the
+    flash_block_kernel pattern — exact w.r.t. the reference math)."""
+
+    @jax.custom_vjp
+    def fused(x, w, mul, add):
+        return _pallas_impl(x, w, mul, add, stride=stride,
+                            clamp6=clamp6, interpret=interpret,
+                            channel_tile=channel_tile)
+
+    def fwd(x, w, mul, add):
+        return fused(x, w, mul, add), (x, w, mul, add)
+
+    def bwd(res, g):
+        x, w, mul, add = res
+        _, vjp = jax.vjp(
+            lambda x_, w_, m_, a_: reference_impl(
+                x_, w_, m_, a_, stride=stride, clamp6=clamp6),
+            x, w, mul, add)
+        return vjp(g)
+
+    fused.defvjp(fwd, bwd)
+    return fused
+
+
+def default_interpret() -> bool:
+    """The `interpret=None` resolution: real Mosaic lowering on TPU,
+    the Pallas interpreter (same kernel body) everywhere else — the
+    tier-1-on-CPU testing contract."""
+    return jax.default_backend() != "tpu"
+
+
+def fused_depthwise_affine(x, w, mul, add, *, stride=1, clamp6=True,
+                           interpret=None, channel_tile=None):
+    """Fused `clamp6(dwconv(x) * mul + add)` (TF-SAME padding).
+
+    x: [N, H, W, C]; w: [kh, kw, 1, C] (the core.depthwise_conv2d param
+    layout); mul/add: [C] folded-BN affine (identity: ones/zeros).
+    Differentiable in all four array arguments via the reference-vjp
+    backward.
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    strides = (stride, stride) if isinstance(stride, int) else stride
+    if channel_tile is None:
+        channel_tile = DEFAULT_CHANNEL_TILE
+    return _make_fused(tuple(strides), bool(clamp6), bool(interpret),
+                       channel_tile)(x, w, mul, add)
+
+
+def fused_depthwise_bn_relu6(x, w, scale, bias, mean, var, *, eps,
+                             stride=1, interpret=None,
+                             channel_tile=None):
+    """The MobileNetV2 chain: depthwise conv -> inference-mode BN ->
+    ReLU6, one kernel. `scale`/`bias` are BN params, `mean`/`var` the
+    moving statistics — folding happens here, outside the kernel's
+    custom_vjp, so their gradients flow through ordinary autodiff."""
+    mul, add = fold_bn(scale, bias, mean, var, eps)
+    return fused_depthwise_affine(x, w, mul, add, stride=stride,
+                                  clamp6=True, interpret=interpret,
+                                  channel_tile=channel_tile)
+
+
+# ---------------------------------------------------------------------------
+# analytic cost — XLA cost_analysis cannot see inside a Pallas call
+# ---------------------------------------------------------------------------
+
+
+def depthwise_call_cost(n, h_in, w_in, c, *, stride=1, kernel_size=3,
+                        itemsize=4):
+    """Analytic (flops, bytes_accessed) of ONE fused call: kh*kw MACs +
+    the affine + the clamp per output element; HBM bytes are the padded
+    input + output + the (tiny) weight/affine operands."""
+    k = kernel_size
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    h_out, w_out = -(-h_in // sh), -(-w_in // sw)
+    out_elems = n * h_out * w_out * c
+    flops = float(out_elems * (2 * k * k + 3))
+    h_p = (h_out - 1) * sh + k
+    w_p = (w_out - 1) * sw + k
+    bytes_accessed = float(
+        (n * h_p * w_p * c + out_elems) * itemsize
+        + (k * k * c + 2 * c) * 4)
+    return flops, bytes_accessed
+
+
+def depthwise_chain_cost(calls, *, itemsize=4):
+    """Sum `depthwise_call_cost` over `calls` — an iterable of dicts of
+    its keyword arguments (models/mobilenet.py `fused_call_shapes`
+    produces the schedule). Returns (flops, bytes_accessed)."""
+    flops = bytes_accessed = 0.0
+    for call in calls:
+        f, b = depthwise_call_cost(itemsize=itemsize, **call)
+        flops += f
+        bytes_accessed += b
+    return flops, bytes_accessed

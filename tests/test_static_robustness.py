@@ -457,6 +457,62 @@ def test_single_cost_analysis_extraction_point():
         f"cost-analysis allowlist entries match no code: {stale}")
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 16: DenseNet stays concat-free — `concatenate` is banned in
+# models/densenet.py outside the documented parity reference. The packed
+# dense blocks exist precisely because the iterated concat re-reads and
+# re-writes the whole growing feature map every layer (the PR 14 MFU
+# attribution measured intensity 2.0 against a ~240 ridge); a concat
+# quietly reintroduced anywhere else in the model would silently undo
+# the data-movement fix while every numeric test keeps passing.
+# ---------------------------------------------------------------------------
+
+CONCAT_ALLOWLIST = {
+    ("idc_models_tpu/models/densenet.py", "dense_layer_concat"):
+        "the block_impl=\"concat\" parity reference: the ONE place the "
+        "literal concat semantics live, pinned bit-close against the "
+        "packed path by tests/test_fused_conv.py and used as the "
+        "bench_backbone_fused baseline",
+}
+
+
+def _scan_concat_calls(path: Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = str(path.relative_to(REPO)).replace("\\", "/")
+    violations, live = [], set()
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                f = child.func
+                name = (f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else None)
+                if name in ("concatenate", "concat"):
+                    key = (rel, _enclosing_function(stack))
+                    live.add(key)
+                    if key not in CONCAT_ALLOWLIST:
+                        violations.append((rel, child.lineno, name,
+                                           key[1]))
+            walk(child, stack + [child])
+
+    walk(tree, [])
+    return violations, live
+
+
+def test_densenet_is_concat_free():
+    violations, live = _scan_concat_calls(
+        REPO / "idc_models_tpu" / "models" / "densenet.py")
+    assert not violations, (
+        "concatenate/concat calls in models/densenet.py outside the "
+        "documented parity reference — dense blocks are concat-free by "
+        "design (packed buffer + dynamic_update_slice; ISSUE 16); "
+        "route new layers through the packed layout or extend the "
+        f"documented CONCAT_ALLOWLIST: {violations}")
+    stale = set(CONCAT_ALLOWLIST) - live
+    assert not stale, (
+        f"concat allowlist entries match no code: {stale}")
+
+
 # -- ISSUE 11: no stray t_max-sized KV allocations in serve/ -------------
 #
 # The paged engine exists so HBM stops being reserved per slot's worst
