@@ -2400,6 +2400,159 @@ def bench_profile_overhead(on_accelerator: bool):
     }
 
 
+def bench_checkpoint_rollout(on_accelerator: bool):
+    """The ISSUE-17 acceptance drills, measured:
+
+    1. CROSS-MESH SAVE/RESTORE — a sharded tree saved under one mesh
+       layout restores bit-identically under a DIFFERENT layout (the
+       partition rules are re-resolved against the target mesh), with
+       restore peak host bytes bounded by one target block plus one
+       saved shard — never O(model) on any single host. Throughput is
+       the headline: `ckpt_save_mb_per_s` / `ckpt_restore_mb_per_s`,
+       plus `ckpt_restore_peak_host_ratio` (peak host bytes over the
+       full tree — the smaller, the more out-of-core the restore).
+    2. LIVE ROLLOUT — `run_with_rollout` replays a Poisson trace while
+       staging -> canarying -> promoting a candidate that arrives as a
+       sharded checkpoint DIRECTORY: zero dropped, zero duplicated,
+       zero errored requests, asserted. Then the forced-bad drill: a
+       NaN candidate is refused at staging (spot-check on the compiled
+       programs), the serve stage lands rolled_back, and every client
+       request still finishes ok.
+
+    Degrades gracefully below 8 devices: the mesh shapes are derived
+    from the live device count (on one device both layouts collapse to
+    1x1 — the bit-identity, integrity, and peak-bound assertions still
+    run; only the cross-layout re-shard goes trivial).
+    """
+    import tempfile
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from idc_models_tpu import mesh as meshlib, partition
+    from idc_models_tpu.checkpoint import (
+        checkpoint_info, restore_sharded, run_with_rollout,
+        save_sharded,
+    )
+    from idc_models_tpu.models.lm import attention_lm
+    from idc_models_tpu.serve import LMServer, poisson_trace
+
+    # ---- scenario 1: cross-mesh save/restore throughput ---------------
+    if on_accelerator:
+        dim, blocks_n = 4096, 4          # ~ 128 MiB tree
+    else:
+        dim, blocks_n = 1024, 4          # ~ 8 MiB tree
+    rules = partition.PartitionRules((
+        (r"w1$", P(meshlib.DATA_AXIS, meshlib.MODEL_AXIS)),
+        (r"blocks/.*/kernel$", P(None, meshlib.MODEL_AXIS)),
+        (r".*", P()),
+    ))
+    rng = np.random.default_rng(17)
+    tree = {
+        "w1": rng.normal(size=(dim, dim)).astype(np.float32),
+        "blocks": {str(i): {"kernel": rng.normal(size=(dim // 2,
+                                                       dim // 2))
+                            .astype(np.float32)}
+                   for i in range(blocks_n)},
+        "step": np.int32(0),
+    }
+    total = sum(a.nbytes for _, a in partition.tree_paths(tree))
+    n_dev = jax.device_count()
+    tp = 2 if n_dev % 2 == 0 else 1
+    save_mesh = meshlib.fsdp_tp_mesh(n_dev // tp, tp)
+    restore_mesh = meshlib.fsdp_tp_mesh(n_dev, 1)
+    placed = partition.shard_tree(save_mesh, rules, tree)
+
+    save_s = restore_s = float("inf")
+    restored = stats = None
+    for _ in range(2):                   # keep the best of two passes
+        with tempfile.TemporaryDirectory() as td:
+            ck = Path(td) / "ck"
+            t0 = time.perf_counter()
+            save_sharded(ck, placed, step=1).wait()
+            save_s = min(save_s, time.perf_counter() - t0)
+            stats = {}
+            t0 = time.perf_counter()
+            restored = restore_sharded(ck, mesh=restore_mesh,
+                                       rules=rules, stats=stats)
+            jax.block_until_ready(restored)
+            restore_s = min(restore_s, time.perf_counter() - t0)
+            biggest_shard = max(
+                s["bytes"]
+                for rec in checkpoint_info(ck)["leaves"].values()
+                for s in rec["shards"])
+    # bit-identical across the layout change, every leaf
+    for (n1, a), (n2, b) in zip(partition.tree_paths(restored),
+                                partition.tree_paths(tree)):
+        assert n1 == n2
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), b, err_msg=n1)
+    # and the no-O(model)-host-memory bound from the stats hook
+    biggest_block = max(sh.data.nbytes
+                        for _, leaf in partition.tree_paths(restored)
+                        for sh in leaf.addressable_shards)
+    assert stats["peak_host_bytes"] <= biggest_block + biggest_shard, (
+        stats["peak_host_bytes"], biggest_block, biggest_shard)
+    assert stats["bytes_read"] >= total
+
+    # ---- scenario 2: live rollout under a Poisson trace ---------------
+    if on_accelerator:
+        vocab, e, heads, blocks, mlp = 1024, 256, 4, 2, 512
+        t_max, n_req = 256, 48
+    else:
+        vocab, e, heads, blocks, mlp = 32, 32, 2, 2, 64
+        t_max, n_req = 64, 24
+    model = attention_lm(vocab, t_max, embed_dim=e, num_heads=heads,
+                         mlp_dim=mlp, num_blocks=blocks)
+    params = model.init(jax.random.key(0)).params
+    candidate = model.init(jax.random.key(1)).params
+    kw = dict(embed_dim=e, num_heads=heads, num_blocks=blocks,
+              t_max=t_max, n_slots=4, window=8)
+    trace = poisson_trace(n_req, rate_per_s=500.0, vocab=vocab,
+                          t_max=t_max, prompt_lens=(3, 8),
+                          budgets=(3, 6), seed=17)
+
+    with tempfile.TemporaryDirectory() as td:
+        save_sharded(Path(td) / "cand", candidate).wait()
+        server = LMServer(params, **kw)
+        t0 = time.perf_counter()
+        res, ctl = run_with_rollout(server, trace,
+                                    str(Path(td) / "cand"),
+                                    canary_fraction=0.5,
+                                    canary_requests=3)
+        promote_s = time.perf_counter() - t0
+        server.close()
+    ids = [r.id for r in res]
+    assert sorted(ids) == sorted(t[1].id for t in trace)   # zero drop
+    assert len(set(ids)) == len(ids)                       # zero dup
+    assert all(r.status == "ok" for r in res), (
+        [r.status for r in res])
+    assert ctl.stage == "promoted", (ctl.stage, ctl.reason)
+
+    # forced-bad: NaN candidate refused at staging, clients untouched
+    import jax.numpy as jnp
+
+    bad = jax.tree.map(lambda a: jnp.full_like(a, jnp.nan), params)
+    server = LMServer(params, **kw)
+    res, ctl = run_with_rollout(server, trace, bad,
+                                canary_fraction=0.5,
+                                canary_requests=3)
+    server.close()
+    assert ctl.stage == "rolled_back", (ctl.stage, ctl.reason)
+    assert all(r.status == "ok" for r in res)
+    assert len(res) == len(trace)
+
+    mib = total / 2**20
+    return {
+        "ckpt_tree_mb": round(mib, 2),
+        "ckpt_save_mb_per_s": round(mib / save_s, 2),
+        "ckpt_restore_mb_per_s": round(mib / restore_s, 2),
+        "ckpt_restore_peak_host_ratio": round(
+            stats["peak_host_bytes"] / total, 4),
+        "ckpt_rollout_promote_s": round(promote_s, 3),
+    }
+
+
 # ---------------------------------------------------------------------------
 # bench_compare: regression triage over the recorded BENCH_rNN.json trail
 # ---------------------------------------------------------------------------
@@ -2427,6 +2580,7 @@ HIGHER_IS_BETTER = (
     "ring_fwd_speedup_vs_jnp", "ring_fwd_speedup_median",
     "zigzag_schedule_speedup", "fed_byz_robust_advantage",
     "fed_async_speedup", "fed_scale_replay_bitwise",
+    "ckpt_save_mb_per_s", "ckpt_restore_mb_per_s",
 )
 LOWER_IS_BETTER = (
     "fed_round_s", "fed_round_32_s", "secure_round_s",
@@ -2447,6 +2601,7 @@ LOWER_IS_BETTER = (
     "zigzag_zigzag_ms", "ring_fwd_pallas_ms",
     "fed_scale_round_s", "fed_scale_peak_growth_mb",
     "fed_async_wall_to_loss_s",
+    "ckpt_restore_peak_host_ratio",
 )
 
 
@@ -2605,6 +2760,7 @@ def main() -> None:
     ring.update(bench_profile_overhead(on_accelerator))
     ring.update(bench_federated_robustness(on_accelerator))
     ring.update(bench_federated_scale(on_accelerator))
+    ring.update(bench_checkpoint_rollout(on_accelerator))
     if on_accelerator:
         # second headline sample, minutes after the first (the shared
         # chip's load drifts on that timescale; back-to-back windows

@@ -1,0 +1,28 @@
+"""Sharded checkpointing + zero-downtime weight rollout (ROADMAP 4).
+
+`sharded` — per-shard async save/restore keyed on partition.py rules:
+each device writes only its own blocks, an atomic MANIFEST.json is the
+completion contract, and restore re-resolves rules against the TARGET
+mesh (an FSDP checkpoint loads bit-identically onto a TP mesh or a
+different device count) without materializing the tree on one host.
+
+`rollout` — serve-side hot weight swap: stage a candidate behind the
+live params, spot-check it on the engine's already-compiled programs,
+canary a controlled fraction of live traffic, compare SLO burn, then
+promote (`SlotEngine.swap_params`) or roll back with zero dropped or
+duplicated requests.
+"""
+
+from idc_models_tpu.checkpoint.rollout import (
+    RolloutController, run_with_rollout,
+)
+from idc_models_tpu.checkpoint.sharded import (
+    MANIFEST_NAME, CheckpointError, SaveHandle, barrier,
+    checkpoint_info, restore_sharded, save_sharded,
+)
+
+__all__ = [
+    "MANIFEST_NAME", "CheckpointError", "SaveHandle", "barrier",
+    "checkpoint_info", "restore_sharded", "save_sharded",
+    "RolloutController", "run_with_rollout",
+]

@@ -1,0 +1,520 @@
+"""Sharded checkpointing keyed on `partition.py` rules (ROADMAP item 4).
+
+`train/checkpoint.py` durably saves a REPLICATED tree: orbax gathers,
+one host writes, and the digest pass used to `jax.device_get` the whole
+state — O(model) host memory, exactly what PR 15's FSDP/TP sharding
+exists to avoid. This module is the sharded successor:
+
+- **Save** walks `partition.tree_paths(tree)` and writes each leaf's
+  *addressable* shards (`replica_id == 0` only, so every unique block
+  is written exactly once across hosts AND replicas), one shard in
+  host memory at a time. Each shard file carries its own sha256,
+  committed tmp-then-`os.replace` so a torn write is never readable
+  under the final name.
+- **Manifest as the completion contract**: shard files alone mean
+  nothing. Every process writes a `_SHARDS.p<i>.json` fragment listing
+  the shards it committed; after a cross-host barrier, process 0 merges
+  the fragments into `MANIFEST.json` (itself tmp-then-rename). A
+  directory without a manifest IS a torn checkpoint and restore
+  refuses it — the same marker discipline as `train/checkpoint.py`,
+  with the digest riding per shard instead of per tree.
+- **Restore re-resolves rules against the TARGET mesh**: the manifest
+  stores shapes/dtypes, `rules.spec_for` + mesh adaptation decide the
+  target layout, and each device's block is assembled via
+  `jax.make_array_from_callback` from only the saved shards that
+  OVERLAP it — an FSDP-mesh checkpoint loads bit-identically onto a TP
+  mesh or a different device count without ever materializing the full
+  tree on one host (peak host bytes ~ one target block + one saved
+  shard, reported in `stats`).
+- **Async**: `save_sharded(..., wait=False)` returns a `SaveHandle`
+  whose background thread fetches/writes/commits; `.wait()` is the
+  durability point. The caller must not donate or mutate the tree
+  before `.wait()` returns (the thread reads the live buffers).
+
+Writes under a checkpoint directory are allowed ONLY through
+`_write_bytes`/`_commit_json` here (and orbax inside
+`train/checkpoint.py`) — a static AST scan in
+tests/test_static_robustness.py bans raw `open(...,"w")`/`np.save`/
+`shutil` writes outside that allowlist, so every byte that lands in a
+checkpoint went through an atomic tmp-then-rename commit.
+
+Events (frozen schemas, tests/test_observability.py): `ckpt_save` and
+`ckpt_restore`, one per completed operation; registry instruments
+`ckpt_saves_total` / `ckpt_restores_total` / `ckpt_bytes_written_total`
+/ `ckpt_bytes_read_total` and second histograms from day one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+MANIFEST_NAME = "MANIFEST.json"
+FORMAT_VERSION = 1
+_FRAGMENT = "_SHARDS.p{}.json"
+
+
+class CheckpointError(ValueError):
+    """A torn/corrupt/mismatched checkpoint, with a teaching message."""
+
+
+def barrier(tag: str) -> None:
+    """Cross-host sync point (no-op in a single-process run) — the
+    fence between "every host committed its shards" and "process 0
+    commits the manifest", and between "manifest committed" and "any
+    host returns". Shared with train/checkpoint.py's rename dance."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"idc-ckpt-{tag}")
+
+
+def _registry(registry):
+    if registry is not None:
+        return registry
+    from idc_models_tpu.observe.metrics_registry import REGISTRY
+
+    return REGISTRY
+
+
+def _instruments(reg):
+    return {
+        "saves": reg.counter(
+            "ckpt_saves_total", "completed sharded checkpoint saves"),
+        "restores": reg.counter(
+            "ckpt_restores_total",
+            "completed sharded checkpoint restores"),
+        "bytes_written": reg.counter(
+            "ckpt_bytes_written_total",
+            "shard bytes committed by sharded saves"),
+        "bytes_read": reg.counter(
+            "ckpt_bytes_read_total",
+            "shard bytes read by sharded restores"),
+        "save_s": reg.histogram(
+            "ckpt_save_seconds", "wall seconds per sharded save"),
+        "restore_s": reg.histogram(
+            "ckpt_restore_seconds", "wall seconds per sharded restore"),
+    }
+
+
+def _dtype_str(dt) -> str:
+    return str(np.dtype(dt))
+
+
+def _dtype_from_str(s: str):
+    try:
+        return np.dtype(s)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, s))
+
+
+def _shard_file(name: str, spans) -> str:
+    """Deterministic shard filename: leaf path + the block's start
+    offsets (unique per block — two shards of one leaf never share a
+    start corner)."""
+    corner = "_".join(str(lo) for lo, _ in spans) or "scalar"
+    return f"{name.replace('/', '.')}@{corner}"
+
+
+def _norm_index(index, shape) -> tuple:
+    """A jax shard `index` (tuple of slices, Nones for full dims) ->
+    ((start, stop), ...) resolved against the leaf shape."""
+    index = tuple(index)
+    out = []
+    for dim, sl in zip(shape, index):
+        lo = 0 if sl.start is None else int(sl.start)
+        hi = dim if sl.stop is None else int(sl.stop)
+        out.append((lo, hi))
+    # jax omits trailing full dims for rank-0 indices; pad explicit
+    for dim in shape[len(index):]:
+        out.append((0, dim))
+    return tuple(out)
+
+
+def _write_bytes(dirpath: Path, relfile: str, buf: bytes) -> str:
+    """THE atomic byte commit (static-scan allowlisted): write to a
+    tmp name, fsync, rename into place. Returns the sha256 hex."""
+    h = hashlib.sha256(buf).hexdigest()
+    tmp = dirpath / (relfile + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(buf)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dirpath / relfile)
+    return h
+
+
+def _commit_json(dirpath: Path, relfile: str, doc: dict) -> None:
+    """Atomic JSON commit (static-scan allowlisted) — the manifest and
+    fragment writer."""
+    _write_bytes(dirpath, relfile,
+                 json.dumps(doc, sort_keys=True).encode())
+
+
+def _leaf_shards(leaf):
+    """[(spans, host_fetch)] for THIS process's unique blocks of one
+    leaf. jax arrays yield their addressable replica-0 shards (each
+    distinct block written exactly once across replicas/hosts); host
+    leaves yield one full-leaf block on process 0 only."""
+    import jax
+
+    shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        out = []
+        for sh in leaf.addressable_shards:
+            if sh.replica_id != 0:
+                continue
+            spans = _norm_index(sh.index, shape)
+            out.append((spans, (lambda s=sh: np.asarray(s.data))))
+        return out
+    if jax.process_index() != 0:
+        return []
+    spans = tuple((0, d) for d in shape)
+    return [(spans, (lambda a=leaf: np.asarray(a)))]
+
+
+class SaveHandle:
+    """An in-flight (or finished) sharded save. `.wait()` is the
+    durability point: it joins the writer, re-raises its failure, and
+    returns the committed manifest."""
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._manifest: dict | None = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+    def wait(self, timeout: float | None = None) -> dict:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("sharded save still writing")
+        if self._error is not None:
+            raise self._error
+        assert self._manifest is not None
+        return self._manifest
+
+    @property
+    def manifest(self) -> dict:
+        return self.wait()
+
+
+def save_sharded(path, tree, *, step: int | None = None,
+                 wait: bool = True, logger=None,
+                 registry=None) -> SaveHandle:
+    """Write `tree` as a sharded checkpoint under `path`.
+
+    Every process writes only its own addressable replica-0 shards
+    (one shard resident in host memory at a time — peak host bytes is
+    O(largest shard), never O(model)), then process 0 commits
+    `MANIFEST.json` behind a barrier: the manifest IS the completion
+    contract, and a directory without one is a torn save `restore_
+    sharded` refuses.
+
+    `wait=False` runs the fetch/write/commit on a background thread
+    and returns immediately; call `.wait()` before donating or
+    mutating the tree (the writer reads the live buffers). The handle
+    from `wait=True` is already finished."""
+    import jax
+
+    from idc_models_tpu import partition
+
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    proc = jax.process_index()
+    plan = []                      # (name, spans, fetch)
+    leaves = {}
+    for name, leaf in partition.tree_paths(tree):
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        dtype = getattr(leaf, "dtype", np.asarray(leaf).dtype)
+        leaves[name] = {"shape": list(shape),
+                        "dtype": _dtype_str(dtype), "shards": []}
+        for spans, fetch in _leaf_shards(leaf):
+            plan.append((name, spans, fetch))
+    reg = _instruments(_registry(registry))
+    handle = SaveHandle()
+
+    def _run() -> None:
+        t0 = time.perf_counter()
+        frag: dict[str, list] = {}
+        total = 0
+        for name, spans, fetch in plan:
+            arr = np.ascontiguousarray(fetch())   # ONE shard resident
+            buf = arr.tobytes()
+            relfile = _shard_file(name, spans)
+            digest = _write_bytes(path, relfile, buf)
+            frag.setdefault(name, []).append({
+                "file": relfile, "index": [list(s) for s in spans],
+                "sha256": digest, "bytes": len(buf)})
+            total += len(buf)
+            del arr, buf
+        _commit_json(path, _FRAGMENT.format(proc), frag)
+        barrier("save-shards")
+        if proc == 0:
+            n_shards = 0
+            for fp in sorted(path.glob(_FRAGMENT.format("*"))):
+                for name, shards in json.loads(fp.read_text()).items():
+                    leaves[name]["shards"].extend(shards)
+                    n_shards += len(shards)
+            manifest = {
+                "format": FORMAT_VERSION, "step": step,
+                "leaves": leaves, "n_shards": n_shards,
+                "nbytes": sum(s["bytes"] for rec in leaves.values()
+                              for s in rec["shards"])}
+            for name, rec in leaves.items():
+                if not rec["shards"]:
+                    raise CheckpointError(
+                        f"no process wrote any shard of leaf {name!r} "
+                        f"— the manifest would commit a hole")
+                rec["shards"].sort(key=lambda s: s["file"])
+            _commit_json(path, MANIFEST_NAME, manifest)
+            for fp in path.glob(_FRAGMENT.format("*")):
+                fp.unlink()
+        else:
+            manifest = None
+        barrier("save-manifest")
+        if manifest is None:
+            manifest = json.loads((path / MANIFEST_NAME).read_text())
+        dt = time.perf_counter() - t0
+        reg["saves"].inc()
+        reg["bytes_written"].inc(total)
+        reg["save_s"].observe(dt)
+        if logger is not None:
+            logger.log(event="ckpt_save", path=str(path), step=step,
+                       leaves=len(leaves), shards=len(plan),
+                       bytes=total, seconds=round(dt, 6),
+                       background=not wait)
+        handle._manifest = manifest
+
+    if wait:
+        _run()
+        return handle
+
+    def _guarded() -> None:
+        try:
+            _run()
+        except BaseException as e:          # surfaced at .wait()
+            handle._error = e
+
+    handle._thread = threading.Thread(target=_guarded,
+                                      name="ckpt-save", daemon=True)
+    handle._thread.start()
+    return handle
+
+
+def checkpoint_info(path) -> dict:
+    """The committed manifest, or a CheckpointError teaching why the
+    directory is not a restorable checkpoint (missing = torn save)."""
+    path = Path(path)
+    mf = path / MANIFEST_NAME
+    if not mf.exists():
+        raise CheckpointError(
+            f"{path} has no {MANIFEST_NAME} — not a completed sharded "
+            f"checkpoint. The manifest is the atomic completion "
+            f"contract (committed last, behind a barrier): its absence "
+            f"means the save was interrupted or this directory never "
+            f"held a checkpoint. Re-save, or point at a directory "
+            f"containing {MANIFEST_NAME}")
+    try:
+        manifest = json.loads(mf.read_text())
+    except ValueError as e:
+        raise CheckpointError(
+            f"{mf} is not valid JSON ({e}) — the manifest commit is "
+            f"atomic (tmp + rename), so this is disk corruption, not "
+            f"a torn write; the checkpoint cannot be trusted") from e
+    if manifest.get("format") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"{mf} is format {manifest.get('format')!r}, this reader "
+            f"speaks {FORMAT_VERSION}")
+    return manifest
+
+
+def _read_shard(path: Path, shard: dict, dtype, verified: set,
+                stats: dict) -> np.ndarray:
+    """One saved shard back as an array, sha256-verified the first
+    time this restore touches its file."""
+    fp = path / shard["file"]
+    if not fp.exists():
+        raise CheckpointError(
+            f"manifest names shard {shard['file']!r} but the file is "
+            f"missing from {path} — the checkpoint directory was "
+            f"partially deleted; restore refuses to fabricate the "
+            f"block")
+    buf = fp.read_bytes()
+    if shard["file"] not in verified:
+        if hashlib.sha256(buf).hexdigest() != shard["sha256"]:
+            raise CheckpointError(
+                f"shard {shard['file']!r} fails its manifest sha256 — "
+                f"bytes on disk are not the bytes the save committed "
+                f"(bit rot or tampering); refusing to restore a "
+                f"corrupt block")
+        verified.add(shard["file"])
+    stats["bytes_read"] = stats.get("bytes_read", 0) + len(buf)
+    stats["shards_read"] = stats.get("shards_read", 0) + 1
+    spans = shard["index"]
+    shape = tuple(hi - lo for lo, hi in spans)
+    arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    if arr.nbytes != shard["bytes"]:
+        raise CheckpointError(
+            f"shard {shard['file']!r} holds {arr.nbytes} bytes but the "
+            f"manifest promised {shard['bytes']}")
+    return arr
+
+
+def _assemble(path: Path, rec: dict, spans, verified: set,
+              stats: dict) -> np.ndarray:
+    """The requested block of one leaf, assembled from only the saved
+    shards that OVERLAP it — one saved shard resident at a time, so
+    peak host bytes is the block plus one shard, never the leaf set."""
+    dtype = _dtype_from_str(rec["dtype"])
+    out = np.empty(tuple(hi - lo for lo, hi in spans), dtype)
+    filled = 0
+    for shard in rec["shards"]:
+        inter = [(max(lo, slo), min(hi, shi))
+                 for (lo, hi), (slo, shi) in zip(spans, shard["index"])]
+        if any(lo >= hi for lo, hi in inter):
+            continue
+        data = _read_shard(path, shard, dtype, verified, stats)
+        src = tuple(slice(lo - slo, hi - slo) for (lo, hi), (slo, _)
+                    in zip(inter, shard["index"]))
+        dst = tuple(slice(lo - rlo, hi - rlo) for (lo, hi), (rlo, _)
+                    in zip(inter, spans))
+        out[dst] = data[src]
+        peak = out.nbytes + data.nbytes
+        stats["peak_host_bytes"] = max(stats.get("peak_host_bytes", 0),
+                                       peak)
+        filled += int(np.prod([hi - lo for lo, hi in inter]))
+        del data
+    if filled != out.size:
+        raise CheckpointError(
+            f"saved shards cover {filled} of {out.size} elements of a "
+            f"requested block — the manifest's shards do not tile the "
+            f"leaf (a save bug, not a mesh mismatch: restore handles "
+            f"any target layout)")
+    stats["peak_host_bytes"] = max(stats.get("peak_host_bytes", 0),
+                                   out.nbytes)
+    return out
+
+
+def _nest(name: str, value) -> dict:
+    """A single-leaf nested dict whose `tree_paths` name is exactly
+    `name` — so rule regexes see the same "a/b/c" path the save
+    recorded, not a mangled flat key."""
+    out: dict = {}
+    node, parts = out, name.split("/")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+    return out
+
+
+def restore_sharded(path, *, mesh=None, rules=None, template=None,
+                    check_dead: bool = True, stats: dict | None = None,
+                    logger=None, registry=None):
+    """Load a sharded checkpoint back as a pytree.
+
+    With `mesh` + `rules`, specs are re-resolved against the TARGET
+    mesh (`rules.spec_for` + the same adaptation `shard_tree` applies)
+    and every device block is built via `jax.make_array_from_callback`
+    from only the overlapping saved shards — the save-time mesh shape
+    and device count are irrelevant, and the full tree never exists on
+    one host. Without a mesh the tree comes back as host numpy arrays
+    (the caller opted into O(model) host memory).
+
+    `template` (any pytree with the same leaf names) fixes the tree
+    STRUCTURE for non-dict containers; by default the manifest's
+    "a/b/c" names rebuild nested dicts. `stats`, if a dict, is filled
+    with bytes_read / shards_read / peak_host_bytes — the numbers the
+    per-device-peak gate asserts."""
+    import jax
+
+    from idc_models_tpu import partition
+
+    if (mesh is None) != (rules is None):
+        raise CheckpointError(
+            "pass BOTH mesh and rules (sharded restore re-resolves the "
+            "rules against the target mesh) or neither (host restore)")
+    t0 = time.perf_counter()
+    manifest = checkpoint_info(path)
+    path = Path(path)
+    recs = manifest["leaves"]
+    stats = stats if stats is not None else {}
+    verified: set[str] = set()
+
+    def build(name: str, rec: dict):
+        shape = tuple(rec["shape"])
+        dtype = _dtype_from_str(rec["dtype"])
+        if mesh is None:
+            spans = tuple((0, d) for d in shape)
+            return _assemble(path, rec, spans, verified, stats)
+        struct = jax.ShapeDtypeStruct(shape, dtype)
+        sharding = jax.tree.leaves(rules.shardings(
+            mesh, _nest(name, struct), check_dead=False))[0]
+
+        def cb(index):
+            return _assemble(path, rec, _norm_index(index, shape),
+                             verified, stats)
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    if rules is not None and check_dead:
+        # dead-rule discipline against the CHECKPOINT's leaf names —
+        # a rule matching nothing saved is the same silent-sharding
+        # loss shard_tree refuses
+        live = {i for n in recs
+                for i in [rules._match(n)[0]] if i is not None}
+        dead = [rules.patterns[i] for i in range(len(rules.patterns))
+                if i not in live]
+        if dead:
+            raise partition.PartitionError(
+                f"dead partition rule(s) {dead}: they match none of "
+                f"the {len(recs)} checkpointed leaves — the rule set "
+                f"and this checkpoint describe different models "
+                f"(restore with check_dead=False for a deliberately "
+                f"partial rule set)")
+
+    built = {name: build(name, rec) for name, rec in recs.items()}
+    if template is not None:
+        t_names = [n for n, _ in partition.tree_paths(template)]
+        missing = [n for n in t_names if n not in built]
+        extra = [n for n in built if n not in t_names]
+        if missing or extra:
+            raise CheckpointError(
+                f"template/checkpoint leaf mismatch: template-only "
+                f"{missing}, checkpoint-only {extra} — the template "
+                f"must name exactly the saved leaves")
+        tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(template),
+            [built[n] for n in t_names])
+    else:
+        tree = {}
+        for name, leaf in built.items():
+            node, parts = tree, name.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = leaf
+    dt = time.perf_counter() - t0
+    reg = _instruments(_registry(registry))
+    reg["restores"].inc()
+    reg["bytes_read"].inc(stats.get("bytes_read", 0))
+    reg["restore_s"].observe(dt)
+    if logger is not None:
+        logger.log(event="ckpt_restore", path=str(path),
+                   leaves=len(recs),
+                   shards_read=stats.get("shards_read", 0),
+                   bytes_read=stats.get("bytes_read", 0),
+                   peak_host_bytes=stats.get("peak_host_bytes", 0),
+                   seconds=round(dt, 6), sharded=mesh is not None)
+    return tree

@@ -107,6 +107,11 @@ def _parse(argv):
                         help="checkpoint the training loop after every "
                              "epoch under <path>/dist_ckpt and resume "
                              "from there on restart (requires --path)")
+        sp.add_argument("--checkpoint-every", type=int, default=1,
+                        help="with --resumable: epochs between loop "
+                             "checkpoints (the final epoch always "
+                             "saves; a blocking orbax save per short "
+                             "epoch can dominate the epoch itself)")
         sp.add_argument("--stream", action="store_true",
                         help="decode training batches from disk on the "
                              "fly (datasets larger than host RAM) "
@@ -540,6 +545,46 @@ def _parse(argv):
                          "(ttft:<name>) and the tenant's own brownout "
                          "trigger — one tenant's flood sheds that "
                          "tenant only. Needs --tenants")
+    sp.add_argument("--save-ckpt", default=None, metavar="DIR",
+                    help="export the serving params as a sharded "
+                         "checkpoint (checkpoint/sharded.py) before the "
+                         "trace replays: each device writes only its "
+                         "own shards, MANIFEST.json commits the save "
+                         "atomically. Pair with --train-steps to mint "
+                         "a --rollout candidate")
+    sp.add_argument("--rollout", default=None, metavar="CKPT_DIR",
+                    help="zero-downtime weight rollout "
+                         "(checkpoint/rollout.py): mid-trace, restore "
+                         "this sharded checkpoint against the SERVING "
+                         "mesh + partition rules, canary "
+                         "--canary-fraction of the traffic onto it, "
+                         "compare error rate and TTFT p95 against the "
+                         "live fleet-of-one, then promote (hot-swap "
+                         "the live weights, zero recompile) or roll "
+                         "back — no request is dropped or duplicated "
+                         "either way")
+    sp.add_argument("--canary-fraction", type=float, default=None,
+                    help="traffic share routed to the --rollout canary "
+                         "while it is open, in (0, 1] (tenant-affine: "
+                         "whole tenants land on one side; default "
+                         "0.25)")
+    sp.add_argument("--canary-requests", type=int, default=None,
+                    help="canary finishes required before the "
+                         "promote/rollback verdict (default 4); a "
+                         "trace that drains short of this ROLLS BACK "
+                         "— insufficient evidence is not health")
+    sp.add_argument("--rollout-at", type=float, default=None,
+                    help="fraction of the trace submitted before the "
+                         "rollout opens, in [0, 1) (default 0.25: the "
+                         "live side banks baseline latency first)")
+    sp.add_argument("--rollout-adapters", type=int, default=None,
+                    metavar="RANK",
+                    help="per-tenant adapter hot-swap drill, the cheap "
+                         "first rung of a rollout: register rank-RANK "
+                         "logit adapters for every tenant, serve the "
+                         "trace, then swap a re-seeded bank in live — "
+                         "no recompile, no dropped request. Needs "
+                         "--tenants")
 
     sp = sub.add_parser(
         "serve-cluster", aliases=["serve_cluster"],
@@ -1426,6 +1471,14 @@ def _run_dist(ns):
 
     if ns.resumable and ns.path is None:
         sys.exit("--resumable requires --path (checkpoints live under it)")
+    if ns.checkpoint_every < 1:
+        sys.exit(f"--checkpoint-every {ns.checkpoint_every} must be "
+                 f">= 1: saving every 0 epochs is never, and never "
+                 f"checkpointing is what --resumable exists to fix")
+    if ns.checkpoint_every != 1 and not ns.resumable:
+        sys.exit("--checkpoint-every needs --resumable: it paces the "
+                 "resume checkpoints, and without --resumable none "
+                 "are written")
     preset = _apply_overrides(
         get_preset(ns.preset_key), ns,
         ["batch_size", "lr", "epochs", "fine_tune_epochs", "fine_tune_at",
@@ -1491,6 +1544,7 @@ def _run_dist(ns):
             artifact_path=ns.path,
             checkpoint_dir=(str(Path(ns.path) / "dist_ckpt")
                             if ns.resumable and ns.path else None),
+            checkpoint_every=ns.checkpoint_every,
             logger=logger)
     test_metrics = evaluate(result.model, result.state, test,
                             _loss_for(preset.num_outputs), mesh,
@@ -1897,6 +1951,54 @@ def _run_serve(ns):
                  f"{ns.brownout_clear_ms}")
     ns.tenant_list, ns.tenant_quotas, ns.tenant_slos = (
         _parse_tenant_flags(ns))
+    # rollout flags fail fast too — a bad canary fraction discovered
+    # AFTER --train-steps pre-training wastes the whole warmup
+    if ns.rollout is None:
+        for flag, val in (("--canary-fraction", ns.canary_fraction),
+                          ("--canary-requests", ns.canary_requests),
+                          ("--rollout-at", ns.rollout_at)):
+            if val is not None:
+                sys.exit(f"{flag} needs --rollout: it tunes the canary "
+                         f"stage of a weight rollout, and without a "
+                         f"candidate checkpoint there is no rollout to "
+                         f"tune")
+    else:
+        if ns.canary_fraction is None:
+            ns.canary_fraction = 0.25
+        if ns.canary_requests is None:
+            ns.canary_requests = 4
+        if ns.rollout_at is None:
+            ns.rollout_at = 0.25
+        if not 0.0 < ns.canary_fraction <= 1.0:
+            sys.exit(f"--canary-fraction {ns.canary_fraction} must be "
+                     f"in (0, 1]: a zero (or negative) fraction "
+                     f"starves the canary of evidence forever, and "
+                     f"promoting without evidence is not a rollout")
+        if ns.canary_requests < 1:
+            sys.exit(f"--canary-requests {ns.canary_requests} must be "
+                     f">= 1: the verdict needs at least one canary "
+                     f"finish to compare")
+        if not 0.0 <= ns.rollout_at < 1.0:
+            sys.exit(f"--rollout-at {ns.rollout_at} must be in [0, 1): "
+                     f"at 1.0 or past it the trace drains before the "
+                     f"rollout ever opens")
+        from idc_models_tpu.checkpoint import (
+            CheckpointError, checkpoint_info,
+        )
+
+        try:
+            checkpoint_info(ns.rollout)
+        except CheckpointError as e:
+            sys.exit(f"--rollout: {e}")
+    if ns.rollout_adapters is not None:
+        if ns.tenant_list is None:
+            sys.exit("--rollout-adapters needs --tenants: an adapter "
+                     "rollout hot-swaps PER-TENANT logit deltas, and a "
+                     "tenant-less server has no adapter bank to swap")
+        if ns.rollout_adapters < 1:
+            sys.exit(f"--rollout-adapters {ns.rollout_adapters} must "
+                     f"be >= 1 (it is the adapter rank r in the "
+                     f"[V, r] x [r, V] factors)")
     ns.serve_fault_plan = None
     if ns.serve_faults:
         from idc_models_tpu.serve import parse_serve_fault_spec
@@ -2057,10 +2159,26 @@ def _parse_tenant_flags(ns):
     return names, quotas, slos
 
 
+def _synth_adapters(names, vocab, rank, seed):
+    """Deterministic rank-r logit-adapter factors per tenant ([V, r] /
+    [r, V] float32) for the --rollout-adapters drill — small enough
+    that the hot-swap mechanics, not the math, are the thing under
+    test."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {name: (rng.normal(0.0, 0.01, (vocab, rank))
+                   .astype(np.float32),
+                   rng.normal(0.0, 0.01, (rank, vocab))
+                   .astype(np.float32))
+            for name in names}
+
+
 def _serve_body(ns, mesh, params, logger, rules=None) -> None:
     import json
 
     import jax.numpy as jnp
+    import numpy as np
 
     from idc_models_tpu.observe import Timer, profile_trace
     from idc_models_tpu.serve import LMServer, load_trace, poisson_trace
@@ -2109,15 +2227,23 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
     # tenant set with its quotas + per-tenant TTFT SLOs and build the
     # runtime against the serve knobs' windows/dwells. CLI tenants
     # carry no trained adapters (the synthetic model has none to
-    # load); quota/SLO/brownout isolation is the full drill surface —
-    # docs/MULTITENANCY.md shows the adapter path in code.
+    # load) unless --rollout-adapters arms synthetic ones for the
+    # hot-swap drill; quota/SLO/brownout isolation is the full drill
+    # surface — docs/MULTITENANCY.md shows the adapter path in code.
     tenancy = None
     if ns.tenant_list:
         from idc_models_tpu.serve import TenantRegistry
 
         reg = TenantRegistry()
+        # --rollout-adapters arms the bank at build time (rank is a
+        # compiled shape): every tenant gets a deterministic rank-r
+        # adapter the post-trace hot-swap then replaces live
+        adapters = (_synth_adapters(ns.tenant_list, ns.vocab,
+                                    ns.rollout_adapters, ns.seed)
+                    if ns.rollout_adapters else {})
         for name in ns.tenant_list:
-            reg.register(name, quota=ns.tenant_quotas.get(name),
+            reg.register(name, adapter=adapters.get(name),
+                         quota=ns.tenant_quotas.get(name),
                          slo_ttft_p95_ms=ns.tenant_slos.get(name))
         tenancy = reg.build(
             vocab=ns.vocab, logger=logger,
@@ -2164,6 +2290,16 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
                      f"--max-queue-depth and rerun with the same "
                      f"--journal to recover them")
         print(line)
+    if ns.save_ckpt:
+        # each device writes only its own shards; the manifest is the
+        # atomic completion contract (checkpoint/sharded.py). With
+        # --train-steps this mints a --rollout candidate in one run.
+        from idc_models_tpu.checkpoint import save_sharded
+
+        manifest = save_sharded(ns.save_ckpt, server.engine._params,
+                                step=ns.train_steps, logger=logger).wait()
+        print(f"checkpoint: wrote {manifest['n_shards']} shard(s) / "
+              f"{len(manifest['leaves'])} leaves to {ns.save_ckpt}")
     if ns.trace:
         trace = load_trace(ns.trace)
     else:
@@ -2179,10 +2315,20 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
     from idc_models_tpu.serve import InjectedEngineCrash
 
     crashed = None
+    rollout_ctl = None
     with Timer("Serving trace", logger=logger), \
             profile_trace(ns.profile_dir):
         try:
-            results = server.run(trace, realtime=ns.realtime)
+            if ns.rollout:
+                from idc_models_tpu.checkpoint import run_with_rollout
+
+                results, rollout_ctl = run_with_rollout(
+                    server, trace, ns.rollout,
+                    start_after=ns.rollout_at, realtime=ns.realtime,
+                    canary_fraction=ns.canary_fraction,
+                    canary_requests=ns.canary_requests, logger=logger)
+            else:
+                results = server.run(trace, realtime=ns.realtime)
         except InjectedEngineCrash as e:
             # the drill's hard death: the failure cleanup already
             # finalized every in-flight request as an error Result —
@@ -2242,6 +2388,25 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
         names = sorted({a["slo"] for a in slo.alerts})
         print(f"slo: {len(slo.alerts)} alert(s)"
               + (f" ({', '.join(names)})" if names else ""))
+    if rollout_ctl is not None:
+        # the verdict an operator acts on: terminal stage, how much
+        # canary evidence backed it, and the rollback reason if any
+        line = (f"rollout: {rollout_ctl.stage} after "
+                f"{rollout_ctl.canary_finishes} canary finish(es)")
+        if rollout_ctl.reason:
+            line += f" — {rollout_ctl.reason}"
+        print(line)
+    if ns.rollout_adapters and crashed is None:
+        # the cheap first rung, live: replace the whole bank with
+        # re-seeded factors — same compiled shapes, zero recompile
+        fresh = _synth_adapters(ns.tenant_list, ns.vocab,
+                                ns.rollout_adapters, ns.seed + 1)
+        server.swap_adapters(
+            np.stack([fresh[n][0] for n in ns.tenant_list]),
+            np.stack([fresh[n][1] for n in ns.tenant_list]))
+        print(f"adapter rollout: hot-swapped rank-"
+              f"{ns.rollout_adapters} adapters for "
+              f"{len(ns.tenant_list)} tenant(s), zero recompile")
     if tenancy is not None:
         # what isolation actually did, one line per tenant: volume,
         # tail latency, sheds/quota refusals, the tenant's own
@@ -2697,6 +2862,10 @@ def _run_fed(ns):
         rmsprop, save_checkpoint, two_phase_fit,
     )
 
+    if ns.checkpoint_every < 1:
+        sys.exit(f"--checkpoint-every {ns.checkpoint_every} must be "
+                 f">= 1: saving every 0 rounds is never, and a crash "
+                 f"then replays the whole run")
     if getattr(ns, "population", 0):
         return _run_fed_population(ns)
     preset = _apply_overrides(

@@ -56,6 +56,10 @@ def summarize_jsonl(path) -> dict:
     profile_steps: list[dict] = []
     fed_cohorts: list[dict] = []
     tenants: dict[str, dict] = {}
+    ckpt = {"saves": 0, "save_bytes": 0, "save_seconds": 0.0,
+            "restores": 0, "restore_bytes": 0, "restore_seconds": 0.0,
+            "restore_peak_host_bytes": 0}
+    rollouts: list[dict] = []
     last_snapshot = None
     ts = [r["ts"] for r in records
           if isinstance(r.get("ts"), (int, float))]
@@ -101,6 +105,26 @@ def summarize_jsonl(path) -> dict:
             _tenant_slot(tenants, r)["shed"] += 1
         if event == "serve_tenant_quota_reject":
             _tenant_slot(tenants, r)["quota_rejections"] += 1
+        # sharded checkpoint + weight rollout (ISSUE 17): byte/second
+        # totals for the transfer events, the raw transition list for
+        # the rollout state machine (serve-level and cluster-level)
+        if event == "ckpt_save":
+            ckpt["saves"] += 1
+            ckpt["save_bytes"] += int(r.get("bytes") or 0)
+            ckpt["save_seconds"] += float(r.get("seconds") or 0.0)
+        if event == "ckpt_restore":
+            ckpt["restores"] += 1
+            ckpt["restore_bytes"] += int(r.get("bytes_read") or 0)
+            ckpt["restore_seconds"] += float(r.get("seconds") or 0.0)
+            ckpt["restore_peak_host_bytes"] = max(
+                ckpt["restore_peak_host_bytes"],
+                int(r.get("peak_host_bytes") or 0))
+        if event in ("serve_rollout", "cluster_rollout"):
+            rollouts.append(
+                {k: r.get(k) for k in
+                 ("event", "stage", "outcome", "reason",
+                  "canary_requests", "replica")
+                 if r.get(k) is not None})
     events = {
         ev: {"count": slot["count"],
              "fields": {k: _num_stats(vs)
@@ -133,6 +157,26 @@ def summarize_jsonl(path) -> dict:
                 "by_reason": v["by_reason"], "shed": v["shed"],
                 "quota_rejections": v["quota_rejections"]}
             for t, v in sorted(tenants.items())},
+        # checkpoint traffic totals (None when the run never saved or
+        # restored — the key set stays stable either way) and the
+        # rollout transition list, in file order
+        "checkpoints": (
+            {"saves": ckpt["saves"],
+             "save_bytes": ckpt["save_bytes"],
+             "save_mb_per_s": (
+                 round(ckpt["save_bytes"] / 2**20
+                       / ckpt["save_seconds"], 2)
+                 if ckpt["save_seconds"] > 0 else None),
+             "restores": ckpt["restores"],
+             "restore_bytes": ckpt["restore_bytes"],
+             "restore_mb_per_s": (
+                 round(ckpt["restore_bytes"] / 2**20
+                       / ckpt["restore_seconds"], 2)
+                 if ckpt["restore_seconds"] > 0 else None),
+             "restore_peak_host_bytes":
+                 ckpt["restore_peak_host_bytes"]}
+            if ckpt["saves"] or ckpt["restores"] else None),
+        "rollouts": rollouts,
         "metrics": last_snapshot,
         "requests": _request_timelines(records),
     }
@@ -323,6 +367,29 @@ def format_summary(s: dict, *, top: int = 15) -> str:
                 f"p95={st['ttft_ms_p95']} shed={st['shed']} "
                 f"quota_rej={st['quota_rejections']}"
                 + (f" ({reasons})" if reasons else ""))
+    if s.get("checkpoints"):
+        ck = s["checkpoints"]
+        out.append("")
+        out.append(
+            f"checkpoints: {ck['saves']} save(s) "
+            f"({ck['save_bytes']} bytes"
+            + (f", {ck['save_mb_per_s']} MB/s"
+               if ck["save_mb_per_s"] is not None else "")
+            + f"), {ck['restores']} restore(s) "
+            f"({ck['restore_bytes']} bytes"
+            + (f", {ck['restore_mb_per_s']} MB/s"
+               if ck["restore_mb_per_s"] is not None else "")
+            + f", peak host {ck['restore_peak_host_bytes']} bytes)")
+    if s.get("rollouts"):
+        out.append("")
+        out.append("rollouts (state transitions, file order):")
+        for rec in s["rollouts"]:
+            line = f"  {rec.get('event'):16s} stage={rec.get('stage')}"
+            for k in ("outcome", "replica", "canary_requests",
+                      "reason"):
+                if rec.get(k) is not None:
+                    line += f" {k}={rec[k]}"
+            out.append(line)
     if s.get("requests"):
         out.append("")
         out.append(f"requests: {len(s['requests'])} with per-request "

@@ -132,6 +132,24 @@ class LMServer:
         # and the MB budget converts to pages when the engine binds
         # its allocator.
         paged = kv_page_size is not None or kv_pages is not None
+        # everything canary_clone needs to build a config-identical
+        # second server over candidate weights (same shapes/mesh ->
+        # the process-wide jit cache serves both, zero new compiles)
+        self._clone_cfg = dict(
+            embed_dim=embed_dim, num_heads=num_heads,
+            num_blocks=num_blocks, t_max=t_max, n_slots=n_slots,
+            window=window, mesh=mesh, cache_dtype=cache_dtype,
+            block_impl=block_impl, temperature=temperature,
+            top_k=top_k, pad_id=pad_id, eos_id=eos_id,
+            max_queue_depth=max_queue_depth,
+            max_prefills_per_cycle=max_prefills_per_cycle,
+            admit_after_collect=admit_after_collect, clock=clock,
+            prefill_chunk=prefill_chunk, kv_dtype=kv_dtype,
+            spec_decode=spec_decode, draft_k=draft_k,
+            draft_order=draft_order, kv_page_size=kv_page_size,
+            kv_pages=kv_pages, kv_decode_reserve=kv_decode_reserve,
+            partition_rules=partition_rules)
+        self._clone_logger = logger
         # registry: an observe MetricsRegistry for this server's
         # instruments (None = the process-wide default). A multi-
         # replica process (serve/cluster) gives each replica its OWN
@@ -334,11 +352,21 @@ class LMServer:
         first recorded as status="error" Results (slots released, queue
         intact) so poll() answers for them and a recovering caller can
         keep serving."""
-        finished = []
         if self._fault_plan is not None:
             self._fire_bursts()
+        return self._cycle(self.scheduler.tick)
+
+    def quiesce(self) -> list[Result]:
+        """One cycle that collects the in-flight decode window without
+        dispatching another (Scheduler.quiesce) — the dispatch-idle
+        point a paged engine's rollout spot-check needs. Same
+        result/failure bookkeeping as step()."""
+        return self._cycle(self.scheduler.quiesce)
+
+    def _cycle(self, tick_fn) -> list[Result]:
+        finished = []
         try:
-            ticked = self.scheduler.tick()
+            ticked = tick_fn()
         except Exception:
             for e in self.scheduler.pop_failed():
                 r = _to_result(e)
@@ -351,6 +379,50 @@ class LMServer:
             self._inflight.discard(r.id)
             finished.append(r)
         return finished
+
+    # -- hot weight rollout (ROADMAP 4) ----------------------------------
+
+    def swap_params(self, params) -> None:
+        """Promote candidate weights onto THIS server's engine — see
+        `SlotEngine.swap_params` for the zero-recompile/zero-drop
+        contract. The rollout metrics hook is the caller's job
+        (checkpoint/rollout.py owns the state machine)."""
+        self.engine.swap_params(params)
+
+    def swap_adapters(self, u, v) -> None:
+        """Hot-swap the per-tenant adapter bank — the cheap first rung
+        of a rollout (no full-tree placement, no canary needed: the
+        base weights are untouched). See `SlotEngine.swap_adapters`
+        for the shape contract and the tenant-less teaching error."""
+        self.engine.swap_adapters(u, v)
+
+    def canary_clone(self, params, *, registry=None,
+                     logger=None) -> "LMServer":
+        """A second, config-identical server over CANDIDATE weights —
+        the canary a rollout routes a controlled traffic fraction
+        onto. Same shapes, mesh, and programs, so the process-wide jit
+        cache serves both and construction compiles NOTHING new (the
+        cluster tier's N-replicas-one-process pattern).
+
+        Deliberately NOT shared: the prefix cache (its KV snapshots
+        were computed under the LIVE weights — resuming them under
+        candidate weights would silently mix two models' caches), the
+        journal (one WAL system of record; canary requests are
+        journaled by the controller against the live server), fault
+        plan, brownout, and the metrics registry (a fresh one per
+        canary, like cluster replicas, so live gauges are never
+        stomped). Tenancy IS shared: quotas and per-tenant SLOs bill
+        across both sides of the split."""
+        if registry is None:
+            from idc_models_tpu.observe.metrics_registry import (
+                MetricsRegistry,
+            )
+
+            registry = MetricsRegistry()
+        return LMServer(
+            params, tenancy=self.tenancy, registry=registry,
+            logger=self._clone_logger if logger is None else logger,
+            **self._clone_cfg)
 
     def poll(self, rid: str) -> Result | None:
         """The finished Result for `rid`, or None while it is still

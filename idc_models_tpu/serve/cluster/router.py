@@ -44,6 +44,7 @@ stack, realized on the repo's own control plane:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 
 from idc_models_tpu.observe import metrics_registry as mreg
@@ -180,6 +181,8 @@ class Router:
         # sees the request), so they must be counted here — replica
         # metrics cannot
         self.cluster_sheds = 0
+        # the open weight rollout, if any (start_rollout/finish_rollout)
+        self._rollout: dict | None = None
 
     # -- placement --------------------------------------------------------
 
@@ -665,6 +668,129 @@ class Router:
                         trace_id=req.trace_id)
             self._log(event="cluster_migrate", id=req.id,
                       replica=target.replica_id, trace_id=req.trace_id)
+
+    # -- weight rollout (checkpoint/rollout.py at fleet scope) ------------
+
+    def start_rollout(self, candidate, *, replica_id=None) -> str:
+        """Open a fleet rollout: ONE replica becomes the canary. The
+        candidate (a params tree, or a sharded-checkpoint path —
+        checkpoint/sharded.py — restored against the canary engine's
+        mesh + rules) is spot-checked on the canary's already-compiled
+        programs first; a NaN/garbage candidate raises here and the
+        fleet is untouched. On success the canary's weights are
+        swapped in-place (its in-flight slots keep decoding) while the
+        rest of the fleet keeps the old weights — normal placement
+        keeps routing live traffic onto the canary, which is the
+        controlled-exposure mechanism at cluster scope. Returns the
+        canary's replica_id; `finish_rollout` reads the health
+        documents and promotes the rest or swaps the canary back."""
+        if self._rollout is not None:
+            raise RuntimeError(
+                f"a rollout is already open (canary "
+                f"{self._rollout['canary'].replica_id!r}) — "
+                f"finish_rollout() it before starting another")
+        cands = [r for r in self.replicas
+                 if r.state == "live" and r.role != "prefill"]
+        if replica_id is not None:
+            rep = self._by_id[replica_id]
+            if rep.state != "live" or rep.role == "prefill":
+                raise ValueError(
+                    f"replica {replica_id!r} is "
+                    f"{rep.state}/{rep.role} — the canary must be a "
+                    f"live decode-capable replica")
+        elif not cands:
+            raise RuntimeError("no live decode-capable replica to "
+                               "canary on")
+        else:
+            # least-loaded live replica: the cheapest place to expose
+            # candidate weights, deterministic via the placement score
+            rep = min(cands, key=lambda r: self._score(r, r.health()))
+        if isinstance(candidate, (str, os.PathLike)):
+            from idc_models_tpu.checkpoint.sharded import restore_sharded
+
+            eng = rep.server.engine
+            rules = eng._partition_rules
+            candidate = restore_sharded(
+                candidate,
+                mesh=eng._cfg.mesh if rules is not None else None,
+                rules=rules, logger=self.logger)
+        rep.server.metrics.on_rollout(stage="staging")
+        check = rep.server.engine.spot_check_params(candidate)
+        if not check["ok"]:
+            detail = {1: "non-finite logits",
+                      2: f"magnitude-blown logits (max |x| = "
+                         f"{check['max_abs']:.3g})"}
+            rep.server.metrics.on_rollout(
+                stage="rolled_back", outcome="rolled_back",
+                reason=f"spot-check: {detail[check['code']]}")
+            raise ValueError(
+                f"candidate failed the spot-check on canary "
+                f"{rep.replica_id!r}: {detail[check['code']]} — the "
+                f"fleet was not touched")
+        old = rep.server.engine._params
+        rep.server.swap_params(candidate)
+        self._rollout = {"canary": rep, "candidate": candidate,
+                         "old": old,
+                         "baseline": {r.replica_id: r.health()
+                                      for r in self.replicas
+                                      if r is not rep
+                                      and r.state == "live"}}
+        rep.server.metrics.on_rollout(stage="canary")
+        trace.point("cluster.rollout_canary", replica=rep.replica_id)
+        self._log(event="cluster_rollout", stage="canary",
+                  replica=rep.replica_id)
+        return rep.replica_id
+
+    def finish_rollout(self) -> str:
+        """Decide the open rollout from the HEALTH DOCUMENTS: the
+        canary must not be SLO-breached, brownout-shedding, or dead
+        while the rest of the fleet is clean. Healthy -> promote: every
+        other live replica's weights are swapped in place (in-flight
+        work keeps decoding; zero recompiles — all replicas share the
+        process jit cache). Unhealthy -> the canary swaps BACK to the
+        old weights; nothing else ever saw the candidate. Returns
+        "promoted" or "rolled_back"."""
+        ro = self._rollout
+        if ro is None:
+            raise RuntimeError("no rollout open — start_rollout() "
+                               "first")
+        rep = ro["canary"]
+        h = rep.health() if rep.state != "dead" else {"status": "dead"}
+        fleet_breached = any(b["slo_breached"]
+                             for b in ro["baseline"].values())
+        reasons = []
+        if rep.state != "live":
+            reasons.append(f"canary is {rep.state}")
+        else:
+            if h["slo_breached"] and not fleet_breached:
+                reasons.append("canary SLO breached while the fleet "
+                               "is clean")
+            if h["shedding"]:
+                reasons.append(f"canary shedding (brownout stage "
+                               f"{h['brownout_stage']})")
+        if reasons:
+            if rep.state == "live":
+                rep.server.swap_params(ro["old"])
+            reason = "; ".join(reasons)
+            rep.server.metrics.on_rollout(
+                stage="rolled_back", outcome="rolled_back",
+                reason=reason)
+            verdict = "rolled_back"
+        else:
+            for other in self.replicas:
+                if other is rep or other.state != "live":
+                    continue
+                other.server.swap_params(ro["candidate"])
+            rep.server.metrics.on_rollout(stage="promoted",
+                                          outcome="promoted")
+            reason = None
+            verdict = "promoted"
+        trace.point("cluster.rollout_done", replica=rep.replica_id,
+                    outcome=verdict)
+        self._log(event="cluster_rollout", stage=verdict,
+                  replica=rep.replica_id, reason=reason)
+        self._rollout = None
+        return verdict
 
     # -- lifecycle / observability ----------------------------------------
 

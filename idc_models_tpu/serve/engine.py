@@ -961,6 +961,10 @@ class SlotEngine:
                                      self.kv_int8, self.draft_k)
         self._params = _place_params(params, self._cfg.mesh,
                                      rules=partition_rules)
+        # kept for hot weight swap (swap_params): a candidate tree is
+        # placed under the SAME mesh/rules so the swapped-in leaves
+        # carry identical shardings and no program recompiles
+        self._partition_rules = partition_rules
         self.t_max = t_max
         self.n_slots = n_slots
         self.pad_id = int(pad_id)
@@ -1702,6 +1706,119 @@ class SlotEngine:
         logits = np.array(self._logits)      # blocks on any in-flight window
         logits[slot, :] = val
         self._logits = meshlib.put_with_sharding(logits, rep)
+
+    # -- hot weight rollout (ROADMAP 4) ---------------------------------
+
+    def swap_params(self, params) -> None:
+        """Hot-swap the serving weights. The candidate tree must match
+        the live one leaf-for-leaf in name/shape/dtype — it is placed
+        under the SAME mesh and partition rules, so every compiled
+        program keys identically and the swap costs zero recompiles.
+        Safe with a dispatch in flight: the dispatched window holds
+        immutable references to the old leaves and lands its tokens
+        untouched; the NEXT dispatch reads the new weights. In-flight
+        slots keep their KV caches — their remaining tokens decode
+        under the new weights (the zero-downtime contract: no slot
+        dropped, no request re-prefilled)."""
+        from idc_models_tpu import partition
+
+        live = {n: (tuple(a.shape), jnp.result_type(a.dtype))
+                for n, a in partition.tree_paths(self._params)}
+        cand = {n: (tuple(np.shape(a)),
+                    jnp.result_type(getattr(a, "dtype", np.asarray(a).dtype)))
+                for n, a in partition.tree_paths(params)}
+        if live != cand:
+            only_live = sorted(set(live) - set(cand))
+            only_cand = sorted(set(cand) - set(live))
+            diff = sorted(n for n in set(live) & set(cand)
+                          if live[n] != cand[n])
+            raise ValueError(
+                f"swap_params candidate does not match the serving "
+                f"tree: live-only leaves {only_live}, candidate-only "
+                f"{only_cand}, shape/dtype mismatches "
+                f"{[(n, live[n], cand[n]) for n in diff]} — a rollout "
+                f"swaps WEIGHTS, not architectures; rebuild the server "
+                f"for a different model")
+        self._params = _place_params(params, self._cfg.mesh,
+                                     rules=self._partition_rules)
+
+    def swap_adapters(self, u, v) -> None:
+        """Per-tenant adapter hot-swap — the cheap first rung of a
+        rollout: replace the [T, V, r]/[T, r, V] logit-adapter bank.
+        Safe mid-dispatch for the same reason as swap_params (the
+        in-flight window holds the old bank by reference). T must
+        equal the serving tenant count (the bank rows are gathered by
+        registered tenant id) and V/r must match the armed bank's
+        shapes (shapes are jit cache keys — a different rank would
+        recompile every window mid-traffic)."""
+        if self.n_tenants == 0:
+            raise ValueError(
+                "adapter hot-swap needs a multi-tenant server: this "
+                "engine was built without an adapter bank (tenancy), "
+                "so there are no adapter rows to replace — roll out "
+                "full params instead (swap_params)")
+        u = np.asarray(u, np.float32)
+        v = np.asarray(v, np.float32)
+        old_u, old_v = self._adapters
+        if u.shape != old_u.shape or v.shape != old_v.shape:
+            raise ValueError(
+                f"adapter swap shapes {u.shape} / {v.shape} must equal "
+                f"the armed bank's {tuple(old_u.shape)} / "
+                f"{tuple(old_v.shape)} (T = registered tenants, V = "
+                f"model vocab, r = adapter rank are all compiled "
+                f"shapes) — retrain/re-export at the serving shapes, "
+                f"or rebuild the server to change them")
+        rep = meshlib.replicated(self._cfg.mesh)
+        self._adapters = (meshlib.put_with_sharding(u, rep),
+                          meshlib.put_with_sharding(v, rep))
+
+    def spot_check_params(self, params) -> dict:
+        """Greedy spot-check of CANDIDATE weights on this engine's
+        already-compiled prefill program and scratch state — no live
+        slot, cache row, or logit is touched (paged engines replay the
+        warmup's bit-level no-op chunk, p_end=0, so every pool write
+        drops). The staging gate of a rollout: bad weights (NaN/inf,
+        blown magnitudes) are caught HERE, before a single client
+        request routes onto them. Returns {"ok", "code", "max_abs"}
+        with codes mirroring slot_health: 0 healthy, 1 non-finite
+        logits, 2 finite but magnitude-blown (> 1e30). On a PAGED
+        engine the check replays the pool-state chunk program, so it
+        needs the engine dispatch-idle (Scheduler.quiesce() collects
+        the in-flight window without starting another)."""
+        placed = _place_params(params, self._cfg.mesh,
+                               rules=self._partition_rules)
+        if self.paged:
+            if self._pending is not None:
+                raise RuntimeError(
+                    "spot_check_params on a paged engine needs the "
+                    "in-flight dispatch collected first (the pool "
+                    "caches were donated to it) — call the "
+                    "scheduler's quiesce() and retry")
+            c = self.prefill_chunk
+            logits, self._caches, sc = self._efns.prefill_chunk(
+                placed, self._caches, self._pt, self._scales,
+                np.int32(0), np.zeros((1, c), np.int32),
+                np.int32(0), np.int32(0))
+            if self.kv_int8:
+                self._scales = sc
+        elif self.prefill_chunk is not None:
+            c = self.prefill_chunk
+            caches1 = self._sfns.init_caches(1)
+            logits, _ = self._sfns.prefill_chunk(
+                placed, caches1, np.zeros((1, c), np.int32),
+                np.int32(0), np.int32(c))
+        else:
+            b = prefill_buckets(self.t_max, self._n_ring)[0]
+            logits, _ = self._sfns.prefill(
+                placed, np.zeros((1, b), np.int32), np.int32(b))
+        row = np.asarray(jax.device_get(logits)).astype(np.float64)
+        max_abs = float(np.max(np.abs(row[np.isfinite(row)]))
+                        if np.isfinite(row).any() else np.inf)
+        if not np.isfinite(row).all():
+            return {"ok": False, "code": 1, "max_abs": max_abs}
+        if max_abs > 1e30:
+            return {"ok": False, "code": 2, "max_abs": max_abs}
+        return {"ok": True, "code": 0, "max_abs": max_abs}
 
     # -- observability --------------------------------------------------
 

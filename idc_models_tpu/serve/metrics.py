@@ -142,6 +142,18 @@ class ServingMetrics:
             "serve_page_exhaustions_total",
             "cycles the paged engine refused work for lack of free "
             "pages (admission gate or mid-decode growth)")
+        # hot weight rollout (ROADMAP 4): terminal outcomes plus the
+        # live stage gauge an operator watches during a canary
+        self._m_rollouts = reg.counter(
+            "serve_rollouts_total",
+            "weight rollouts by terminal outcome: 'promoted' (canary "
+            "healthy, live weights swapped) or 'rolled_back' (staging "
+            "spot-check or canary SLO comparison failed)",
+            labels=("outcome",))
+        self._m_rollout_stage = reg.gauge(
+            "serve_rollout_stage_code",
+            "current rollout stage: 0 idle, 1 staging, 2 canary, "
+            "3 promoted, 4 rolled_back")
         # tenant-labeled instruments, registered only when tenancy is
         # armed so tenant-less servers' registries stay byte-identical
         # (the /metrics exposition equality gates)
@@ -185,6 +197,9 @@ class ServingMetrics:
         self.tenant_quota_rejections: dict[str, int] = {}
         self._jit_cache_seen: int | None = None
         self.compiles_observed = 0
+        # rollout rollup: stage trail + terminal outcomes
+        self.rollout_stage: str | None = None
+        self.rollout_outcomes: list[str] = []
         # paged-KV rollup (all zero/None on contiguous engines)
         self.kv_pages_total: int | None = None
         self.kv_pages_used_peak = 0
@@ -387,6 +402,30 @@ class ServingMetrics:
         self.faults_injected += 1
         self._m_faults_injected.inc(kind=kind)
         self._log(event="serve_fault_injected", kind=kind, tick=tick)
+
+    # -- hot weight rollout ----------------------------------------------
+
+    def on_rollout(self, *, stage: str, outcome: str | None = None,
+                   canary_requests: int = 0,
+                   reason: str | None = None) -> None:
+        """One rollout state-machine transition (checkpoint/rollout.py
+        drives these: staging -> canary -> promoted | rolled_back).
+        `outcome` is set only on the terminal transitions; `reason`
+        explains a rollback (spot-check code, SLO comparison). New
+        event type only — every historical schema stays
+        byte-identical."""
+        codes = {"idle": 0, "staging": 1, "canary": 2, "promoted": 3,
+                 "rolled_back": 4}
+        if stage not in codes:
+            raise ValueError(f"unknown rollout stage {stage!r} "
+                             f"(one of {sorted(codes)})")
+        self.rollout_stage = stage
+        self._m_rollout_stage.set(codes[stage])
+        if outcome is not None:
+            self.rollout_outcomes.append(outcome)
+            self._m_rollouts.inc(outcome=outcome)
+        self._log(event="serve_rollout", stage=stage, outcome=outcome,
+                  canary_requests=canary_requests, reason=reason)
 
     # -- speculative decoding --------------------------------------------
 
@@ -591,6 +630,14 @@ class ServingMetrics:
                 None if self.kv_tokens_per_byte_peak is None
                 else round(self.kv_tokens_per_byte_peak, 6)),
             "serve_page_exhaustions": self.page_exhaustions,
+            # rollout rollup (additive, ROADMAP 4): terminal outcome
+            # count, the last outcome, and the stage the machine ended
+            # in — None/0 on servers that never rolled anything out
+            "serve_rollouts": len(self.rollout_outcomes),
+            "serve_rollout_outcome": (self.rollout_outcomes[-1]
+                                      if self.rollout_outcomes
+                                      else None),
+            "serve_rollout_stage": self.rollout_stage,
         }
         if self.tenancy is not None:
             # per-tenant rollup (additive key, ISSUE 14): one record

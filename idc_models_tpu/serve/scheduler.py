@@ -271,6 +271,11 @@ class Scheduler:
         # the engine error, so the caller collects them here (pop_failed)
         self._failed: list[Entry] = []
         self._chunked = getattr(engine, "prefill_chunk", None) is not None
+        # quiesce(): one-shot suppression of the end-of-tick window
+        # dispatch, so a caller can reach the engine dispatch-idle
+        # (rollout spot-checks on paged engines) without losing the
+        # collect/finish bookkeeping of a normal tick
+        self._skip_dispatch = False
 
     # -- admission -------------------------------------------------------
 
@@ -740,6 +745,22 @@ class Scheduler:
         with trace.span("serve.tick"):
             return self._tick()
 
+    def quiesce(self) -> list[Entry]:
+        """One normal cycle with the end-of-tick window dispatch
+        suppressed: the in-flight window is collected and finalized
+        exactly as tick() would, but nothing new launches, leaving the
+        engine dispatch-idle. The safe point for operations that
+        replay engine programs over the live device state — a paged
+        engine's rollout spot-check (`spot_check_params`) needs it
+        before candidate weights can be staged. Costs one window of
+        decode idleness; the next tick() resumes dispatching."""
+        self._skip_dispatch = True
+        try:
+            with trace.span("serve.tick", quiesce=True):
+                return self._tick()
+        finally:
+            self._skip_dispatch = False
+
     def _tick(self) -> list[Entry]:
         now = self.clock()
         done: list[Entry] = []
@@ -919,7 +940,7 @@ class Scheduler:
         #    ONE draft-and-verify dispatch emitting up to draft_k + 1
         #    tokens per slot
         occupancy = len(self._running) / self.engine.n_slots
-        if self._running:
+        if self._running and not self._skip_dispatch:
             try:
                 proposal = (self._propose_drafts(got) if self._spec
                             else None)

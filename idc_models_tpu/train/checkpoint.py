@@ -38,16 +38,20 @@ _DIGEST_FILE = "_IDC_DIGEST.json"
 def _tree_digest(state: Any) -> str:
     """sha256 over every leaf's shape + raw bytes in flatten order — a
     content fingerprint a flipped bit or truncated chunk cannot
-    survive. Leaves are fetched/viewed as numpy; non-array leaves hash
-    their repr."""
+    survive. Leaves are fetched ONE AT A TIME (per-leaf device_get, so
+    digesting an N-GB tree needs one leaf of host memory, not N GB —
+    the formula is unchanged and digests recorded before this fix
+    still verify) and viewed as numpy; non-array leaves hash their
+    repr."""
     h = hashlib.sha256()
-    for leaf in jax.tree.leaves(jax.device_get(state)):
+    for leaf in jax.tree.leaves(state):
         if hasattr(leaf, "shape"):
-            a = np.ascontiguousarray(np.asarray(leaf))
+            a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
             h.update(str((a.shape, a.dtype.str)).encode())
             h.update(a.tobytes())
+            del a                       # one leaf resident at a time
         else:
-            h.update(repr(leaf).encode())
+            h.update(repr(jax.device_get(leaf)).encode())
     return h.hexdigest()
 
 
@@ -80,31 +84,50 @@ def save_checkpoint(path: str | os.PathLike, state: Any, *,
     with a completion marker, and renamed into place with `os.replace`.
     A crash at ANY point leaves either the old complete checkpoint or a
     markerless partial that `checkpoint_exists` refuses — never a
-    half-written tree that restores garbage."""
+    half-written tree that restores garbage. Multi-host safe: orbax
+    coordinates the array writes itself, and the marker + rename
+    commit runs on process 0 ONLY, fenced by barriers, so N hosts
+    never race the same rename dance (every process returns after the
+    commit is visible)."""
+    from idc_models_tpu.checkpoint import barrier
+
     path = Path(path).absolute()
-    path.parent.mkdir(parents=True, exist_ok=True)
+    if jax.process_index() == 0:
+        path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_name(path.name + ".tmp")
-    if tmp.exists():
+    if tmp.exists() and jax.process_index() == 0:
         shutil.rmtree(tmp)              # leftover from a prior crash
+    barrier("train-ckpt-clean")
     _checkpointer().save(tmp, state, force=force)
-    if tmp.is_dir() and jax.process_index() == 0:
-        (tmp / _DIGEST_FILE).write_text(
-            json.dumps({"sha256": _tree_digest(state)}))
-    (tmp / _COMPLETE_MARKER).touch()
-    if path.exists():
-        # os.replace cannot overwrite a non-empty directory: retire the
-        # old checkpoint first. The unprotected window is between these
-        # two renames (metadata ops, microseconds) and a crash inside it
-        # still leaves the COMPLETE tree at <path>.old for manual
-        # recovery — never a torn <path>.
-        old = path.with_name(path.name + ".old")
-        if old.exists():
+    # COMMIT is process 0's alone: every process touching the marker
+    # and racing the same os.replace rename dance was the multi-host
+    # corruption bug — N processes renaming <path> -> <path>.old ->
+    # gone concurrently can destroy BOTH copies. Orbax's save above is
+    # itself multi-host coordinated; the barrier then holds everyone
+    # until process 0 has stamped + renamed, so no process returns
+    # while <path> is mid-commit.
+    barrier("train-ckpt-save")
+    if jax.process_index() == 0:
+        if tmp.is_dir():
+            (tmp / _DIGEST_FILE).write_text(
+                json.dumps({"sha256": _tree_digest(state)}))
+        (tmp / _COMPLETE_MARKER).touch()
+        if path.exists():
+            # os.replace cannot overwrite a non-empty directory:
+            # retire the old checkpoint first. The unprotected window
+            # is between these two renames (metadata ops,
+            # microseconds) and a crash inside it still leaves the
+            # COMPLETE tree at <path>.old for manual recovery — never
+            # a torn <path>.
+            old = path.with_name(path.name + ".old")
+            if old.exists():
+                shutil.rmtree(old)
+            os.replace(path, old)
+            os.replace(tmp, path)
             shutil.rmtree(old)
-        os.replace(path, old)
-        os.replace(tmp, path)
-        shutil.rmtree(old)
-    else:
-        os.replace(tmp, path)
+        else:
+            os.replace(tmp, path)
+    barrier("train-ckpt-commit")
     return str(path)
 
 

@@ -164,7 +164,7 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
         batch_size: int = 32, initial_epoch: int = 0, seed: int = 0,
         logger=None, verbose: bool = True, central_storage: bool = False,
         compute_dtype=jnp.float32, repeats: int = 1,
-        checkpoint_dir: str | None = None,
+        checkpoint_dir: str | None = None, checkpoint_every: int = 1,
         rules=None) -> tuple[TrainState, History]:
     """Keras-`fit`-shaped epoch loop over the jitted DP train step.
 
@@ -175,8 +175,10 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
 
     `checkpoint_dir` enables epoch-granular resume (SURVEY.md §5 build
     target: checkpoint every loop, not just the pretrainer): the full
-    TrainState + history are saved after each epoch, and a restart picks
-    up at the next epoch. Per-step rng keys are derived by folding the
+    TrainState + history are saved every `checkpoint_every` epochs
+    (plus always after the final one — a blocking orbax save per epoch
+    can dominate short epochs), and a restart picks up at the epoch
+    after the last save. Per-step rng keys are derived by folding the
     epoch into the seed, so a resumed run consumes the exact stream a
     straight-through run would have.
 
@@ -336,7 +338,9 @@ def fit(model: core.Module, optimizer: optax.GradientTransformation,
             print(f"epoch {epoch + 1}/{epochs} {msg}")
         if logger is not None:
             logger.log(event="epoch", epoch=epoch, **ep)
-        if checkpoint_dir is not None:
+        if checkpoint_dir is not None and (
+                (epoch + 1) % max(checkpoint_every, 1) == 0
+                or epoch + 1 == epochs):
             _save_fit_checkpoint(checkpoint_dir, state, history, epoch + 1,
                                  fingerprint)
     return state, history
@@ -479,6 +483,7 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
                   pretrained_weights: str | None = None,
                   artifact_path: str | None = None,
                   checkpoint_dir: str | None = None,
+                  checkpoint_every: int = 1,
                   logger=None) -> TwoPhaseResult:
     """The reference's full two-phase transfer-learning program (C7).
 
@@ -537,7 +542,8 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
             central_storage=config.central_storage,
             compute_dtype=config.compute_dtype, repeats=config.repeats,
             checkpoint_dir=(f"{checkpoint_dir}/phase1"
-                            if checkpoint_dir else None))
+                            if checkpoint_dir else None),
+            checkpoint_every=checkpoint_every)
 
     # Phase 2: "recompile" = fresh optimizer (and state) at lr/10 with the
     # fine-tune mask; BN below fine_tune_at stays in inference mode.
@@ -571,7 +577,8 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
             state, history_fine = _fit_cached_phase2(
                 plan, spec, state, train_ds, val_ds, mesh, config,
                 fine_tune_at, loss_fn, total_epochs, logger,
-                checkpoint_dir=phase2_ckpt)
+                checkpoint_dir=phase2_ckpt,
+                checkpoint_every=checkpoint_every)
         else:
             state, history_fine = fit(
                 model2, opt2, loss_fn, state, train_ds, val_ds, mesh,
@@ -579,7 +586,8 @@ def two_phase_fit(model_name: str, num_outputs: int, train_ds: ArrayDataset,
                 initial_epoch=config.epochs, seed=config.seed + 1,
                 logger=logger, central_storage=config.central_storage,
                 compute_dtype=config.compute_dtype, repeats=config.repeats,
-                checkpoint_dir=phase2_ckpt)
+                checkpoint_dir=phase2_ckpt,
+                checkpoint_every=checkpoint_every)
 
     print(history)
     print(history_fine)
@@ -597,7 +605,8 @@ def _fit_cached_phase2(plan, spec, state: TrainState, train_ds, val_ds,
                        mesh: Mesh, config: TwoPhaseConfig,
                        fine_tune_at: int, loss_fn, total_epochs: int,
                        logger,
-                       checkpoint_dir: str | None = None
+                       checkpoint_dir: str | None = None,
+                       checkpoint_every: int = 1
                        ) -> tuple[TrainState, History]:
     """Phase 2 on cached frozen-prefix features (train/feature_cache.py):
     run the prefix once over train/val, fit the suffix model on the
@@ -629,7 +638,7 @@ def _fit_cached_phase2(plan, spec, state: TrainState, train_ds, val_ds,
         initial_epoch=config.epochs, seed=config.seed + 1, logger=logger,
         central_storage=config.central_storage,
         compute_dtype=config.compute_dtype, repeats=config.repeats,
-        checkpoint_dir=checkpoint_dir)
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every)
 
     params, model_state = fc.merge_suffix_variables(
         plan, state.params, state.model_state,

@@ -1039,3 +1039,105 @@ def test_cli_serve_tp(tmp_path, capsys):
     with pytest.raises(SystemExit, match="needs"):
         cli.main(["serve", "--host-devices", "8", "--tp", "16",
                   "--requests", "1"])
+
+def test_cli_serve_save_ckpt_and_rollout(tmp_path, capsys):
+    """ISSUE-17 acceptance from the product surface: one run mints a
+    sharded checkpoint with --save-ckpt, the next canaries it onto live
+    traffic with --rollout and promotes — every request served, the
+    verdict line printed, the frozen ckpt_save/serve_rollout events in
+    the jsonl. State-machine semantics are owned by
+    tests/test_rollout.py; this drives the CLI wiring end to end."""
+    import json
+
+    model = ["--slots", "2", "--window", "4", "--t-max", "32",
+             "--vocab", "11", "--embed-dim", "16", "--num-heads", "2",
+             "--mlp-dim", "32", "--num-blocks", "1"]
+    ckpt = tmp_path / "candidate"
+    out = _run(["serve", "--host-devices", "8", "--requests", "4",
+                "--seed", "1", "--save-ckpt", str(ckpt),
+                "--path", str(tmp_path), *model], capsys)
+    assert f"to {ckpt}" in out and "checkpoint: wrote" in out
+    from idc_models_tpu.checkpoint import MANIFEST_NAME
+
+    assert (ckpt / MANIFEST_NAME).exists()
+
+    out = _run(["serve", "--host-devices", "8", "--requests", "24",
+                "--rollout", str(ckpt), "--canary-fraction", "0.5",
+                "--canary-requests", "3", "--rollout-at", "0.0",
+                "--path", str(tmp_path), *model], capsys)
+    assert "served: ok=24 timeout=0 rejected=0" in out
+    assert "rollout: promoted after" in out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("serve summary:")][0]
+    summary = json.loads(line.split("serve summary:", 1)[1])
+    assert summary["serve_rollout_outcome"] == "promoted"
+    assert summary["serve_rollout_stage"] == "promoted"
+    events = {json.loads(l)["event"] for l in
+              (tmp_path / "logs" / "serve.jsonl").read_text()
+              .splitlines()}
+    assert {"ckpt_save", "ckpt_restore", "serve_rollout"} <= events
+
+
+def test_cli_serve_rollout_adapters(tmp_path, capsys):
+    """--rollout-adapters: the cheap first rung — synthetic per-tenant
+    adapters are armed at build time and a re-seeded bank hot-swaps in
+    after the trace, with the tenant isolation epilogue intact."""
+    out = _run(["serve", "--host-devices", "8", "--requests", "6",
+                "--slots", "2", "--window", "4", "--t-max", "32",
+                "--vocab", "11", "--embed-dim", "16", "--num-heads",
+                "2", "--mlp-dim", "32", "--num-blocks", "1",
+                "--tenants", "acme,beta", "--rollout-adapters", "3"],
+               capsys)
+    assert "served: ok=6" in out
+    assert ("adapter rollout: hot-swapped rank-3 adapters for "
+            "2 tenant(s)") in out
+    assert "tenant acme:" in out and "tenant beta:" in out
+
+
+def test_cli_serve_rollout_usage_errors(tmp_path, capsys):
+    """ISSUE-17: every bad rollout knob dies as a TEACHING usage error
+    before any pre-training or serving runs, never a traceback."""
+    base = ["serve", "--host-devices", "8"]
+    with pytest.raises(SystemExit,
+                       match="--canary-fraction needs --rollout"):
+        cli.main(base + ["--canary-fraction", "0.5"])
+    with pytest.raises(SystemExit, match="--rollout-at needs"):
+        cli.main(base + ["--rollout-at", "0.5"])
+    # a fake but complete checkpoint lets the knob checks run; the
+    # knobs are validated before the checkpoint is ever restored
+    from idc_models_tpu.checkpoint import save_sharded
+
+    ck = tmp_path / "ck"
+    save_sharded(ck, {"w": np.zeros(3, np.float32)})
+    with pytest.raises(SystemExit, match="promoting without evidence"):
+        cli.main(base + ["--rollout", str(ck),
+                         "--canary-fraction", "-0.5"])
+    with pytest.raises(SystemExit, match="promoting without evidence"):
+        cli.main(base + ["--rollout", str(ck),
+                         "--canary-fraction", "1.5"])
+    with pytest.raises(SystemExit, match="at least one canary finish"):
+        cli.main(base + ["--rollout", str(ck),
+                         "--canary-requests", "0"])
+    with pytest.raises(SystemExit, match="drains before the rollout"):
+        cli.main(base + ["--rollout", str(ck), "--rollout-at", "1.0"])
+    with pytest.raises(SystemExit, match="MANIFEST.json"):
+        cli.main(base + ["--rollout", str(tmp_path / "nothing_here")])
+    with pytest.raises(SystemExit,
+                       match="--rollout-adapters needs --tenants"):
+        cli.main(base + ["--rollout-adapters", "3"])
+    with pytest.raises(SystemExit, match="adapter rank"):
+        cli.main(base + ["--tenants", "a,b", "--rollout-adapters", "0"])
+
+
+def test_cli_checkpoint_every_usage_errors(capsys):
+    """ISSUE-17: --checkpoint-every teaches on both training verbs —
+    zero is never, and pacing without --resumable writes nothing."""
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        cli.main(["vgg", "--host-devices", "8", "--checkpoint-every",
+                  "0", "--epochs", "1"])
+    with pytest.raises(SystemExit, match="needs --resumable"):
+        cli.main(["vgg", "--host-devices", "8", "--checkpoint-every",
+                  "2", "--epochs", "1"])
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        cli.main(["fed", "--host-devices", "8", "--checkpoint-every",
+                  "0", "--rounds", "1"])

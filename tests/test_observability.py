@@ -869,3 +869,61 @@ def test_tenant_jsonl_schemas_frozen_from_day_one(tmp_path):
     assert st["tenants"]["acme"]["by_reason"] == {"budget": 1}
     rendered = format_summary(st)
     assert "tenants:" in rendered and "acme" in rendered
+
+
+def test_checkpoint_rollout_jsonl_schemas_frozen(tmp_path, devices):
+    """ISSUE-17: the three new event shapes — ckpt_save, ckpt_restore,
+    serve_rollout — are frozen from day one; the summary grows three
+    additive keys (serve_rollouts / serve_rollout_outcome /
+    serve_rollout_stage) and the offline stats rollup reads the events
+    into its checkpoints/rollouts sections."""
+    from idc_models_tpu.checkpoint import restore_sharded, save_sharded
+    from idc_models_tpu.observe.stats import format_summary
+    from idc_models_tpu.serve.metrics import ServingMetrics
+
+    log = tmp_path / "run.jsonl"
+    with JsonlLogger(log) as logger:
+        mreg = MetricsRegistry()
+        save_sharded(tmp_path / "ck",
+                     {"w": np.arange(8, dtype=np.float32)}, step=2,
+                     logger=logger, registry=mreg)
+        restore_sharded(tmp_path / "ck", logger=logger, registry=mreg)
+        m = ServingMetrics(logger, registry=mreg)
+        m.on_rollout(stage="staging")
+        m.on_rollout(stage="canary")
+        m.on_rollout(stage="promoted", outcome="promoted",
+                     canary_requests=5)
+    recs = [json.loads(l) for l in open(log)]
+    by_event = {r["event"]: r for r in recs}
+    # the ISSUE-17 events, frozen from day one
+    assert set(by_event["ckpt_save"]) == {
+        "ts", "event", "path", "step", "leaves", "shards", "bytes",
+        "seconds", "background"}
+    assert set(by_event["ckpt_restore"]) == {
+        "ts", "event", "path", "leaves", "shards_read", "bytes_read",
+        "peak_host_bytes", "seconds", "sharded"}
+    assert set(by_event["serve_rollout"]) == {
+        "ts", "event", "stage", "outcome", "canary_requests", "reason"}
+    # the additive summary keys: rollout count, terminal outcome, the
+    # stage the machine ended in
+    s = m.summary()
+    assert s["serve_rollouts"] == 1
+    assert s["serve_rollout_outcome"] == "promoted"
+    assert s["serve_rollout_stage"] == "promoted"
+    # registry instruments from day one
+    names = {rec["name"] for rec in mreg.snapshot()}
+    assert {"ckpt_saves_total", "ckpt_restores_total",
+            "ckpt_bytes_written_total", "ckpt_bytes_read_total",
+            "serve_rollouts_total", "serve_rollout_stage_code"} <= names
+    # the offline stats rollup: transfer totals + the transition list
+    st = summarize_jsonl(log)
+    assert st["checkpoints"]["saves"] == 1
+    assert st["checkpoints"]["restores"] == 1
+    assert st["checkpoints"]["save_bytes"] == 32
+    assert st["checkpoints"]["restore_bytes"] == 32
+    assert st["checkpoints"]["restore_peak_host_bytes"] > 0
+    assert [r["stage"] for r in st["rollouts"]] == [
+        "staging", "canary", "promoted"]
+    assert st["rollouts"][-1]["outcome"] == "promoted"
+    rendered = format_summary(st)
+    assert "checkpoints:" in rendered and "rollouts" in rendered

@@ -522,7 +522,14 @@ def test_sharding_doc_complete():
             f"LM rule {pattern!r} undocumented in docs/SHARDING.md")
     for needle in ("PartitionRules", "shard_tree", "gather_tree",
                    "--fsdp", "--tp", "right-align", "dead rule",
-                   "peak_hbm_bytes"):
+                   "peak_hbm_bytes",
+                   # ISSUE 17: the checkpoint/rollout section rides the
+                   # same gate — the rules layer is its addressing scheme
+                   "MANIFEST.json", "save_sharded", "restore_sharded",
+                   "peak_host_bytes", "canary", "swap_params",
+                   "swap_adapters", "--rollout", "--canary-fraction",
+                   "--checkpoint-every", "--save-ckpt",
+                   "--rollout-adapters"):
         assert needle in doc, (
             f"docs/SHARDING.md missing {needle!r}")
 

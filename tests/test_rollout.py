@@ -1,0 +1,325 @@
+"""Zero-downtime weight rollout (checkpoint/rollout.py + the serve
+hooks) against its contracts:
+
+1. ZERO DROP/DUP — a trace replayed through `run_with_rollout` comes
+   back with exactly one Result per request id, every one served,
+   whether the rollout promotes or rolls back.
+2. FORCED-BAD CANDIDATE — a NaN candidate is caught at the STAGING
+   spot-check on the engine's already-compiled programs and
+   auto-rolls back with zero client-visible errors (no request ever
+   routes onto the bad weights).
+3. PROMOTE SEMANTICS — `swap_params` is zero-recompile (jit cache
+   sizes frozen across the swap), refuses architecture changes with a
+   teaching error, and post-promote output matches a server BUILT on
+   the candidate weights bit-for-bit.
+4. ADAPTER FIRST RUNG — `swap_adapters` changes a tenant's stream to
+   match a natively-built bank, and teaches on tenant-less servers.
+5. FLEET SCALE — `Router.start_rollout` canaries ONE replica, the
+   health-document decision promotes the rest or swaps back.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idc_models_tpu.checkpoint import (
+    RolloutController, run_with_rollout, save_sharded,
+)
+from idc_models_tpu.checkpoint.rollout import RolloutError
+from idc_models_tpu.models.lm import attention_lm
+from idc_models_tpu.serve import LMServer, Request, TenantRegistry
+from idc_models_tpu.serve.cluster import Router, build_replica
+
+VOCAB, SEQ, E, HEADS, MLP, BLOCKS = 11, 32, 32, 2, 64, 2
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = attention_lm(VOCAB, SEQ, embed_dim=E, num_heads=HEADS,
+                         mlp_dim=MLP, num_blocks=BLOCKS)
+    return model.init(jax.random.key(0)).params
+
+
+@pytest.fixture(scope="module")
+def candidate():
+    model = attention_lm(VOCAB, SEQ, embed_dim=E, num_heads=HEADS,
+                         mlp_dim=MLP, num_blocks=BLOCKS)
+    return model.init(jax.random.key(1)).params
+
+
+def _kw(**over):
+    kw = dict(embed_dim=E, num_heads=HEADS, num_blocks=BLOCKS,
+              t_max=SEQ, cache_dtype=jnp.float32)
+    kw.update(over)
+    return kw
+
+
+def _trace(n, *, start=0, tenant=None, seed=7):
+    rng = np.random.default_rng(seed)
+    return [(0.0, Request(id=f"r{start + i}",
+                          prompt=tuple(int(x) for x in
+                                       rng.integers(1, VOCAB,
+                                                    3 + i % 5)),
+                          max_new_tokens=3 + i % 4, tenant=tenant))
+            for i in range(n)]
+
+
+def _assert_one_result_each(results, trace):
+    ids = [r.id for r in results]
+    assert sorted(ids) == sorted(t[1].id for t in trace)
+    assert len(set(ids)) == len(ids)
+
+
+# -- the drill: promote and rollback under live traffic -----------------
+
+
+def test_rollout_promotes_with_zero_drop_or_dup(params, candidate,
+                                                devices):
+    server = LMServer(params, n_slots=2, window=4, **_kw())
+    tr = _trace(24)
+    res, ctl = run_with_rollout(server, tr, candidate,
+                                canary_fraction=0.5, canary_requests=3)
+    _assert_one_result_each(res, tr)
+    assert all(r.status == "ok" for r in res)
+    assert ctl.stage == "promoted"
+    assert len(ctl._canary_done) >= 3
+    s = server.summary()
+    assert s["serve_rollout_stage"] == "promoted"
+    assert s["serve_rollout_outcome"] == "promoted"
+    assert s["serve_rollouts"] == 1
+
+    # post-promote the LIVE server speaks the candidate weights:
+    # bit-identical to a server BUILT on them
+    probe = _trace(3, start=100, seed=11)
+    want = {r.id: r.tokens for r in
+            LMServer(candidate, n_slots=2, window=4,
+                     **_kw()).run(probe)}
+    got = {r.id: r.tokens for r in server.run(probe)}
+    assert got == want
+
+
+def test_nan_candidate_rolls_back_at_staging(params, devices):
+    """The forced-bad drill: staging's spot-check on the compiled
+    programs catches NaN weights — no canary ever opens, no client
+    request errors, the stage lands rolled_back."""
+    server = LMServer(params, n_slots=2, window=4, **_kw())
+    bad = jax.tree.map(lambda a: jnp.full_like(a, jnp.nan), params)
+    tr = _trace(12)
+    res, ctl = run_with_rollout(server, tr, bad, canary_fraction=0.5,
+                                canary_requests=3)
+    assert ctl.stage == "rolled_back"
+    assert "spot-check" in ctl.reason and "non-finite" in ctl.reason
+    assert ctl.canary is None
+    _assert_one_result_each(res, tr)
+    assert all(r.status == "ok" for r in res)
+    assert server.summary()["serve_rollout_outcome"] == "rolled_back"
+
+    # live output is untouched by the refused candidate
+    probe = _trace(2, start=200, seed=13)
+    fresh = {r.id: r.tokens for r in
+             LMServer(params, n_slots=2, window=4,
+                      **_kw()).run(probe)}
+    assert {r.id: r.tokens for r in server.run(probe)} == fresh
+
+
+def test_insufficient_canary_evidence_rolls_back(params, candidate,
+                                                 devices):
+    server = LMServer(params, n_slots=2, window=4, **_kw())
+    tr = _trace(6)
+    res, ctl = run_with_rollout(server, tr, candidate,
+                                canary_fraction=0.01,
+                                canary_requests=50)
+    assert ctl.stage == "rolled_back"
+    assert "not enough evidence" in ctl.reason
+    _assert_one_result_each(res, tr)
+    assert all(r.status == "ok" for r in res)
+
+
+def test_rollout_from_sharded_checkpoint_path(params, candidate,
+                                              devices, tmp_path):
+    """The subsystems compose: the candidate arrives as a sharded
+    checkpoint DIRECTORY and the controller restores it before
+    staging."""
+    save_sharded(tmp_path / "cand", candidate)
+    server = LMServer(params, n_slots=2, window=4, **_kw())
+    tr = _trace(20)
+    res, ctl = run_with_rollout(server, tr, str(tmp_path / "cand"),
+                                canary_fraction=0.5, canary_requests=2)
+    assert ctl.stage == "promoted"
+    _assert_one_result_each(res, tr)
+    probe = _trace(2, start=300, seed=17)
+    want = {r.id: r.tokens for r in
+            LMServer(candidate, n_slots=2, window=4,
+                     **_kw()).run(probe)}
+    assert {r.id: r.tokens for r in server.run(probe)} == want
+
+
+def test_tenant_affine_routing_is_deterministic(params, candidate,
+                                                devices):
+    """A tenant's requests all land on ONE side of the split (PR 14
+    affinity: prefix locality and quota accounting never straddle)."""
+    reg = TenantRegistry()
+    for name in ("acme", "globex", "initech", "umbrella"):
+        reg.register(name)
+    server = LMServer(params, n_slots=2, window=4, tenancy=reg,
+                      **_kw())
+    ctl = RolloutController(server, candidate, canary_fraction=0.5)
+    assert ctl.start()
+    sides = {}
+    for name in ("acme", "globex", "initech", "umbrella"):
+        routed = {ctl.routes_to_canary(
+            Request(id=f"q{name}{i}", prompt=(1, 2),
+                    max_new_tokens=2, tenant=name)) for i in range(8)}
+        assert len(routed) == 1     # whole tenant on one side
+        sides[name] = routed.pop()
+    assert len(set(sides.values())) == 2    # the split actually splits
+    ctl._rollback("test over")
+
+
+# -- swap primitives ----------------------------------------------------
+
+
+def test_swap_params_is_zero_recompile_and_validates(params, candidate,
+                                                     devices):
+    server = LMServer(params, n_slots=2, window=4, **_kw())
+    server.run(_trace(2, seed=23))
+    sizes = server.engine.cache_sizes()
+    server.swap_params(candidate)
+    server.run(_trace(2, start=50, seed=29))
+    assert server.engine.cache_sizes() == sizes
+
+    with pytest.raises(ValueError, match="not architectures"):
+        server.swap_params({"wrong": np.zeros((2, 2), np.float32)})
+    grown = jax.tree.map(
+        lambda a: np.zeros(tuple(d + 1 for d in a.shape),
+                           np.asarray(a).dtype), params)
+    with pytest.raises(ValueError, match="not architectures"):
+        server.swap_params(grown)
+
+
+def test_controller_is_single_use(params, candidate, devices):
+    server = LMServer(params, n_slots=2, window=4, **_kw())
+    ctl = RolloutController(server, candidate, canary_requests=1)
+    assert ctl.start()
+    with pytest.raises(RolloutError, match="ONE rollout"):
+        ctl.start()
+    ctl._rollback("test over")
+    with pytest.raises(RolloutError, match="ONE rollout"):
+        ctl.start()
+    with pytest.raises(ValueError, match="canary_fraction"):
+        RolloutController(server, candidate, canary_fraction=1.5)
+    with pytest.raises(ValueError, match="canary_requests"):
+        RolloutController(server, candidate, canary_requests=0)
+
+
+def test_adapter_hot_swap_first_rung(params, devices):
+    """swap_adapters on a live multi-tenant server matches a server
+    BUILT with the new bank; a tenant-less server teaches instead."""
+    rank = 3
+    rng = np.random.default_rng(31)
+
+    def adapter(seed, scale=0.5):
+        r = np.random.default_rng(seed)
+        return (r.normal(0, scale, (VOCAB, rank)).astype(np.float32),
+                r.normal(0, scale, (rank, VOCAB)).astype(np.float32))
+
+    def registry(a, b):
+        reg = TenantRegistry()
+        reg.register("acme", adapter=a)
+        reg.register("globex", adapter=b)
+        return reg
+
+    a0, b0 = adapter(1), adapter(2)
+    a1, b1 = adapter(3), adapter(4)
+    live = LMServer(params, n_slots=2, window=4,
+                    tenancy=registry(a0, b0), **_kw())
+    probe = _trace(4, tenant="acme", seed=37)
+    live.run(probe)
+
+    # build the new bank rows in registry order and hot-swap
+    u = np.stack([a1[0], b1[0]])
+    v = np.stack([a1[1], b1[1]])
+    live.swap_adapters(u, v)
+    probe2 = _trace(4, start=60, tenant="acme", seed=41)
+    want = {r.id: r.tokens for r in
+            LMServer(params, n_slots=2, window=4,
+                     tenancy=registry(a1, b1), **_kw()).run(probe2)}
+    got = {r.id: r.tokens for r in live.run(probe2)}
+    assert got == want
+
+    bare = LMServer(params, n_slots=2, window=4, **_kw())
+    with pytest.raises(ValueError, match="multi-tenant"):
+        bare.swap_adapters(u, v)
+    with pytest.raises(ValueError, match="armed bank"):
+        live.swap_adapters(u[:, :, :2], v[:, :2, :])
+
+
+def test_quiesce_collects_without_dispatch(params, devices):
+    """Scheduler.quiesce: one cycle that collects the in-flight window
+    without dispatching another — afterwards the engine is
+    dispatch-idle (the paged spot-check precondition) and the pending
+    requests still finish on later ticks."""
+    server = LMServer(params, n_slots=2, window=4, **_kw())
+    for _, r in _trace(3, seed=43):
+        server.submit(r)
+    server.step()
+    server.quiesce()
+    assert server.engine._pending is None
+    done = server.drain()
+    assert server.scheduler.idle()
+    assert all(r.status == "ok" for r in server.results())
+
+
+# -- fleet scale --------------------------------------------------------
+
+
+def _fleet(params, n=2, **kw):
+    reps = [build_replica(params, replica_id=f"rep{i}", n_slots=2,
+                          window=4, **_kw(), **kw) for i in range(n)]
+    return reps, Router(reps)
+
+
+def test_router_rollout_promotes_fleet(params, candidate, devices):
+    reps, router = _fleet(params)
+    router.run(_trace(6, seed=47))
+    canary_id = router.start_rollout(candidate)
+    assert canary_id in {"rep0", "rep1"}
+    router.run(_trace(6, start=70, seed=53))
+    assert router.finish_rollout() == "promoted"
+    # EVERY replica now speaks the candidate weights
+    probe = _trace(2, start=400, seed=59)
+    want = [r.tokens for r in sorted(
+        LMServer(candidate, n_slots=2, window=4, **_kw()).run(probe),
+        key=lambda r: r.id)]
+    for rep in reps:
+        renamed = [(t, Request(id=f"{q.id}-{rep.replica_id}",
+                               prompt=q.prompt,
+                               max_new_tokens=q.max_new_tokens))
+                   for t, q in probe]
+        got = [r.tokens for r in sorted(rep.server.run(renamed),
+                                        key=lambda r: r.id)]
+        assert got == want, rep.replica_id
+
+
+def test_router_rollout_nan_refused_fleet_untouched(params, devices):
+    _, router = _fleet(params)
+    bad = jax.tree.map(lambda a: jnp.full_like(a, jnp.nan), params)
+    with pytest.raises(ValueError, match="spot-check"):
+        router.start_rollout(bad)
+    assert router._rollout is None
+    res = router.run(_trace(4, seed=61))
+    assert all(r.status == "ok" for r in res)
+
+
+def test_router_rollout_api_misuse_teaches(params, candidate, devices):
+    _, router = _fleet(params)
+    with pytest.raises(RuntimeError, match="no rollout open"):
+        router.finish_rollout()
+    router.start_rollout(candidate, replica_id="rep1")
+    with pytest.raises(RuntimeError, match="already open"):
+        router.start_rollout(candidate)
+    assert router.finish_rollout() == "promoted"
+    with pytest.raises(ValueError, match="decode-capable"):
+        router.replicas[0].drain()
+        router.start_rollout(candidate, replica_id="rep0")
