@@ -12,7 +12,10 @@ The only way to change serving weights used to be killing the server.
    new weights — the forced-bad-candidate gate.
 2. **canary** — a config-identical second server over the candidate
    (`LMServer.canary_clone`; zero new compiles, the process-wide jit
-   cache serves both) takes a controlled fraction of submits. Routing
+   cache serves both — and when the live server carries a persistent
+   `CompileCache`, the clone config carries it too, so a canary in a
+   FRESH process spins warm off the serialized executables instead of
+   re-running XLA) takes a controlled fraction of submits. Routing
    is TENANT-AFFINE (the PR 14 placement idea): a tenant's whole
    traffic hashes onto one side, so its prefix locality and quota
    accounting never straddle the split; tenant-less requests hash

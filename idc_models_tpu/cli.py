@@ -491,6 +491,14 @@ def _parse(argv):
                          "finishes; at startup any in-flight requests "
                          "a previous crashed run left in the file are "
                          "re-admitted through the normal path")
+    sp.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent compile cache "
+                         "(serve/compile_cache.py): AOT-serialized "
+                         "decode/sample executables keyed on model "
+                         "config + mesh + jaxlib version. First run "
+                         "compiles and stores; later runs (and warm "
+                         "replica spin-ups) deserialize instead of "
+                         "recompiling")
     sp.add_argument("--brownout", action="store_true",
                     help="arm the staged degradation controller "
                          "(serve/brownout.py): when a declared SLO "
@@ -655,6 +663,21 @@ def _parse(argv):
                     help="directory for per-replica journal WALs "
                          "(<dir>/journal-<replica>.jsonl) — required "
                          "for the kill drill's migration")
+    sp.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent compile cache shared by every "
+                         "replica (serve/compile_cache.py): the first "
+                         "replica compiles and stores, the rest — and "
+                         "any autoscaled spin-up — deserialize warm")
+    sp.add_argument("--autoscale-max", type=int, default=None,
+                    metavar="N",
+                    help="arm the autoscaler "
+                         "(serve/cluster/autoscaler.py): scale the "
+                         "decode fleet between --replicas and N from "
+                         "the replicas' own health documents (queue "
+                         "depth, shedding, page headroom) with dwell "
+                         "+ cooldown hysteresis; scale-down drains "
+                         "the least-loaded replica and live-migrates "
+                         "its in-flight slots onto survivors")
     sp.add_argument("--max-retries", type=int, default=2,
                     help="router-level re-placement bound per request "
                          "(migrations + hedges)")
@@ -823,6 +846,39 @@ def _finish_logger(logger) -> None:
 
     REGISTRY.log_snapshot(logger)
     logger.close()
+
+
+class _DrainRequested(Exception):
+    """Raised from the SIGTERM handler to unwind the serve loop into
+    the graceful-drain path (admissions stop, in-flight work
+    finishes, the journal flushes)."""
+
+
+def _arm_sigterm():
+    """Install a SIGTERM handler that raises _DrainRequested in the
+    main thread. Returns the previous handler so the caller can
+    restore it, or None when installation is impossible (non-main
+    thread — e.g. a test harness driving the verb from a worker)."""
+    import signal
+
+    def _handler(signum, frame):
+        raise _DrainRequested()
+
+    try:
+        return signal.signal(signal.SIGTERM, _handler)
+    except ValueError:
+        return None
+
+
+def _disarm_sigterm(prev) -> None:
+    import signal
+
+    if prev is None:
+        return
+    try:
+        signal.signal(signal.SIGTERM, prev)
+    except ValueError:
+        pass
 
 
 def _data_root(ns):
@@ -2259,6 +2315,11 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
         from idc_models_tpu.serve import pending_requests
 
         n_pending = len(pending_requests(ns.journal))
+    compile_cache = None
+    if ns.compile_cache:
+        from idc_models_tpu.serve import CompileCache
+
+        compile_cache = CompileCache(ns.compile_cache, logger=logger)
     server = LMServer(
         params, embed_dim=ns.embed_dim, num_heads=ns.num_heads,
         num_blocks=ns.num_blocks, t_max=ns.t_max, n_slots=ns.slots,
@@ -2276,7 +2337,8 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
         kv_page_size=ns.kv_page_size or None,
         kv_pages=ns.kv_pages or None,
         kv_decode_reserve=ns.kv_decode_reserve or None,
-        tenancy=tenancy, partition_rules=rules)
+        tenancy=tenancy, partition_rules=rules,
+        compile_cache=compile_cache)
     if n_pending:
         readmitted = server.resubmit_pending(ns.journal)
         line = (f"journal: re-admitted {len(readmitted)} in-flight "
@@ -2315,26 +2377,48 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
     from idc_models_tpu.serve import InjectedEngineCrash
 
     crashed = None
+    drained = False
     rollout_ctl = None
-    with Timer("Serving trace", logger=logger), \
-            profile_trace(ns.profile_dir):
-        try:
-            if ns.rollout:
-                from idc_models_tpu.checkpoint import run_with_rollout
+    prev_sigterm = _arm_sigterm()
+    try:
+        with Timer("Serving trace", logger=logger), \
+                profile_trace(ns.profile_dir):
+            try:
+                if ns.rollout:
+                    from idc_models_tpu.checkpoint import (
+                        run_with_rollout,
+                    )
 
-                results, rollout_ctl = run_with_rollout(
-                    server, trace, ns.rollout,
-                    start_after=ns.rollout_at, realtime=ns.realtime,
-                    canary_fraction=ns.canary_fraction,
-                    canary_requests=ns.canary_requests, logger=logger)
-            else:
-                results = server.run(trace, realtime=ns.realtime)
-        except InjectedEngineCrash as e:
-            # the drill's hard death: the failure cleanup already
-            # finalized every in-flight request as an error Result —
-            # salvage them, report honestly, and point at the recovery
-            crashed = e
-            results = server.results()
+                    results, rollout_ctl = run_with_rollout(
+                        server, trace, ns.rollout,
+                        start_after=ns.rollout_at,
+                        realtime=ns.realtime,
+                        canary_fraction=ns.canary_fraction,
+                        canary_requests=ns.canary_requests,
+                        logger=logger)
+                else:
+                    results = server.run(trace, realtime=ns.realtime)
+            except InjectedEngineCrash as e:
+                # the drill's hard death: the failure cleanup already
+                # finalized every in-flight request as an error Result
+                # — salvage them, report honestly, and point at the
+                # recovery
+                crashed = e
+                results = server.results()
+            except _DrainRequested:
+                # SIGTERM: stop admitting, finish what's running, let
+                # the journal's finish records land — the honest
+                # graceful-shutdown contract
+                drained = True
+                server.scheduler.begin_drain()
+                server.drain()
+                results = server.results()
+    finally:
+        _disarm_sigterm(prev_sigterm)
+    if drained:
+        print("SIGTERM: drained gracefully — admissions stopped, "
+              "in-flight requests finished, journal flushed"
+              + (f" ({ns.journal})" if ns.journal else ""))
     if crashed is not None:
         hint = (f"; rerun with --journal {ns.journal} to recover the "
                 f"in-flight requests" if ns.journal else
@@ -2359,6 +2443,13 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
               f"({summary['serve_prefix_hits']} hits, "
               f"{summary['serve_prefix_evictions']} evictions, "
               f"{summary['serve_prefix_bytes']} bytes)")
+    if summary.get("serve_compile_cache") is not None:
+        cc = summary["serve_compile_cache"]
+        print(f"compile cache: {cc['hits']} hit(s) "
+              f"({cc['deserialize_s']:.3f}s deserializing), "
+              f"{cc['misses']} miss(es) -> {cc['stores']} store(s) "
+              f"({cc['compile_s']:.3f}s compiling), "
+              f"{cc['evicted_corrupt']} corrupt eviction(s)")
     if ns.kv_page_size:
         # what paging actually bought: peak pool occupancy vs the
         # capacity the same HBM would hold as contiguous per-slot
@@ -2493,6 +2584,9 @@ def _run_serve_cluster(ns):
     if ns.kill_after_steps < 0:
         sys.exit(f"--kill-after-steps {ns.kill_after_steps} must be "
                  f">= 0")
+    if ns.autoscale_max is not None and ns.autoscale_max < ns.replicas:
+        sys.exit(f"--autoscale-max {ns.autoscale_max} must be >= "
+                 f"--replicas {ns.replicas} (it is the fleet ceiling)")
 
     logger = (JsonlLogger(Path(ns.path) / "logs" / "cluster.jsonl")
               if ns.path else None)
@@ -2512,32 +2606,62 @@ def _run_serve_cluster(ns):
     # always a policy: --max-retries 0 means ZERO re-placements (a
     # valid, strict budget), never "unbounded"
     retry = RetryPolicy(max_retries=ns.max_retries)
+    compile_cache = None
+    if ns.compile_cache:
+        from idc_models_tpu.serve import CompileCache
+
+        compile_cache = CompileCache(ns.compile_cache, logger=logger)
     devices = jax.devices()
+
+    def _build(i, rid, role):
+        return build_replica(
+            params, replica_id=rid, role=role,
+            device=devices[i % len(devices)],
+            n_slots=ns.slots, window=ns.window,
+            prefill_chunk=ns.prefill_chunk or None,
+            prefix_cache_mb=ns.prefix_cache_mb,
+            shared_prefix=registry,
+            journal_path=(
+                str(Path(ns.journal_dir) / f"journal-{rid}.jsonl")
+                if ns.journal_dir else None),
+            retry=retry,
+            brownout_queue_high=ns.brownout_queue_high,
+            max_queue_depth=ns.max_queue_depth,
+            temperature=ns.temperature, top_k=ns.top_k or None,
+            eos_id=ns.eos, cache_dtype=jnp.float32,
+            compile_cache=compile_cache,
+            logger=logger, **model_kw)
+
     replicas = []
     with Timer("Cluster build", logger=logger):
         for i in range(n_fleet):
             role = "prefill" if i >= ns.replicas else "mixed"
-            replicas.append(build_replica(
-                params, replica_id=f"r{i}", role=role,
-                device=devices[i % len(devices)],
-                n_slots=ns.slots, window=ns.window,
-                prefill_chunk=ns.prefill_chunk or None,
-                prefix_cache_mb=ns.prefix_cache_mb,
-                shared_prefix=registry,
-                journal_path=(
-                    str(Path(ns.journal_dir) / f"journal-r{i}.jsonl")
-                    if ns.journal_dir else None),
-                retry=retry,
-                brownout_queue_high=ns.brownout_queue_high,
-                max_queue_depth=ns.max_queue_depth,
-                temperature=ns.temperature, top_k=ns.top_k or None,
-                eos_id=ns.eos, cache_dtype=jnp.float32,
-                logger=logger, **model_kw))
+            replicas.append(_build(i, f"r{i}", role))
+    autoscaler = None
+    replica_factory = None
+    if ns.autoscale_max is not None:
+        from idc_models_tpu.serve import AutoscaleConfig, Autoscaler
+
+        autoscaler = Autoscaler(
+            AutoscaleConfig(min_replicas=ns.replicas,
+                            max_replicas=ns.autoscale_max),
+            logger=logger)
+        # a spun-up replica inherits the fleet's build kwargs — and
+        # the shared compile cache, so it deserializes warm instead
+        # of recompiling
+        auto_ordinal = [n_fleet]
+
+        def replica_factory(rid):
+            i = auto_ordinal[0]
+            auto_ordinal[0] += 1
+            return _build(i, rid, "mixed")
+
     router = Router(
         replicas, retry=retry,
         hedge_after_s=(None if ns.hedge_after_ms is None
                        else ns.hedge_after_ms / 1e3),
-        prefix_registry=registry, logger=logger)
+        prefix_registry=registry, logger=logger,
+        autoscaler=autoscaler, replica_factory=replica_factory)
     if ns.trace:
         trace = load_trace(ns.trace)
     else:
@@ -2554,34 +2678,56 @@ def _run_serve_cluster(ns):
     drill_at = (ns.kill_after_steps
                 if (ns.kill_replica is not None
                     or ns.drain_replica is not None) else None)
-    with Timer("Serving trace (cluster)", logger=logger):
-        if drill_at is None:
-            results = router.run(trace, realtime=ns.realtime)
-        else:
-            # drill mode: burst-submit (re-offering on backpressure —
-            # a refused submit leaves no Result and must not be
-            # silently dropped), step to the drill point, fire it,
-            # then drain — deterministic and journal-backed
-            steps = 0
-            for _, req in sorted(trace, key=lambda tr: tr[0]):
-                while not router.submit(req):
-                    shed = router.poll(req.id)
-                    if shed is not None and shed.status == "shed":
-                        break           # terminal answer, not a race
-                    router.step()
-                    steps += 1
-            for _ in range(max(drill_at - steps, 0)):
-                router.step()
-            if ns.drain_replica is not None:
-                router.drain_replica(f"r{ns.drain_replica}")
-                print(f"drained replica r{ns.drain_replica}")
-            if ns.kill_replica is not None:
-                migrated = router.kill_replica(f"r{ns.kill_replica}")
-                print(f"killed replica r{ns.kill_replica}: "
-                      f"{len(migrated)} journaled request(s) migrated "
-                      f"onto the survivors")
-            router.drain()
-            results = router.results()
+    drained_on_signal = False
+    prev_sigterm = _arm_sigterm()
+    try:
+        with Timer("Serving trace (cluster)", logger=logger):
+            try:
+                if drill_at is None:
+                    results = router.run(trace, realtime=ns.realtime)
+                else:
+                    # drill mode: burst-submit (re-offering on
+                    # backpressure — a refused submit leaves no Result
+                    # and must not be silently dropped), step to the
+                    # drill point, fire it, then drain —
+                    # deterministic and journal-backed
+                    steps = 0
+                    for _, req in sorted(trace, key=lambda tr: tr[0]):
+                        while not router.submit(req):
+                            shed = router.poll(req.id)
+                            if shed is not None and shed.status == "shed":
+                                break   # terminal answer, not a race
+                            router.step()
+                            steps += 1
+                    for _ in range(max(drill_at - steps, 0)):
+                        router.step()
+                    if ns.drain_replica is not None:
+                        router.drain_replica(f"r{ns.drain_replica}")
+                        print(f"drained replica r{ns.drain_replica}")
+                    if ns.kill_replica is not None:
+                        migrated = router.kill_replica(
+                            f"r{ns.kill_replica}")
+                        print(f"killed replica r{ns.kill_replica}: "
+                              f"{len(migrated)} journaled request(s) "
+                              f"migrated onto the survivors")
+                    router.drain()
+                    results = router.results()
+            except _DrainRequested:
+                # SIGTERM: every live replica stops admitting, the
+                # router steps the fleet until in-flight work lands,
+                # and each WAL carries its finish records
+                drained_on_signal = True
+                for rep in router.replicas:
+                    if rep.state == "live":
+                        rep.drain()
+                router.drain()
+                results = router.results()
+    finally:
+        _disarm_sigterm(prev_sigterm)
+    if drained_on_signal:
+        print("SIGTERM: cluster drained gracefully — admissions "
+              "stopped, in-flight requests finished on every live "
+              "replica, journals flushed")
     n_ok = sum(r.status == "ok" for r in results)
     summary = router.summary()
     print(f"served: ok={n_ok} "
@@ -2594,11 +2740,27 @@ def _run_serve_cluster(ns):
               f"(pooled across replicas)")
     print(f"placements: {summary['cluster_placements']}  "
           f"migrations={summary['cluster_migrations']} "
+          f"slot_migrations={summary['cluster_slot_migrations']} "
           f"handoffs={summary['cluster_handoffs']} "
           f"hedges={summary['cluster_hedges']}  replicas "
           f"live={summary['cluster_replicas_live']} "
           f"draining={summary['cluster_replicas_draining']} "
           f"dead={summary['cluster_replicas_dead']}")
+    if autoscaler is not None:
+        ups = sum(1 for d in autoscaler.decisions
+                  if d["action"] == "up")
+        downs = sum(1 for d in autoscaler.decisions
+                    if d["action"] == "down")
+        print(f"autoscaler: {ups} scale-up(s), {downs} "
+              f"scale-down(s), fleet "
+              f"{summary['cluster_replicas_live']} live at exit "
+              f"(bounds [{ns.replicas}, {ns.autoscale_max}])")
+    if compile_cache is not None:
+        cs = compile_cache.summary()
+        print(f"compile cache: {cs['hits']} hit(s) "
+              f"({cs['deserialize_s']:.3f}s deserializing), "
+              f"{cs['misses']} miss(es) -> {cs['stores']} store(s) "
+              f"({cs['compile_s']:.3f}s compiling)")
     if registry is not None:
         print(f"prefix registry: {summary['cluster_prefix_hits']} "
               f"hit(s), {summary['cluster_prefix_published']} "

@@ -60,6 +60,8 @@ def summarize_jsonl(path) -> dict:
             "restores": 0, "restore_bytes": 0, "restore_seconds": 0.0,
             "restore_peak_host_bytes": 0}
     rollouts: list[dict] = []
+    cc = {"hits": 0, "misses": 0, "stores": 0, "evicted_corrupt": 0,
+          "deserialize_ms": 0.0, "compile_ms": 0.0}
     last_snapshot = None
     ts = [r["ts"] for r in records
           if isinstance(r.get("ts"), (int, float))]
@@ -125,6 +127,23 @@ def summarize_jsonl(path) -> dict:
                  ("event", "stage", "outcome", "reason",
                   "canary_requests", "replica")
                  if r.get(k) is not None})
+        # persistent compile cache (PR 18, serve/compile_cache.py):
+        # warm-vs-cold spin-up totals — an evict_corrupt already counts
+        # itself as a miss at the source, mirrored here
+        if event == "compile_cache":
+            o = r.get("outcome")
+            if o == "hit":
+                cc["hits"] += 1
+                cc["deserialize_ms"] += float(
+                    r.get("deserialize_ms") or 0.0)
+            elif o == "store":
+                cc["stores"] += 1
+                cc["compile_ms"] += float(r.get("compile_ms") or 0.0)
+            elif o == "miss":
+                cc["misses"] += 1
+            elif o == "evict_corrupt":
+                cc["evicted_corrupt"] += 1
+                cc["misses"] += 1
     events = {
         ev: {"count": slot["count"],
              "fields": {k: _num_stats(vs)
@@ -177,6 +196,12 @@ def summarize_jsonl(path) -> dict:
                  ckpt["restore_peak_host_bytes"]}
             if ckpt["saves"] or ckpt["restores"] else None),
         "rollouts": rollouts,
+        # compile-cache totals (None when the run never touched one —
+        # the key set stays stable either way)
+        "compile_cache": (
+            {**cc, "deserialize_ms": round(cc["deserialize_ms"], 3),
+             "compile_ms": round(cc["compile_ms"], 3)}
+            if cc["hits"] or cc["misses"] or cc["stores"] else None),
         "metrics": last_snapshot,
         "requests": _request_timelines(records),
     }
@@ -380,6 +405,15 @@ def format_summary(s: dict, *, top: int = 15) -> str:
             + (f", {ck['restore_mb_per_s']} MB/s"
                if ck["restore_mb_per_s"] is not None else "")
             + f", peak host {ck['restore_peak_host_bytes']} bytes)")
+    if s.get("compile_cache"):
+        cc = s["compile_cache"]
+        out.append("")
+        out.append(
+            f"compile cache: {cc['hits']} hit(s) "
+            f"({cc['deserialize_ms']} ms deserializing), "
+            f"{cc['misses']} miss(es) -> {cc['stores']} store(s) "
+            f"({cc['compile_ms']} ms compiling), "
+            f"{cc['evicted_corrupt']} corrupt eviction(s)")
     if s.get("rollouts"):
         out.append("")
         out.append("rollouts (state transitions, file order):")

@@ -3,7 +3,11 @@ from idc_models_tpu.serve.api import (  # noqa: F401
 )
 from idc_models_tpu.serve.brownout import BrownoutController  # noqa: F401
 from idc_models_tpu.serve.cluster import (  # noqa: F401
-    PrefixRegistry, Replica, Router, build_replica,
+    AutoscaleConfig, Autoscaler, PrefixRegistry, Replica, Router,
+    build_replica,
+)
+from idc_models_tpu.serve.compile_cache import (  # noqa: F401
+    CompileCache, enable_persistent_xla_cache,
 )
 from idc_models_tpu.serve.engine import SlotEngine  # noqa: F401
 from idc_models_tpu.serve.faults import (  # noqa: F401

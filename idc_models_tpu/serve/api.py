@@ -110,7 +110,8 @@ class LMServer:
                  kv_page_size: int | None = None,
                  kv_pages: int | None = None,
                  kv_decode_reserve: int | None = None,
-                 registry=None, tenancy=None, partition_rules=None):
+                 registry=None, tenancy=None, partition_rules=None,
+                 compile_cache=None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
@@ -148,8 +149,16 @@ class LMServer:
             spec_decode=spec_decode, draft_k=draft_k,
             draft_order=draft_order, kv_page_size=kv_page_size,
             kv_pages=kv_pages, kv_decode_reserve=kv_decode_reserve,
-            partition_rules=partition_rules)
+            partition_rules=partition_rules,
+            compile_cache=compile_cache)
         self._clone_logger = logger
+        # compile_cache: a serve.compile_cache.CompileCache — warmup
+        # then AOT-loads (or compiles-and-stores) the serve programs
+        # from disk, so a replica spin-up on a warmed cache is a
+        # deserialize, not an XLA run (cluster elasticity; cloned into
+        # canaries via _clone_cfg so a rollout's second server spins
+        # warm too)
+        self.compile_cache = compile_cache
         # registry: an observe MetricsRegistry for this server's
         # instruments (None = the process-wide default). A multi-
         # replica process (serve/cluster) gives each replica its OWN
@@ -238,7 +247,9 @@ class LMServer:
         self._results: dict[str, Result] = {}
         self._inflight: set[str] = set()
         if warmup:
-            self.engine.warmup(window)
+            self.engine.warmup(window, compile_cache=compile_cache)
+        if compile_cache is not None:
+            self.metrics.on_compile_cache(compile_cache)
 
     # -- synchronous API -------------------------------------------------
 

@@ -1,3 +1,6 @@
+from idc_models_tpu.serve.cluster.autoscaler import (  # noqa: F401
+    AutoscaleConfig, Autoscaler,
+)
 from idc_models_tpu.serve.cluster.registry import (  # noqa: F401
     PrefixRegistry,
 )

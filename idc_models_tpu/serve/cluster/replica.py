@@ -201,6 +201,10 @@ class Replica:
                 f"replica {self.replica_id} is dead — drain is for "
                 f"live replicas (failover migrates dead ones)")
         self.state = "draining"
+        # the scheduler's own drain mode sheds stragglers even on
+        # replicas built WITHOUT a brownout controller, and stays
+        # sticky (brownout hysteresis cannot un-drain it)
+        self.server.scheduler.begin_drain()
         if self.server.brownout is not None:
             self.server.brownout.force_stage(3, reason="drain")
 

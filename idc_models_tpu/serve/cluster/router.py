@@ -30,7 +30,18 @@ stack, realized on the repo's own control plane:
   work), bounded per request by the `RetryPolicy`'s max_retries.
 - **Drain**: `drain_replica` flips the replica to draining (placement
   stops; its brownout — when armed — jumps to the shed stage) while
-  its in-flight work steps to completion.
+  its in-flight work steps to completion — or, `migrate=True`, leaves
+  WITH it: queued work re-places onto the fleet and RUNNING slots
+  move live (mid-decode KV + sampling state export/import, output
+  bit-identical), the source journal staying open across the
+  export→import gap so a crash inside it replays the request.
+- **Elasticity** (`autoscaler=`): an `Autoscaler` reads the health
+  documents every step and the router applies its decisions — scale-
+  up builds a replica through `replica_factory` (warm spin-up when
+  the factory carries the fleet's `CompileCache`), scale-down drains
+  the least-loaded live replica with slot migration. When EVERY
+  decode-capable replica is draining or dead, `submit` answers with a
+  terminal shed instead of a retry-forever False.
 - **Failover**: a replica whose step raises (or is killed by the
   drill) is marked dead; terminal results its final tick salvaged are
   adopted, and everything its journal WAL shows accepted-but-
@@ -47,11 +58,31 @@ import dataclasses
 import os
 import time
 
+import numpy as np
+
 from idc_models_tpu.observe import metrics_registry as mreg
 from idc_models_tpu.observe import trace
 from idc_models_tpu.serve.api import Request, Result
 from idc_models_tpu.serve.journal import pending_requests
 from idc_models_tpu.serve.metrics import aggregate_summaries
+
+
+def _entry_request(entry) -> Request:
+    """Rebuild a `Request` from a scheduler entry — the drain path's
+    fallback for work this router never placed itself (a direct
+    replica submit) or can no longer seat live. Mirrors the journal's
+    submit record: id, prompt, budget, eos, integer seed, trace and
+    tenant identity (an explicit jax key is not re-placeable — same
+    documented limit as the WAL's)."""
+    seed = (int(entry.rng)
+            if isinstance(entry.rng, (int, np.integer)) else None)
+    return Request(
+        id=str(entry.rid),
+        prompt=tuple(int(t) for t in np.asarray(entry.prompt)
+                     .reshape(-1)),
+        max_new_tokens=int(entry.budget), eos_id=entry.eos_id,
+        seed=seed, trace_id=entry.trace_id,
+        tenant=getattr(entry, "tenant", None))
 
 
 class Router:
@@ -69,7 +100,8 @@ class Router:
     def __init__(self, replicas, *, retry=None, hedge_after_s=None,
                  prefix_registry=None, slo=None, logger=None,
                  registry=None, clock=time.monotonic,
-                 tenant_affinity_slack: int | None = 4):
+                 tenant_affinity_slack: int | None = 4,
+                 autoscaler=None, replica_factory=None):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("need at least one replica")
@@ -79,6 +111,12 @@ class Router:
         if hedge_after_s is not None and hedge_after_s <= 0:
             raise ValueError(f"need hedge_after_s > 0, got "
                              f"{hedge_after_s}")
+        if autoscaler is not None and replica_factory is None:
+            raise ValueError(
+                "an autoscaler needs a replica_factory: a scale-up "
+                "decision has to BUILD the replica it adds (a callable "
+                "replica_id -> Replica; serve/cluster/replica.py's "
+                "build_replica partial is the usual one)")
         # misconfigured disaggregation fails at FLEET BUILD, not on the
         # first caller's submit: a prefill replica is useless without
         # chunked prefill (boundary snapshots are the artifact) and
@@ -131,6 +169,15 @@ class Router:
         self._m_deaths = reg.counter(
             "cluster_replica_deaths_total",
             "replicas marked dead (step failure or kill drill)")
+        self._m_slot_migrations = reg.counter(
+            "cluster_slot_migrations_total",
+            "mid-decode slots exported off a draining replica and "
+            "imported live onto a peer (KV + sampling state move; "
+            "decode continues bit-identically)")
+        self._m_scale = reg.counter(
+            "cluster_scale_events_total",
+            "autoscaler decisions applied to the fleet",
+            labels=("action",))
         # tenant affinity (serve/tenancy.py, ISSUE 14): a tenant's
         # requests stick to the replica that last served them — its
         # prefix cache holds the tenant's system-prompt snapshots and
@@ -173,10 +220,26 @@ class Router:
         # migrated requests waiting for a survivor with room, in the
         # dead replica's original submit order
         self._pending_migration: list[Request] = []
+        # rid -> DRAINING source replica whose journal still holds the
+        # open submit: once the re-placement lands, the source writes
+        # the terminal "migrated" finish (a dead source — failover —
+        # never appears here; its journal is closed and the WAL itself
+        # is the recovery record)
+        self._migrating_from: dict = {}
         self.placements: dict[str, int] = {i: 0 for i in ids}
         self.migrations: list[dict] = []
         self.handoffs: list[dict] = []
+        # live mid-decode slot moves ({rid, from, to}), distinct from
+        # `migrations` (re-placements that re-run from the prompt)
+        self.slot_migrations: list[dict] = []
         self.hedges_sent = 0
+        # elasticity (serve/cluster/autoscaler.py): the autoscaler
+        # reads the health documents each step and the router applies
+        # its decisions — scale-up through replica_factory/add_replica,
+        # scale-down through drain_replica(migrate=True)
+        self.autoscaler = autoscaler
+        self.replica_factory = replica_factory
+        self._next_replica_ordinal = len(replicas)
         # cluster-wide sheds happen at the ROUTER (no replica ever
         # sees the request), so they must be counted here — replica
         # metrics cannot
@@ -263,6 +326,27 @@ class Router:
         if target is None:
             live = [r for r in self.replicas
                     if r.state == "live" and r.role != "prefill"]
+            if not live:
+                # every decode-capable replica is draining or dead:
+                # there is NOTHING for a re-offer loop to wait out, so
+                # spinning would hang the caller forever. The honest
+                # terminal answer is a shed — and because submit()
+                # admits ids whose prior result was a shed, the same
+                # id may resubmit once add_replica revives the fleet.
+                self._results[request.id] = Result(
+                    id=request.id, tokens=[], status="shed",
+                    finish_reason="shed",
+                    error="no live decode-capable replica "
+                          "(all draining or dead)",
+                    trace_id=request.trace_id)
+                self.cluster_sheds += 1
+                trace.point("cluster.shed", rid=request.id,
+                            reason="no_live_replica")
+                self._log(event="cluster_shed", id=request.id,
+                          reason="no_live_replica")
+                if self.slo is not None and self.slo.has("error_rate"):
+                    self.slo.record("error_rate", ok=False)
+                return False
             if live and all(r.server.brownout is not None
                             and r.server.brownout.shedding
                             for r in live):
@@ -361,6 +445,8 @@ class Router:
             self._maybe_hedge()
         if self.slo is not None:
             self.slo.evaluate()
+        if self.autoscaler is not None:
+            self._autoscale()
         return out
 
     def _record(self, replica, result: Result) -> list[Result]:
@@ -509,19 +595,215 @@ class Router:
             self._log(event="cluster_hedge", id=rid,
                       replica=target.replica_id)
 
+    # -- elasticity (serve/cluster/autoscaler.py) -------------------------
+
+    def add_replica(self, replica) -> None:
+        """Grow the fleet live — the autoscaler's scale-up path, and
+        the operator's drain-then-revive move. The replica joins
+        placement immediately: the very next submit/step can land on
+        it, and a fleet the honest-shed branch declared dead becomes
+        placeable again (shed ids may resubmit)."""
+        if replica.replica_id in self._by_id:
+            raise ValueError(
+                f"replica id {replica.replica_id!r} is already in "
+                f"the fleet")
+        self.replicas.append(replica)
+        self._by_id[replica.replica_id] = replica
+        self.placements.setdefault(replica.replica_id, 0)
+        self._g_live.set(sum(1 for r in self.replicas
+                             if r.state == "live"))
+        trace.point("cluster.scale_up", replica=replica.replica_id)
+        self._log(event="cluster_scale_up",
+                  replica=replica.replica_id,
+                  live=sum(1 for r in self.replicas
+                           if r.state == "live"))
+
+    def _next_auto_id(self) -> str:
+        while True:
+            rid = f"auto{self._next_replica_ordinal}"
+            self._next_replica_ordinal += 1
+            if rid not in self._by_id:
+                return rid
+
+    def _autoscale(self) -> None:
+        """Apply the autoscaler's decision for this tick: ``up`` spins
+        a replica through `replica_factory` (warm when the factory
+        hands the fleet's CompileCache to the server — spin-up is a
+        deserialize, not a compile) and adds it; ``down`` drains the
+        least-loaded live decode replica with live slot migration, so
+        shrinking never drops or re-runs in-flight work."""
+        decision = self.autoscaler.evaluate(self.healths(),
+                                            now=self.clock())
+        if decision is None:
+            return
+        action = decision["action"]
+        if action == "up":
+            rep = self.replica_factory(self._next_auto_id())
+            self.add_replica(rep)
+            self._m_scale.inc(action="up")
+        elif action == "down":
+            live = [r for r in self.replicas
+                    if r.state == "live" and r.role != "prefill"]
+            if len(live) <= 1:
+                return                  # never drain the last one
+            victim = min(live, key=lambda r: (r.load(),
+                                              self.replicas.index(r)))
+            self._m_scale.inc(action="down")
+            self.drain_replica(victim.replica_id, migrate=True)
+
     # -- drain / failover -------------------------------------------------
 
-    def drain_replica(self, replica_id: str, *,
-                      wait: bool = False) -> None:
-        """Graceful drain: placement stops immediately (and the
-        replica's brownout, when armed, jumps to shed); with
-        `wait=True` the fleet steps until the replica is idle."""
+    def drain_replica(self, replica_id: str, *, wait: bool = False,
+                      migrate: bool = False) -> list[str]:
+        """Graceful drain: placement stops immediately (the scheduler
+        enters its sticky drain mode and sheds stragglers; the
+        brownout, when armed, jumps to shed). With ``migrate=True``
+        the replica's unfinished work leaves with it — queued entries
+        re-enter the NORMAL placement path and RUNNING slots move
+        LIVE: mid-decode KV, position, rng chain, and budget exported
+        and imported into a peer's free slot, decode continuing there
+        bit-identically (the elastic scale-down path). With
+        `wait=True` the fleet steps until the replica is idle.
+        Returns the ids whose work moved."""
         rep = self._by_id[replica_id]
         rep.drain()
         trace.point("cluster.drain", replica=replica_id)
         self._log(event="cluster_drain", replica=replica_id)
+        moved = self._migrate_out(rep) if migrate else []
         while wait and not rep.idle():
             self.step()
+        return moved
+
+    def _migrate_out(self, rep) -> list[str]:
+        """Empty a draining replica onto the fleet. Queued (and still-
+        prefilling / retry-parked) entries are re-placed through
+        `_place_migrations` — original id, seed, relative deadline
+        preserved, the request re-runs from the prompt. Running slots
+        migrate live instead: `Scheduler.export_running` lifts the
+        slot's KV + sampling state, a compatible peer's
+        `import_running` seats it, and decode resumes mid-request with
+        bit-identical output (the engine's serial-parity contract).
+
+        Journal protocol across the export→import gap: the SOURCE
+        journal's submit stays open until the peer's import (which
+        journals a normal submit on the TARGET) has landed; only then
+        does the source write ``journal_migrate`` + the terminal
+        ``"migrated"`` finish. A crash anywhere inside the gap
+        therefore leaves the request pending in exactly one WAL — the
+        source's — and the normal failover replay re-runs it from the
+        prompt, bit-identically."""
+        sch = rep.server.scheduler
+        moved: list[str] = []
+        # 1. work that never reached a slot re-enters normal placement
+        for entry in sch.drain_pending():
+            rid = entry.rid
+            orig = self._hedges.pop(rid, None)
+            if orig is not None:
+                # a queued hedge copy: the original still runs on its
+                # own replica — drop the copy (and close its WAL entry
+                # so a later kill of THIS replica cannot resurrect it)
+                self._hedge_target.pop(rid, None)
+                self._hedged.discard(orig)
+                if sch.journal is not None:
+                    sch.journal.record_finish(rid, "shed",
+                                              reason="drain")
+                continue
+            req = self._requests.get(rid)
+            if req is None:
+                # never placed by this router (a direct replica
+                # submit): rebuild the Request from the entry so the
+                # drain still honors it
+                req = _entry_request(entry)
+            self._owner.pop(rid, None)
+            self._results.pop(rid, None)
+            self._pending_migration.append(req)
+            self._migrating_from[rid] = rep
+            moved.append(rid)
+        # 2. running slots move live. quiesce() first: it collects the
+        # in-flight decode window without dispatching another, which is
+        # the dispatch-idle point export_slot requires — and any
+        # request that window finished is adopted, not migrated.
+        running = list(sch.running_ids())
+        if running and rep.server.engine.supports_slot_migration:
+            for r in rep.server.quiesce():
+                self._out_of_band.extend(self._record(rep, r))
+            for rid in list(sch.running_ids()):
+                target = self._slot_target(rep, rid)
+                if target is not None:
+                    # the peer may hold its own in-flight dispatched
+                    # window — collect it (import needs the engine
+                    # dispatch-idle, same as export does)
+                    for r in target.server.quiesce():
+                        self._out_of_band.extend(
+                            self._record(target, r))
+                entry, snap = sch.export_running(rid)
+                seated = (target is not None
+                          and target.server.scheduler.import_running(
+                              entry, snap))
+                if not seated:
+                    # no compatible peer with a free slot right now:
+                    # fall back to a from-the-prompt re-placement (the
+                    # source submit is still open, so the journal
+                    # contract already covers this path)
+                    req = self._requests.get(rid)
+                    if req is None:
+                        req = _entry_request(entry)
+                    self._owner.pop(rid, None)
+                    self._results.pop(rid, None)
+                    self._pending_migration.append(req)
+                    self._migrating_from[rid] = rep
+                    moved.append(rid)
+                    continue
+                self._owner[rid] = target
+                # the import landed: close the gap on the source WAL
+                if sch.journal is not None:
+                    sch.journal.record_migrate(
+                        rid, "out", peer=target.replica_id)
+                    sch.journal.record_finish(rid, "migrated")
+                tj = target.server.scheduler.journal
+                if tj is not None:
+                    tj.record_migrate(rid, "in", peer=rep.replica_id)
+                self.slot_migrations.append(
+                    {"rid": rid, "from": rep.replica_id,
+                     "to": target.replica_id})
+                self._m_slot_migrations.inc()
+                trace.point("cluster.slot_migrate", rid=rid,
+                            src=rep.replica_id,
+                            dst=target.replica_id)
+                self._log(event="cluster_slot_migrate", id=rid,
+                          src=rep.replica_id,
+                          dst=target.replica_id)
+                moved.append(rid)
+        self._place_migrations()
+        return moved
+
+    def _slot_target(self, rep, rid) -> object | None:
+        """The peer a running slot can move into: live, decode-
+        capable, migration-capable, geometry-identical (head/block
+        layout and cache dtype — import_slot re-validates), not
+        draining, t_max at least the source's, and holding a free
+        slot. Least-loaded first, fleet order breaking ties — the same
+        determinism contract as placement."""
+        e1 = rep.server.engine
+        cands = []
+        for r in self.replicas:
+            if r is rep or r.state != "live" or r.role == "prefill":
+                continue
+            e2 = r.server.engine
+            if (not e2.supports_slot_migration
+                    or r.server.scheduler.draining
+                    or not e2.free_slots()
+                    or e2.t_max < e1.t_max
+                    or e2._cfg.embed_dim != e1._cfg.embed_dim
+                    or e2._cfg.num_heads != e1._cfg.num_heads
+                    or e2._cfg.num_blocks != e1._cfg.num_blocks
+                    or e2._cfg.cache_dtype != e1._cfg.cache_dtype):
+                continue
+            cands.append(r)
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.load(),
+                                         self.replicas.index(r)))
 
     def kill_replica(self, replica_id: str) -> list[str]:
         """The failover drill: hard-kill a replica (its journal WAL is
@@ -593,6 +875,16 @@ class Router:
             for req in pending_requests(replica.journal_path):
                 if req.id in dead_copies:
                     continue            # a hedge copy handled above
+                if self._owner.get(req.id) not in (None, replica):
+                    # a live mid-decode migration moved this slot onto
+                    # a survivor before the death closed the source
+                    # WAL: the open submit is stale — the survivor is
+                    # decoding it right now, and replaying here would
+                    # answer the id twice
+                    continue
+                if any(p.id == req.id
+                       for p in self._pending_migration):
+                    continue            # a drain already queued it
                 orig = self._hedges.get(req.id, req.id)
                 if orig in self._results:
                     continue            # already answered (hedge won,
@@ -659,6 +951,17 @@ class Router:
             if target is None or not self._submit_to(target, req):
                 return
             self._pending_migration.pop(0)
+            src = self._migrating_from.pop(req.id, None)
+            if src is not None:
+                # the re-placement landed and the TARGET journaled its
+                # own submit — only now does the still-open source WAL
+                # close with the terminal migrated finish (a crash any
+                # earlier replays the request from the source)
+                sj = src.server.scheduler.journal
+                if sj is not None and src.state != "dead":
+                    sj.record_migrate(req.id, "out",
+                                      peer=target.replica_id)
+                    sj.record_finish(req.id, "migrated")
             self.migrations.append({"rid": req.id,
                                     "replica": target.replica_id,
                                     "trace_id": req.trace_id})
@@ -827,6 +1130,7 @@ class Router:
                                          if r.state == "dead"),
             "cluster_placements": dict(self.placements),
             "cluster_migrations": len(self.migrations),
+            "cluster_slot_migrations": len(self.slot_migrations),
             "cluster_handoffs": len(self.handoffs),
             "cluster_hedges": self.hedges_sent,
         })

@@ -108,6 +108,49 @@ def _key_data(rng) -> np.ndarray:
 _key_data._checked = False
 
 
+class _AotWindow:
+    """AOT decode-window executable plus the static step count it was
+    compiled at. The jit dispatch site passes the step count as the
+    last positional argument (a `static_argnums` entry); a Compiled
+    executable takes only the array arguments, so this shim drops it —
+    after checking it MATCHES. A different window size is a different
+    program: it falls through to the jitted function (which compiles
+    it), exactly what a compile-cache miss means."""
+
+    def __init__(self, exe, n_steps: int, base):
+        self._exe = exe
+        self._n = int(n_steps)
+        self._base = base
+
+    def __call__(self, *args):
+        if int(args[-1]) != self._n:
+            return self._base(*args)
+        return self._exe(*args[:-1])
+
+
+class _AotPrograms:
+    """Per-engine dispatch-table proxy installed by a cache-backed
+    warmup: program names the persistent compile cache covered resolve
+    to AOT executables; everything else falls through to the shared
+    jitted namespace. A proxy — never a mutation — because the
+    underlying `_engine_fns`/`_serving_fns` namespaces are
+    `lru_cache`-shared across every engine with the same config
+    (canary clones, cluster replicas on one device): planting one
+    engine's device-bound executables there would corrupt its
+    siblings. Introspection (`cache_sizes`, `program_costs`) reaches
+    the jitted originals through `_base`."""
+
+    def __init__(self, base, overlay: dict):
+        self._base = base
+        self._overlay = dict(overlay)
+
+    def __getattr__(self, name):
+        ov = self.__dict__["_overlay"].get(name)
+        if ov is not None:
+            return ov
+        return getattr(self.__dict__["_base"], name)
+
+
 class _PendingPrefill:
     """Host-side record of one chunked prefill in flight: the prompt,
     the single-request caches being extended chunk by chunk, and where
@@ -1090,6 +1133,143 @@ class SlotEngine:
             self._alloc.release(self._slot_pages.pop(slot, []))
             self._alloc_tokens[slot] = 0
 
+    # -- mid-decode slot migration (elastic drain, ROADMAP 3) -----------
+
+    @property
+    def supports_slot_migration(self) -> bool:
+        """True when a RUNNING slot's state can travel to a peer engine
+        bit-exactly: contiguous float-KV rows only. Paged engines have
+        no slot-granular KV export (pages belong to one shared pool and
+        land through grant-time scatter, not a row insert), and int8
+        rows would pass back through the insert path's quantization —
+        neither can honor the bit-identity contract, so a drain on them
+        finishes requests in place instead of migrating."""
+        return not self.paged and not self.kv_int8
+
+    def export_slot(self, slot: int) -> dict:
+        """Snapshot a RUNNING slot as host numpy — the prefix
+        registry's packed-KV handoff generalized past chunk boundaries
+        to mid-decode: per-block K/V rows truncated to the slot's
+        position, the last-token logits row, and the slot's raw rng KEY
+        DATA mid-chain. The key data — not a seed — is the point: a
+        seeded stream must resume exactly where the source's per-token
+        splits left it for the migrated output to stay bit-identical to
+        an unmigrated run (greedy consumes no randomness either way).
+        A peer engine's `import_slot` resumes the request; the caller
+        (scheduler/router) owns releasing this slot and the journal
+        protocol around the gap.
+
+        Needs the engine dispatch-idle (`Scheduler.quiesce()` is the
+        safe point): after `begin_window` the host shadows lag the
+        donated device state by one window, and a snapshot taken in
+        that gap would pair post-window caches with pre-window
+        positions."""
+        if not self.supports_slot_migration:
+            raise RuntimeError(
+                "slot export needs a contiguous float-KV engine: paged "
+                "pools have no slot-granular export program and int8 "
+                "rows would re-quantize on import, breaking the "
+                "bit-identity contract — drain this replica to "
+                "completion instead of migrating")
+        if self._pending is not None:
+            raise RuntimeError(
+                "export_slot with a window in flight would snapshot "
+                "post-window caches against pre-window host shadows — "
+                "quiesce() the scheduler first")
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.n_slots})")
+        if not self._occupied[slot]:
+            raise ValueError(f"slot {slot} is not occupied — only a "
+                             f"running request has state to export")
+        p = int(self._pos_h[slot])
+        head_dim = self._cfg.embed_dim // self._cfg.num_heads
+        return {
+            "pos": p,
+            "rem": int(self._rem_h[slot]),
+            "eos": int(self._eos_h[slot]),
+            "num_heads": self._cfg.num_heads,
+            "head_dim": head_dim,
+            "kd": np.asarray(self._kd[slot]).astype(np.uint32),
+            "logits": np.asarray(self._logits[slot]),
+            # truncated to the written positions (the packer idiom):
+            # everything past `pos` in the source row is zeros the
+            # import's pad re-creates, and masked out regardless
+            "caches": tuple((np.asarray(kc[slot:slot + 1, :p]),
+                             np.asarray(vc[slot:slot + 1, :p]))
+                            for kc, vc in self._caches),
+        }
+
+    def import_slot(self, slot: int, snap: dict, *, tid: int = 0) -> None:
+        """Adopt an exported slot snapshot into free `slot` through the
+        NORMAL admission insert: the K/V rows pad back to `[1, t_max]`
+        (`jnp.pad`, zeros past the position — exactly the layout the
+        source row held) and land under this engine's ring sharding, so
+        the executable is the one admission already compiled — zero new
+        programs — and the resumed decode is bit-identical to never
+        having moved (gated by test). The snapshot's position stands in
+        for a fresh prefill's prompt length and its remaining budget
+        for max_new_tokens; the raw key data resumes the rng chain
+        mid-stream."""
+        if not self.supports_slot_migration:
+            raise RuntimeError(
+                "slot import needs a contiguous float-KV engine (same "
+                "restriction as export_slot) — this replica cannot "
+                "adopt migrated slots")
+        if self._pending is not None:
+            raise RuntimeError(
+                "import_slot with a window in flight — the caches were "
+                "donated to the dispatch; quiesce()/collect first")
+        if self._occupied[slot] or slot in self._prefills:
+            raise ValueError(f"slot {slot} is not free")
+        pos, rem, eos = int(snap["pos"]), int(snap["rem"]), int(snap["eos"])
+        if rem < 1:
+            raise ValueError(
+                "snapshot has no remaining budget — the request already "
+                "finished; deliver its Result instead of migrating it")
+        if pos < 1 or pos + rem > self.t_max:
+            raise ValueError(
+                f"snapshot position {pos} + remaining budget {rem} does "
+                f"not fit this engine's t_max {self.t_max} — migrate to "
+                f"a replica with a cache at least as long as the source")
+        head_dim = self._cfg.embed_dim // self._cfg.num_heads
+        if (len(snap["caches"]) != self._cfg.num_blocks
+                or snap["num_heads"] != self._cfg.num_heads
+                or snap["head_dim"] != head_dim):
+            raise ValueError(
+                f"snapshot geometry (blocks={len(snap['caches'])}, "
+                f"heads={snap['num_heads']}, head_dim="
+                f"{snap['head_dim']}) does not match this engine "
+                f"(blocks={self._cfg.num_blocks}, "
+                f"heads={self._cfg.num_heads}, head_dim={head_dim}) — "
+                f"slots only migrate between config-identical replicas")
+        self._check_tid(tid)
+        from idc_models_tpu.ring_decode import cache_sharding
+        sh = cache_sharding(self._cfg.mesh)
+
+        def _grow(a):
+            a = jnp.pad(jnp.asarray(np.asarray(a), self._cfg.cache_dtype),
+                        ((0, 0), (0, self.t_max - a.shape[1]),
+                         (0, 0), (0, 0)))
+            return meshlib.put_with_sharding(a, sh)
+
+        caches1 = tuple((_grow(kc), _grow(vc))
+                        for kc, vc in snap["caches"])
+        logits1 = meshlib.put_with_sharding(
+            np.asarray(snap["logits"])[None],
+            meshlib.replicated(self._cfg.mesh))
+        kd_row = np.asarray(snap["kd"], np.uint32).reshape(2)
+        (self._caches, self._logits, self._kd, self._pos, self._rem,
+         self._eos, self._tslot, self._scales) = self._efns.insert(
+            self._caches, self._logits, self._kd, self._pos,
+            self._rem, self._eos, self._tslot, self._scales,
+            caches1, logits1, np.int32(slot), np.int32(pos),
+            np.int32(rem), np.int32(eos), np.int32(tid), kd_row)
+        self._pos_h[slot] = pos
+        self._rem_h[slot] = rem
+        self._eos_h[slot] = eos
+        self._occupied[slot] = True
+
     def _validate_admit(self, slot, prompt, max_new_tokens, rng):
         """The one admission contract, shared by the monolithic and
         chunked paths: [1, P] int32 prompt, within-budget lengths, an
@@ -1822,28 +2002,43 @@ class SlotEngine:
 
     # -- observability --------------------------------------------------
 
+    @property
+    def _efns_jit(self):
+        """The shared jitted engine namespace, through any AOT overlay
+        — introspection (`_cache_size`, `.lower`) lives on the jitted
+        functions, not on deserialized executables."""
+        return getattr(self._efns, "_base", self._efns)
+
+    @property
+    def _sfns_jit(self):
+        return getattr(self._sfns, "_base", self._sfns)
+
     def cache_sizes(self) -> dict:
         """Jit-cache entry counts for the no-recompile contract: after
         warmup, admitting requests of ANY prompt length/budget into any
-        slot must not grow these (gated by test)."""
-        out = {"window": self._efns.window._cache_size(),
-               "insert": self._efns.insert._cache_size(),
-               "health": self._efns.health._cache_size()}
+        slot must not grow these (gated by test). With an AOT compile
+        cache armed the overlaid programs never enter the jit cache at
+        all — their counts stay 0 and the no-growth contract holds
+        trivially."""
+        efns, sfns = self._efns_jit, self._sfns_jit
+        out = {"window": efns.window._cache_size(),
+               "insert": efns.insert._cache_size(),
+               "health": efns.health._cache_size()}
         if self.paged:
             # the paged admission path: direct-to-pool chunks + the
             # grant-path programs (no bucketed monolithic prefill)
-            out["prefill_chunk"] = self._efns.prefill_chunk._cache_size()
-            out["page_row"] = self._efns.page_row._cache_size()
+            out["prefill_chunk"] = efns.prefill_chunk._cache_size()
+            out["page_row"] = efns.page_row._cache_size()
             if self.kv_int8:
                 out["stamp_scales"] = (
-                    self._efns.stamp_scales._cache_size())
+                    efns.stamp_scales._cache_size())
         else:
-            out["prefill"] = self._sfns.prefill._cache_size()
+            out["prefill"] = sfns.prefill._cache_size()
             if self.prefill_chunk is not None:
                 out["prefill_chunk"] = (
-                    self._sfns.prefill_chunk._cache_size())
+                    sfns.prefill_chunk._cache_size())
         if self.draft_k is not None:
-            out["verify"] = self._efns.verify._cache_size()
+            out["verify"] = efns.verify._cache_size()
         return out
 
     def program_costs(self, window: int) -> dict:
@@ -1865,14 +2060,14 @@ class SlotEngine:
                 # cost NEXT TO the contiguous serve.window figure
                 out["serve.window_paged"] = prof.register_program(
                     "serve.window_paged",
-                    self._efns.window.lower(
+                    self._efns_jit.window.lower(
                         self._params, self._caches, self._pt,
                         self._logits, self._kd, self._pos, self._rem,
                         self._eos, self._scales, self._adapters,
                         self._tslot, window).compile())
                 out["serve.insert_paged"] = prof.register_program(
                     "serve.insert_paged",
-                    self._efns.insert.lower(
+                    self._efns_jit.insert.lower(
                         self._logits, self._kd, self._pos, self._rem,
                         self._eos, self._tslot,
                         jnp.zeros((1, self._logits.shape[1]),
@@ -1883,7 +2078,7 @@ class SlotEngine:
                 c = self.prefill_chunk
                 out["serve.prefill_chunk_paged"] = prof.register_program(
                     "serve.prefill_chunk_paged",
-                    self._efns.prefill_chunk.lower(
+                    self._efns_jit.prefill_chunk.lower(
                         self._params, self._caches, self._pt,
                         self._scales, np.int32(0),
                         np.zeros((1, c), np.int32), np.int32(0),
@@ -1891,7 +2086,7 @@ class SlotEngine:
                 if self.draft_k is not None:
                     out["lm.verify"] = prof.register_program(
                         "lm.verify",
-                        self._efns.verify.lower(
+                        self._efns_jit.verify.lower(
                             self._params, self._caches, self._pt,
                             self._logits, self._kd, self._pos,
                             self._rem, self._eos, self._scales,
@@ -1902,7 +2097,7 @@ class SlotEngine:
                 return out
             out["serve.window"] = prof.register_program(
                 "serve.window",
-                self._efns.window.lower(
+                self._efns_jit.window.lower(
                     self._params, self._caches, self._logits, self._kd,
                     self._pos, self._rem, self._eos, self._scales,
                     self._adapters, self._tslot, window).compile())
@@ -1911,14 +2106,14 @@ class SlotEngine:
                 caches1 = self._sfns.init_caches(1)
                 out["serve.prefill_chunk"] = prof.register_program(
                     "serve.prefill_chunk",
-                    self._sfns.prefill_chunk.lower(
+                    self._sfns_jit.prefill_chunk.lower(
                         self._params, caches1,
                         np.zeros((1, c), np.int32), np.int32(0),
                         np.int32(c)).compile())
             else:
                 out["serve.prefill"] = prof.register_program(
                     "serve.prefill",
-                    self._sfns.prefill.lower(
+                    self._sfns_jit.prefill.lower(
                         self._params,
                         np.zeros((1, self.t_max), np.int32),
                         np.int32(self.t_max)).compile())
@@ -1929,7 +2124,7 @@ class SlotEngine:
                 # the profile verb's roofline verdicts cover it
                 out["lm.verify"] = prof.register_program(
                     "lm.verify",
-                    self._efns.verify.lower(
+                    self._efns_jit.verify.lower(
                         self._params, self._caches, self._logits,
                         self._kd, self._pos, self._rem, self._eos,
                         self._scales, self._adapters, self._tslot,
@@ -1938,7 +2133,144 @@ class SlotEngine:
                         np.zeros(self.n_slots, bool)).compile())
         return out
 
-    def warmup(self, n_steps: int) -> None:
+    def cache_fingerprint(self) -> dict:
+        """The identity an AOT-serialized executable is valid for: the
+        full compiled-program config (every `_ServeConfig` field plus
+        the engine knobs that reach tracing) AND the mesh's device
+        assignment — a serialized executable replays onto the exact
+        devices it was compiled against, so a different device set must
+        read as a cache MISS, never a mis-placed load. compile_cache.py
+        layers program name + jax/jaxlib/backend versions on top."""
+        mesh = self._cfg.mesh
+        return {
+            "embed_dim": self._cfg.embed_dim,
+            "num_heads": self._cfg.num_heads,
+            "num_blocks": self._cfg.num_blocks,
+            "t_max": self.t_max,
+            "n_slots": self.n_slots,
+            "vocab": int(self._logits.shape[1]),
+            "cache_dtype": str(jnp.dtype(self._cfg.cache_dtype)),
+            "logits_dtype": str(self._logits.dtype),
+            "block_impl": self._cfg.block_impl,
+            "temperature": self._cfg.temperature,
+            "top_k": self._cfg.top_k,
+            "pad_id": self.pad_id,
+            "kv_int8": self.kv_int8,
+            "draft_k": self.draft_k,
+            "prefill_chunk": self.prefill_chunk,
+            "kv_page_size": self.kv_page_size,
+            "kv_pages": self.kv_pages,
+            "n_tenants": self.n_tenants,
+            "adapter_rank": (int(self._adapters[0].shape[2])
+                             if self._adapters else 0),
+            "partition_rules": repr(self._partition_rules),
+            "mesh_axes": {str(k): int(v)
+                          for k, v in self._cfg.mesh.shape.items()},
+            "devices": [f"{d.platform}:{d.id}"
+                        for d in mesh.devices.flat],
+        }
+
+    def _warm_aot(self, n_steps: int, cache) -> None:
+        """Load-or-compile the serve loop's fixed-shape programs
+        through a persistent `CompileCache` and install them as this
+        engine's dispatch table (`_AotPrograms`). Warm replica spin-up:
+        a fresh process deserializes executables instead of re-running
+        XLA. Cold path honesty: a miss compiles AOT via
+        `.lower().compile()` — the same route a hit replays — and
+        stores the result, so cold-vs-warm comparisons measure the
+        cache, not the in-process jit memo. Compiles that do happen
+        here are attributed to ``replica.spinup`` in the compile
+        watchdog.
+
+        Covered programs: the masked window at `n_steps`, the
+        admission insert, and the prefill chunk (when chunked) — the
+        fixed-shape programs that dominate spin-up. Monolithic bucketed
+        prefill shapes and the speculative verify still jit-compile in
+        the warmup dispatches below."""
+        from idc_models_tpu.observe import profile as prof
+
+        fp = self.cache_fingerprint()
+        fp["window_steps"] = int(n_steps)
+        efns, sfns = self._efns_jit, self._sfns_jit
+        vocab = int(self._logits.shape[1])
+        logits1 = jnp.zeros((1, vocab), self._logits.dtype)
+        kd0 = np.zeros(2, np.uint32)
+
+        def undonated(jitted, static_argnums=()):
+            # The cached executables must NOT donate: on jaxlib's CPU
+            # backend, chaining deserialized executables whose donated
+            # outputs feed the next dispatch's donated inputs (the
+            # chunk->chunk->insert->window steady state) intermittently
+            # frees live buffers — glibc heap aborts and, worse,
+            # silently wrong tokens. The donation metadata itself
+            # round-trips (a single deserialized donating program is
+            # fine); only the chained replay is unsound. So the cache
+            # stores donation-free twins of the jitted bodies — an
+            # extra buffer copy per dispatch on the AOT path, bounded
+            # by the engine state size, in exchange for executables
+            # that are safe to replay from any process. The in-process
+            # jit path (no cache, or a window-size fallthrough) keeps
+            # donation.
+            return jax.jit(jitted.__wrapped__,
+                           static_argnums=static_argnums)
+
+        plans = []
+        if self.paged:
+            c = self.prefill_chunk
+            w_nd = undonated(efns.window, (11,))
+            i_nd = undonated(efns.insert)
+            p_nd = undonated(efns.prefill_chunk)
+            plans = [
+                ("window", "e", lambda: w_nd.lower(
+                    self._params, self._caches, self._pt, self._logits,
+                    self._kd, self._pos, self._rem, self._eos,
+                    self._scales, self._adapters, self._tslot, n_steps)),
+                ("insert", "e", lambda: i_nd.lower(
+                    self._logits, self._kd, self._pos, self._rem,
+                    self._eos, self._tslot, logits1, np.int32(0),
+                    np.int32(1), np.int32(1), np.int32(-1), np.int32(0),
+                    kd0)),
+                ("prefill_chunk", "e", lambda: p_nd.lower(
+                    self._params, self._caches, self._pt, self._scales,
+                    np.int32(0), np.zeros((1, c), np.int32),
+                    np.int32(0), np.int32(0))),
+            ]
+        else:
+            w_nd = undonated(efns.window, (10,))
+            plans = [("window", "e", lambda: w_nd.lower(
+                self._params, self._caches, self._logits, self._kd,
+                self._pos, self._rem, self._eos, self._scales,
+                self._adapters, self._tslot, n_steps))]
+            if self.prefill_chunk is not None:
+                c = self.prefill_chunk
+                caches1 = sfns.init_caches(1)
+                p_nd = undonated(sfns.prefill_chunk)
+                i_nd = undonated(efns.insert)
+                plans.append(
+                    ("prefill_chunk", "s", lambda: p_nd.lower(
+                        self._params, caches1, np.zeros((1, c), np.int32),
+                        np.int32(0), np.int32(c))))
+                plans.append(("insert", "e", lambda: i_nd.lower(
+                    self._caches, self._logits, self._kd, self._pos,
+                    self._rem, self._eos, self._tslot, self._scales,
+                    caches1, logits1, np.int32(0), np.int32(1),
+                    np.int32(1), np.int32(-1), np.int32(0), kd0)))
+        overlay_e, overlay_s = {}, {}
+        with prof.naming_compiles("replica.spinup"):
+            for name, ns, lower in plans:
+                key = cache.key(program=name, fingerprint=fp)
+                exe = cache.load(key)
+                if exe is None:
+                    exe = cache.compile_and_store(key, lower())
+                if name == "window":
+                    exe = _AotWindow(exe, n_steps, efns.window)
+                (overlay_e if ns == "e" else overlay_s)[name] = exe
+        if overlay_e:
+            self._efns = _AotPrograms(efns, overlay_e)
+        if overlay_s:
+            self._sfns = _AotPrograms(sfns, overlay_s)
+
+    def warmup(self, n_steps: int, compile_cache=None) -> None:
         """Compile every program the serve loop will touch — so
         admission traffic after this triggers ZERO XLA compilations:
         the prefill shapes the admission path uses (every bucket length
@@ -1946,7 +2278,14 @@ class SlotEngine:
         chunk-from-fresh and chunk-from-chunk chains), the insert, and
         the masked window at `n_steps`. Runs on the real (empty) engine
         state with a ZERO budget, so every row stays dead and the
-        warmup dispatches are bit-level no-ops."""
+        warmup dispatches are bit-level no-ops.
+
+        With `compile_cache` (serve/compile_cache.py) the fixed-shape
+        programs AOT-load from disk first (`_warm_aot`) and the warmup
+        dispatches below run through the loaded executables — a warm
+        process skips their XLA compiles entirely."""
+        if compile_cache is not None:
+            self._warm_aot(n_steps, compile_cache)
         if self.paged:
             # two chunk steps against the live pool with an
             # all-unallocated page table and p_end == start == 0:

@@ -26,6 +26,11 @@ file, never mixed into serve.jsonl):
   per cycle keeps the armed clean path inside the <2% overhead bar);
 - ``journal_finish``   at any terminal state, with the status.
 
+A fourth, ``journal_migrate``, marks mid-decode slot migrations
+(cluster drain, PR 18) — pure observability; the crash coverage of the
+export→import gap rides entirely on the submit/finish pair (the source
+finish, status ``"migrated"``, lands only after the peer's import).
+
 Recovery = `pending_requests(path)`: every journaled submit without a
 finish, in submit order. `LMServer.resubmit_pending` feeds them through
 the normal admission path (chunked prefill + radix prefix cache
@@ -102,6 +107,22 @@ class RequestJournal:
                       reason: str | None = None) -> None:
         self._logger.log(event="journal_finish", id=rid, status=status,
                          reason=reason)
+
+    def record_migrate(self, rid, direction: str, *, peer: str) -> None:
+        """One mid-decode migration boundary (serve/cluster drain):
+        ``direction`` is ``"out"`` (this replica exported the slot) or
+        ``"in"`` (this replica imported it); ``peer`` names the other
+        replica. Observability only — recovery semantics ride on the
+        submit/finish pair: the SOURCE journal's submit stays open until
+        the peer's import lands (a crash inside the export→import gap
+        replays the request here, bit-identically by the serial-parity
+        contract), and only then does the source write the terminal
+        ``journal_finish`` with status ``"migrated"``."""
+        if direction not in ("out", "in"):
+            raise ValueError(f"migration direction must be 'out' or "
+                             f"'in', got {direction!r}")
+        self._logger.log(event="journal_migrate", id=rid,
+                         direction=direction, peer=peer)
 
     def close(self) -> None:
         self._logger.close()

@@ -197,6 +197,13 @@ class ServingMetrics:
         self.tenant_quota_rejections: dict[str, int] = {}
         self._jit_cache_seen: int | None = None
         self.compiles_observed = 0
+        # compile-cache rollup (serve/compile_cache.py): gauges are
+        # registered lazily by on_compile_cache, so a cache-less
+        # server's /metrics exposition stays byte-identical (the
+        # equality gates)
+        self._reg = reg
+        self.compile_cache_summary: dict | None = None
+        self._g_cc: dict | None = None
         # rollout rollup: stage trail + terminal outcomes
         self.rollout_stage: str | None = None
         self.rollout_outcomes: list[str] = []
@@ -526,6 +533,35 @@ class ServingMetrics:
                 self.compiles_observed += delta
         self._jit_cache_seen = total_entries
 
+    def on_compile_cache(self, cache) -> None:
+        """Snapshot a `CompileCache`'s counters after warmup: first
+        call registers the serve_compile_cache_* gauges (lazily — see
+        `_g_cc`), every call re-reads `cache.summary()` into them and
+        the rollup, so warm-vs-cold spin-up is visible in the `stats`
+        epilogue, not just in bench_serving_elastic."""
+        if self._g_cc is None:
+            reg = self._reg
+            self._g_cc = {
+                "hits": reg.gauge(
+                    "serve_compile_cache_hits",
+                    "persistent compile-cache hits (executables "
+                    "deserialized from disk instead of compiled)"),
+                "misses": reg.gauge(
+                    "serve_compile_cache_misses",
+                    "persistent compile-cache misses (programs XLA-"
+                    "compiled and stored; includes corrupt evictions)"),
+                "deserialize_s": reg.gauge(
+                    "serve_compile_cache_deserialize_seconds",
+                    "cumulative seconds spent deserializing cached "
+                    "executables (the warm spin-up cost)"),
+            }
+        s = cache.summary()
+        self.compile_cache_summary = s
+        self._g_cc["hits"].set(s["hits"])
+        self._g_cc["misses"].set(s["misses"])
+        self._g_cc["deserialize_s"].set(s["deserialize_s"])
+        self._log(event="serve_compile_cache", **s)
+
     # -- rollup -----------------------------------------------------------
 
     def summary(self) -> dict:
@@ -660,6 +696,11 @@ class ServingMetrics:
                     "slo_breached": self.tenancy.breached(name),
                 }
                 for name in self.tenancy.names()}
+        if self.compile_cache_summary is not None:
+            # additive key (PR 18): the persistent compile-cache
+            # rollup of THIS server's warmup — absent on servers that
+            # spun up without one
+            out["serve_compile_cache"] = dict(self.compile_cache_summary)
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.summary())
         return out

@@ -251,6 +251,10 @@ class Scheduler:
         self._retrying: list[Entry] = []
         self._cycle = 0
         self._closed = False
+        # drain mode (elastic scale-down / SIGTERM): submits refuse
+        # with the honest terminal shed status while accepted work
+        # finishes or migrates — see begin_drain()
+        self._draining = False
         self._prefill_error_pending = 0
         # paged-KV backpressure: set when admission stalls on page
         # exhaustion this cycle, consumed (and cleared) by the
@@ -336,7 +340,10 @@ class Scheduler:
         # relieves the queue instead of racing it. The TENANT's own
         # controller sheds first: one tenant's flood refuses that
         # tenant's submits while every other tenant stays normal.
-        shedding = self.brownout is not None and self.brownout.shedding
+        # drain mode sheds exactly like a brownout: an honest terminal
+        # refusal, never a silent queue into a replica that is leaving
+        shedding = self._draining or (self.brownout is not None
+                                      and self.brownout.shedding)
         tenant_shed = tenant_bc is not None and tenant_bc.shedding
         if shedding or tenant_shed:
             entry.status, entry.finish_reason = "shed", "shed"
@@ -1096,6 +1103,112 @@ class Scheduler:
         while not self.idle():
             done.extend(self.tick())
         return done
+
+    # -- drain-and-migrate (elastic scale-down / SIGTERM) ----------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Enter drain mode: every later submit refuses with the honest
+        terminal ``shed`` status (stop admitting NEW work) while
+        everything already accepted keeps ticking to completion —
+        unless the caller moves it off first (`drain_pending` for
+        queued work, `export_running` for mid-decode slots). Sticky for
+        the scheduler's life: a draining replica never re-opens (the
+        cluster's live→draining→dead state machine is forward-only)."""
+        self._draining = True
+
+    def running_ids(self) -> list[str]:
+        """Request ids currently DECODING in a slot (not queued, not
+        prefilling) — the candidates for mid-decode migration."""
+        return [e.rid for e in self._running.values()]
+
+    def export_running(self, rid: str):
+        """Detach one RUNNING request for mid-decode migration:
+        returns ``(entry, snapshot)`` — the live Entry itself (its
+        emitted tokens, timestamps, spans and identity travel with it)
+        plus the engine slot's packed device snapshot
+        (`SlotEngine.export_slot`). The slot is released WITHOUT
+        finishing the entry: no Result is produced and the journal
+        deliberately records NOTHING here — the source journal's
+        still-open submit covers the export→import gap, so a crash
+        inside it replays the request from this WAL, bit-identically by
+        the serial-parity contract. The caller (the cluster router)
+        writes the terminal ``migrated`` finish only after the peer's
+        import lands. Needs the engine dispatch-idle — `quiesce()`
+        first."""
+        for slot, e in self._running.items():
+            if e.rid == rid:
+                break
+        else:
+            raise ValueError(f"request {rid!r} is not running here — "
+                             f"only decoding slots export "
+                             f"(running_ids() lists them)")
+        snap = self.engine.export_slot(slot)
+        del self._running[slot]
+        self.engine.release(slot)
+        e.slot = None
+        return e, snap
+
+    def import_running(self, entry: "Entry", snap: dict) -> bool:
+        """The peer half of a mid-decode migration: claim a free slot,
+        re-insert the exported snapshot (`SlotEngine.import_slot`), and
+        adopt the Entry as running — its decode resumes on this
+        replica's next window, bit-identical to never having moved.
+        Returns False (and consumes nothing) when this scheduler cannot
+        take it right now (closed, itself draining, or no free slot) —
+        the router keeps the snapshot and the source request intact.
+        On success the adopted request is journaled as a NORMAL submit
+        here, so a crash after this point recovers it from THIS
+        replica's WAL."""
+        if self._closed or self._draining:
+            return False
+        free = self.engine.free_slots()
+        if not free:
+            return False
+        slot = free[0]
+        self.engine.import_slot(slot, snap, tid=entry.tid)
+        entry.slot = slot
+        entry.status = "running"
+        self._running[slot] = entry
+        if self.journal is not None:
+            deadline_rel = (None if entry.deadline is None else
+                            max(entry.deadline - self.clock(), 0.0))
+            self.journal.record_submit(entry, deadline_s=deadline_rel)
+        return True
+
+    def drain_pending(self) -> list[Entry]:
+        """Pop everything accepted but NOT yet decoding — queued
+        entries, retry-backoff waiters, and chunked prefills in
+        progress (their partial chunks are discarded: re-prefilling on
+        a peer re-derives the exact same stream, so restarting from the
+        prompt is the bit-identical move) — for the router to re-place
+        on surviving replicas. Each entry resets to pending with no
+        slot and its lifecycle spans closed here (re-placement opens a
+        fresh chain under the peer's scheduler). Running slots are
+        `export_running`'s job."""
+        out: list[Entry] = []
+        while len(self.queue):
+            out.append(self.queue.pop())
+        out.extend(self._retrying)
+        self._retrying = []
+        for slot, e in list(self._prefilling.items()):
+            self.engine.cancel_prefill(slot)
+            del self._prefilling[slot]
+            out.append(e)
+        for e in out:
+            e.status, e.slot = "pending", None
+            e.tokens = []
+            e.t_first = None
+            if e.queue_span is not None:
+                e.queue_span.close(migrated=True)
+                e.queue_span = None
+            if e.span is not None:
+                e.span.close(status="migrated", reason="drain")
+                e.span = None
+        return out
 
     def pop_failed(self) -> list[Entry]:
         """Entries finalized by a tick that raised, since the last call

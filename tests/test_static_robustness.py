@@ -324,12 +324,14 @@ def test_allowlist_entries_still_exist():
 # re-raises). An except block in serve/ that does neither — catches,
 # logs-or-not, and falls through — is a request silently lost, the
 # exact bug class the quarantine machinery exists to kill. This scan
-# walks every handler in serve/ — serve/cluster/ included (ISSUE 12):
-# the router's handlers must route through ITS recovery entry point,
-# `_fail_replica` (mark the replica dead + migrate its journal), the
-# cluster-scope analogue of the scheduler's quarantine — and requires
-# a `raise` or a call to one of the recovery entry points in the
-# handler body, outside the documented allowlist.
+# walks every handler in serve/ — serve/cluster/ included (ISSUE 12),
+# which now also covers the elastic layer's autoscaler and the
+# persistent compile cache (ISSUE 18): the router's handlers must
+# route through ITS recovery entry point, `_fail_replica` (mark the
+# replica dead + migrate its journal), the cluster-scope analogue of
+# the scheduler's quarantine — and requires a `raise` or a call to one
+# of the recovery entry points in the handler body, outside the
+# documented allowlist.
 
 _SERVE_RECOVERY_CALLS = {"_quarantine", "_abort_running",
                          "_fail_replica"}
@@ -346,6 +348,13 @@ SERVE_EXCEPT_ALLOWLIST = {
         "server can never serve (decommissioned tenant, shrunken "
         "t_max) is warned about and LEFT IN THE WAL for a rerun — "
         "aborting would block every other tenant's recovery",
+    ("compile_cache.py", "load"):
+        "the cache's best-effort contract (ISSUE 18): a blob that "
+        "exists but cannot deserialize (torn write that survived a "
+        "crash, foreign-toolchain collision) is EVICTED, counted as "
+        "evicted_corrupt, logged, and reported as a miss — spin-up "
+        "must fall back to a real compile, never die on a bad cache "
+        "entry; tests/test_elastic.py pins the evict-as-miss path",
 }
 
 
