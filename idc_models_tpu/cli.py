@@ -25,6 +25,27 @@ from pathlib import Path
 
 import numpy as np
 
+# `serve --drafter` registry: choice name -> (module, class, story).
+# Every class listed here MUST implement the models/draft.py contract
+# (`propose(history) -> [k] int32 | None`) — a static scan
+# (tests/test_static_robustness.py) imports each entry and asserts it,
+# and asserts the argparse choices stay in lockstep with this table,
+# so a drafter added to one place but not the other fails loudly.
+SERVE_DRAFTERS = {
+    "ngram": ("idc_models_tpu.models.draft", "NGramDrafter",
+              "prompt-lookup over the slot's own stream; free, wins on "
+              "repetitive/templated traffic, proposes nothing on fresh "
+              "text"),
+    "learned": ("idc_models_tpu.models.draft_lm", "DraftLM",
+                "distilled draft LM (--draft-ckpt) with device-resident "
+                "ring caches; one batched propose dispatch per cycle, "
+                "wins on non-repetitive traffic"),
+    "chained": ("idc_models_tpu.models.draft", "ChainedDrafter",
+                "lookup-first / learned-fallback composition: the "
+                "n-gram scan's free hits where streams repeat, the "
+                "draft LM (--draft-ckpt) everywhere else"),
+}
+
 
 def main(argv: list[str] | None = None) -> int:
     ns = _parse(argv)
@@ -462,6 +483,19 @@ def _parse(argv):
                          "drafter matches against the stream's "
                          "history (falls back to shorter n-grams "
                          "down to 1)")
+    sp.add_argument("--drafter", choices=sorted(SERVE_DRAFTERS),
+                    default="ngram",
+                    help="which drafter proposes under --spec-decode: "
+                         + "; ".join(f"'{name}' = {entry[2]}"
+                                     for name, entry
+                                     in sorted(SERVE_DRAFTERS.items())))
+    sp.add_argument("--draft-ckpt", default=None, metavar="DIR",
+                    help="distilled draft-LM checkpoint directory "
+                         "(models/draft_lm.save_draft_lm: sharded "
+                         "params + draft_config.json sidecar) — "
+                         "required by --drafter learned/chained; the "
+                         "restore re-resolves layout against the "
+                         "serving mesh")
     sp.add_argument("--metrics-port", type=int, default=None,
                     help="serve GET /metrics (Prometheus text "
                          "exposition of the live registry) and GET "
@@ -1367,11 +1401,23 @@ def _profile_serve(ns, on_accel):
     # they stay in the unnamed bucket, which the churn detector
     # exempts for exactly this reason (one bucket of one-shot
     # compiles is not one program recompiling)
+    from idc_models_tpu.models.draft_lm import (
+        DraftLM, draft_config, draft_lm,
+    )
+
+    dcfg = draft_config(vocab, t_max)
+    dparams = draft_lm(dcfg, mesh=mesh).init(
+        jax.random.key(ns.seed + 1)).params
+
     class _NoDraft:
-        # arms the engine's fixed-k verify program so lm.verify is
-        # ACCOUNTED (cost/roofline), while never proposing — the
-        # measured loop stays pure fused windows, so window_s times
-        # exactly the program the serve.window verdict is paired with
+        # arms the engine's fixed-k verify program AND the drafter's
+        # device state (via `learned`) so lm.verify and serve.propose
+        # are both ACCOUNTED (cost/roofline), while never proposing —
+        # the measured loop stays pure fused windows, so window_s
+        # times exactly the program the serve.window verdict is
+        # paired with
+        learned = DraftLM(min(8, window), dparams, dcfg)
+
         def propose(self, history):
             return None
 
@@ -1978,6 +2024,23 @@ def _run_serve(ns):
                  f"token inside the {ns.t_max}-slot cache)")
     if ns.spec_decode and ns.ngram_order < 1:
         sys.exit(f"--ngram-order {ns.ngram_order} must be >= 1")
+    if ns.drafter != "ngram" and not ns.spec_decode:
+        sys.exit(f"--drafter {ns.drafter} without --spec-decode: the "
+                 f"drafter only runs inside the speculative loop (its "
+                 f"proposals feed the engine's fixed-k verify "
+                 f"program) — add --spec-decode")
+    if ns.drafter in ("learned", "chained") and not ns.draft_ckpt:
+        sys.exit(f"--drafter {ns.drafter} needs --draft-ckpt DIR: the "
+                 f"learned drafter is a distilled draft LM restored "
+                 f"from a models/draft_lm.save_draft_lm checkpoint "
+                 f"(params + draft_config.json sidecar); distill one "
+                 f"with models/draft_lm.distill_draft_lm, or use "
+                 f"--drafter ngram which needs no model")
+    if ns.draft_ckpt and ns.drafter == "ngram":
+        sys.exit(f"--draft-ckpt without a learned drafter: the n-gram "
+                 f"drafter loads no model, so the checkpoint would be "
+                 f"silently ignored — pass --drafter learned (or "
+                 f"chained) to use it")
     if ns.slo_ttft_p95_ms is not None and ns.slo_ttft_p95_ms <= 0:
         sys.exit(f"--slo-ttft-p95-ms {ns.slo_ttft_p95_ms} must be > 0")
     if (ns.slo_error_rate is not None
@@ -2320,6 +2383,40 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
         from idc_models_tpu.serve import CompileCache
 
         compile_cache = CompileCache(ns.compile_cache, logger=logger)
+    # --drafter learned/chained: restore the distilled draft LM through
+    # the sharded-checkpoint path (layout re-resolved against THIS
+    # mesh) and hand the drafter to the server; 'ngram' stays None so
+    # LMServer builds its default prompt-lookup drafter from
+    # --ngram-order. Vocab is checked HERE, at load time, because the
+    # engine's own teaching error fires only after params land on
+    # device — an operator typo should die before that.
+    drafter = None
+    draft_rules = None
+    if ns.spec_decode and ns.drafter != "ngram":
+        from idc_models_tpu.models.draft_lm import DraftLM, load_draft_lm
+        from idc_models_tpu.models.registry import DRAFT_LM_RULES
+
+        draft_rules = DRAFT_LM_RULES if rules is not None else None
+        dparams, dcfg = load_draft_lm(ns.draft_ckpt, mesh=mesh,
+                                      rules=draft_rules)
+        if dcfg["vocab_size"] != ns.vocab:
+            sys.exit(f"--draft-ckpt {ns.draft_ckpt} was distilled "
+                     f"against a {dcfg['vocab_size']}-token vocab but "
+                     f"this target serves --vocab {ns.vocab}: drafter "
+                     f"and target must share one tokenizer (the verify "
+                     f"program compares token IDS) — re-distill the "
+                     f"drafter against this target "
+                     f"(models/draft_lm.distill_draft_lm)")
+        learned = DraftLM(ns.draft_k, dparams, dcfg)
+        if ns.drafter == "chained":
+            from idc_models_tpu.models.draft import (
+                ChainedDrafter, NGramDrafter,
+            )
+
+            drafter = ChainedDrafter(
+                NGramDrafter(ns.draft_k, order=ns.ngram_order), learned)
+        else:
+            drafter = learned
     server = LMServer(
         params, embed_dim=ns.embed_dim, num_heads=ns.num_heads,
         num_blocks=ns.num_blocks, t_max=ns.t_max, n_slots=ns.slots,
@@ -2333,7 +2430,8 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
         retry=retry, fault_plan=ns.serve_fault_plan,
         journal=ns.journal, brownout=brownout,
         spec_decode=ns.spec_decode, draft_k=ns.draft_k,
-        draft_order=ns.ngram_order,
+        draft_order=ns.ngram_order, drafter=drafter,
+        draft_partition_rules=draft_rules,
         kv_page_size=ns.kv_page_size or None,
         kv_pages=ns.kv_pages or None,
         kv_decode_reserve=ns.kv_decode_reserve or None,
@@ -2467,14 +2565,21 @@ def _serve_body(ns, mesh, params, logger, rules=None) -> None:
         # what speculation actually bought: accept rate over drafted
         # tokens and emitted tokens per slot per verify (1.0 would
         # mean plain decode did just as well)
-        print(f"speculative: drafted={summary['serve_spec_drafted']} "
-              f"accepted={summary['serve_spec_accepted']} "
-              f"accept_rate={summary['serve_spec_accept_rate']} "
-              f"tokens/dispatch="
-              f"{summary['serve_spec_tokens_per_dispatch']} "
-              f"({summary['serve_spec_verify_dispatches']} verify + "
-              f"{summary['serve_decode_dispatches'] - summary['serve_spec_verify_dispatches']}"
-              f" window dispatches)")
+        line = (f"speculative ({ns.drafter}): "
+                f"drafted={summary['serve_spec_drafted']} "
+                f"accepted={summary['serve_spec_accepted']} "
+                f"accept_rate={summary['serve_spec_accept_rate']} "
+                f"tokens/dispatch="
+                f"{summary['serve_spec_tokens_per_dispatch']} "
+                f"({summary['serve_spec_verify_dispatches']} verify + "
+                f"{summary['serve_decode_dispatches'] - summary['serve_spec_verify_dispatches']}"
+                f" window dispatches)")
+        if summary.get("serve_spec_propose_s") is not None:
+            # the overhead speculation pays before any win: host+device
+            # seconds spent PROPOSING (the bench states it as a % of
+            # window time — serve_spec_nonrep_draft_overhead_pct)
+            line += f" propose_s={summary['serve_spec_propose_s']}"
+        print(line)
     if slo is not None:
         names = sorted({a["slo"] for a in slo.alerts})
         print(f"slo: {len(slo.alerts)} alert(s)"

@@ -24,6 +24,13 @@ model, no device work — ideal for the repetitive, templated traffic
 usually HAS appeared before. On adversarially random streams it simply
 stops proposing (None) and serving falls back to the plain fused
 window (docs/LONG_CONTEXT.md owns the when-it-loses story).
+
+`ChainedDrafter` composes drafters first-hit-wins per slot — the
+production policy is lookup-first/learned-fallback: the n-gram scan's
+free hits on templated streams, the learned draft LM
+(models/draft_lm.DraftLM) everywhere the lookup goes quiet. Because
+every member honors the same contract, the chain does too — the
+verify program makes ANY composition sound.
 """
 
 from __future__ import annotations
@@ -92,3 +99,85 @@ class NGramDrafter:
                                   np.int64)])
             return cont.astype(np.int32)
         return None
+
+
+class ChainedDrafter:
+    """First-hit-wins composition of drafters, one proposal per slot.
+
+    Per slot, members are consulted IN ORDER and the first non-None
+    proposal wins — put the free drafter first (lookup-first /
+    learned-fallback: `ChainedDrafter(NGramDrafter(k), DraftLM(...))`)
+    so the expensive member only answers where the cheap one went
+    quiet. All members must agree on `k` (the verify program has ONE
+    fixed draft shape), and at most one member may be engine-backed
+    (`uses_engine`): the engine hosts one set of drafter ring caches,
+    and the chain keeps the one-propose-dispatch-per-cycle budget.
+
+    The batched path calls the engine-backed member's
+    `propose_batched` exactly ONCE per cycle regardless of how many
+    slots the earlier members already covered — the dispatch is what
+    drains the drafter's pending-token backlog into its ring caches,
+    so skipping it on lookup-hit cycles would let the drafter's state
+    fall behind the streams it must draft next cycle."""
+
+    def __init__(self, *drafters):
+        if len(drafters) < 2:
+            raise ValueError(
+                f"ChainedDrafter needs at least 2 drafters to chain, "
+                f"got {len(drafters)} — use the drafter directly")
+        ks = sorted({int(d.k) for d in drafters})
+        if len(ks) != 1:
+            raise ValueError(
+                f"chained drafters disagree on k {ks}: the verify "
+                f"program has one fixed [n_slots, draft_k] draft "
+                f"shape, so every member must propose the same k")
+        backed = [d for d in drafters
+                  if getattr(d, "uses_engine", False)]
+        if len(backed) > 1:
+            raise ValueError(
+                f"chain has {len(backed)} engine-backed drafters "
+                f"({', '.join(type(d).__name__ for d in backed)}); "
+                f"the engine hosts ONE set of drafter ring caches — "
+                f"chain at most one models/draft_lm.DraftLM")
+        self.drafters = tuple(drafters)
+        self.k = ks[0]
+
+    @property
+    def learned(self):
+        """The engine-backed member's model handle (None without one)
+        — serve/api.py arms the engine's drafter state from this."""
+        for d in self.drafters:
+            if getattr(d, "uses_engine", False):
+                return d.learned
+        return None
+
+    def propose(self, history) -> np.ndarray | None:
+        """Host-side chain walk: first member with a proposal wins
+        (the engine-backed member answers through its own host-side
+        rollout here)."""
+        for d in self.drafters:
+            got = d.propose(history)
+            if got is not None:
+                return got
+        return None
+
+    def propose_batched(self, engine, slots, hists) -> dict:
+        """Per-slot chain resolution over ONE batched learned dispatch
+        (when a learned member is chained) plus the host members'
+        scans."""
+        learned_rows = {}
+        for d in self.drafters:
+            if getattr(d, "uses_engine", False):
+                learned_rows = d.propose_batched(engine, slots, hists)
+                break
+        out = {}
+        for s, h in zip(slots, hists):
+            got = None
+            for d in self.drafters:
+                got = (learned_rows.get(s)
+                       if getattr(d, "uses_engine", False)
+                       else d.propose(h))
+                if got is not None:
+                    break
+            out[s] = got
+        return out

@@ -1,0 +1,355 @@
+"""Learned draft model for speculative decoding (ROADMAP item 2).
+
+The PR 10 n-gram drafter only proposes when a trailing n-gram recurs,
+so speculation is inert on fresh text. This module supplies the
+learned alternative — a tiny `attention_lm` student (same tokenizer /
+vocab as the target, ~2 blocks) distilled from the target's own
+logits — plus the glue that carries it from `train/loop.py` all the
+way to the serve stack:
+
+- `draft_config` / `draft_lm`: the student architecture, a scaled-down
+  models/lm.py `attention_lm`. Same param-tree schema as the target,
+  so the drafter rides the registry partition rules ("draft_lm") and
+  the sharded checkpoint path unchanged.
+- `greedy_streams`: the target's own greedy continuations of a prompt
+  batch — the distillation corpus ("the target's sampled streams").
+- `distill_kl_loss` / `distill_draft_lm`: per-position KL against the
+  teacher's logits, trained through the EXISTING `train/loop.fit`
+  machinery (epoch loop, checkpoint-resume, history) so the
+  train→serve handoff is exercised end to end.
+- `save_draft_lm` / `load_draft_lm`: sharded-checkpoint save/restore
+  (checkpoint/sharded.py — atomic manifest, cross-mesh restore) with
+  a `draft_config.json` sidecar so a restore knows the architecture
+  without the caller carrying it out of band.
+- `DraftLM`: the serve-side drafter. It satisfies the models/draft.py
+  host contract (`propose(history) -> [k] int32 | None`) with a
+  fixed-shape jitted forward (one compile per instance, any history),
+  and additionally flags `uses_engine=True` so the scheduler routes
+  proposals through `SlotEngine.propose_all()` — ONE batched device
+  dispatch per cycle for ALL running slots against the drafter's own
+  ring KV caches — instead of per-slot host calls.
+
+A draft model is never trusted: the target's verify program accepts
+only the prefix the target itself would have emitted, so a bad student
+costs acceptance rate, never correctness (models/draft.py owns that
+contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idc_models_tpu.models.lm import attention_lm
+
+CONFIG_NAME = "draft_config.json"
+
+# architecture knobs a draft_config carries (beyond vocab/seq); the
+# defaults are the "tiny student" the distillation recipe targets —
+# ~2 blocks, a fraction of the target's width
+_ARCH_DEFAULTS = {
+    "embed_dim": 32,
+    "num_heads": 2,
+    "mlp_dim": 64,
+    "num_blocks": 2,
+}
+
+
+def draft_config(vocab_size: int, seq_len: int, **overrides) -> dict:
+    """Normalized draft-model architecture dict (the sidecar schema).
+
+    `seq_len` sizes the position table: it must cover the longest
+    training stream AND the serving engine's `t_max` (the engine
+    validates the latter with a teaching error at construction).
+    """
+    unknown = set(overrides) - set(_ARCH_DEFAULTS)
+    if unknown:
+        raise ValueError(
+            f"unknown draft_config overrides {sorted(unknown)}; valid "
+            f"keys: {sorted(_ARCH_DEFAULTS)}")
+    cfg = {"vocab_size": int(vocab_size), "seq_len": int(seq_len)}
+    for key, default in _ARCH_DEFAULTS.items():
+        cfg[key] = int(overrides.get(key, default))
+    if cfg["embed_dim"] % cfg["num_heads"]:
+        raise ValueError(
+            f"draft embed_dim {cfg['embed_dim']} must divide by "
+            f"num_heads {cfg['num_heads']}")
+    return cfg
+
+
+def draft_lm(config: dict, *, mesh=None, block_impl: str = "jnp"):
+    """Build the student Module from a `draft_config` dict."""
+    return attention_lm(
+        config["vocab_size"], config["seq_len"],
+        embed_dim=config["embed_dim"], num_heads=config["num_heads"],
+        mlp_dim=config["mlp_dim"], num_blocks=config["num_blocks"],
+        mesh=mesh, block_impl=block_impl)
+
+
+def greedy_streams(model, variables, prompts, total_len: int) -> np.ndarray:
+    """The target's own greedy continuations: extend each prompt row to
+    `total_len` tokens with the target's argmax picks. This is the
+    distillation corpus — the student learns the target's behavior on
+    the target's OWN stream distribution, which is exactly what it will
+    be asked to draft at serve time."""
+    prompts = np.asarray(prompts, np.int32)
+    n, p_len = prompts.shape
+    if not 1 <= p_len < total_len:
+        raise ValueError(f"need 1 <= prompt len < total_len, got "
+                         f"prompt {p_len}, total_len {total_len}")
+    toks = np.zeros((n, total_len), np.int32)
+    toks[:, :p_len] = prompts
+    fwd = jax.jit(lambda p, s, t: model.apply(p, s, t, train=False)[0])
+    for t in range(p_len, total_len):
+        logits = fwd(variables.params, variables.state, toks)
+        toks[:, t] = np.asarray(jnp.argmax(logits[:, t - 1, :], -1),
+                                np.int32)
+    return toks
+
+
+def teacher_logits(model, variables, streams, *,
+                   batch_size: int = 32) -> np.ndarray:
+    """The teacher's full-sequence logits [N, T, V] float32 — the soft
+    labels the KL loss distills against."""
+    streams = np.asarray(streams, np.int32)
+    fwd = jax.jit(lambda p, s, t: model.apply(p, s, t, train=False)[0])
+    out = []
+    for i in range(0, len(streams), batch_size):
+        chunk = streams[i:i + batch_size]
+        live = len(chunk)
+        if live < batch_size:       # pad the ragged tail: one jit entry
+            chunk = np.concatenate(
+                [chunk, np.repeat(chunk[-1:], batch_size - live, 0)])
+        logits = np.asarray(fwd(variables.params, variables.state,
+                                chunk), np.float32)
+        out.append(logits[:live])
+    return np.concatenate(out, axis=0)
+
+
+def distill_kl_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean per-position KL(teacher ‖ student). `labels` are the
+    teacher's raw logits [B, T, V] (an ArrayDataset's labels field);
+    both distributions are formed in float32. Unshifted: teacher and
+    student logits at position t both predict token t+1, so the
+    positions already align."""
+    t = jax.nn.log_softmax(labels.astype(jnp.float32), axis=-1)
+    s = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(jnp.sum(jnp.exp(t) * (t - s), axis=-1))
+
+
+def distill_draft_lm(target_model, target_variables, streams, *,
+                     config: dict, mesh, epochs: int = 4,
+                     batch_size: int = 8, lr: float = 1e-2,
+                     seed: int = 0, rules=None,
+                     checkpoint_dir: str | None = None, logger=None,
+                     verbose: bool = False):
+    """The distillation recipe, through the standard train stack.
+
+    Computes the teacher's logits over `streams` (int32 [N, T] token
+    streams — use `greedy_streams` to sample them from the target),
+    then runs `train/loop.fit` on the student with `distill_kl_loss`
+    and the reference RMSprop — the same epoch loop, checkpoint-resume
+    and history plumbing every other model here trains through, so the
+    train→serve handoff is exercised end to end.
+
+    Returns `(student_model, TrainState, history)`; persist with
+    `save_draft_lm(path, jax.device_get(state.params), config=config)`.
+    """
+    # lazy: keeps models.* import-light (train pulls in the loader /
+    # observe stacks)
+    from idc_models_tpu.data.idc import ArrayDataset
+    from idc_models_tpu.train.loop import fit
+    from idc_models_tpu.train.state import TrainState, rmsprop
+
+    streams = np.asarray(streams, np.int32)
+    if streams.ndim != 2:
+        raise ValueError(f"streams must be [N, T] int tokens, got "
+                         f"shape {streams.shape}")
+    if streams.shape[1] > config["seq_len"]:
+        raise ValueError(
+            f"stream length {streams.shape[1]} exceeds the draft "
+            f"position table seq_len={config['seq_len']}; raise "
+            f"seq_len in draft_config (it must also cover the serving "
+            f"engine's t_max)")
+    labels = teacher_logits(target_model, target_variables, streams,
+                            batch_size=batch_size)
+    model = draft_lm(config, mesh=mesh)
+    variables = model.init(jax.random.PRNGKey(seed))
+    opt = rmsprop(lr)
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables.params,
+                       model_state=variables.state,
+                       opt_state=opt.init(variables.params))
+    ds = ArrayDataset(streams, labels)
+    state, history = fit(model, opt, distill_kl_loss, state, ds, None,
+                         mesh, epochs=epochs, batch_size=batch_size,
+                         seed=seed, logger=logger, verbose=verbose,
+                         checkpoint_dir=checkpoint_dir, rules=rules)
+    return model, state, history
+
+
+def save_draft_lm(path, params, *, config: dict, step=None):
+    """Save a distilled drafter: the param tree through the sharded
+    checkpoint path (atomic manifest, per-shard writes) plus the
+    `draft_config.json` architecture sidecar, committed atomically by
+    the same writer the manifest uses."""
+    from idc_models_tpu.checkpoint import save_sharded
+    from idc_models_tpu.checkpoint.sharded import _commit_json
+
+    for p, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        if np.asarray(leaf).dtype == object:
+            raise ValueError(
+                f"save_draft_lm got a non-array leaf at "
+                f"{jax.tree_util.keystr(p)} ({type(leaf).__name__}): "
+                f"pass the PARAM tree — distill_draft_lm returns "
+                f"(model, state, history), so save "
+                f"jax.device_get(state.params), not the model")
+    doc = draft_config(config["vocab_size"], config["seq_len"],
+                       **{k: config[k] for k in _ARCH_DEFAULTS
+                          if k in config})
+    handle = save_sharded(str(path), params, step=step)
+    from pathlib import Path
+
+    _commit_json(Path(path), CONFIG_NAME, doc)
+    return handle
+
+
+def load_draft_lm(path, *, mesh=None, rules=None):
+    """Restore `(params, config)` from a `save_draft_lm` directory.
+
+    `mesh` + `rules` re-resolve the layout against the TARGET mesh
+    (checkpoint/sharded.py): a drafter saved under FSDP rules restores
+    bit-identically onto a TP mesh or a different device count. With a
+    mesh but no rules, the registry's "draft_lm" rule set (the one the
+    serving engine places drafter params with) is used.
+    """
+    from idc_models_tpu.checkpoint import restore_sharded
+
+    if mesh is not None and rules is None:
+        from idc_models_tpu.models.registry import DRAFT_LM_RULES
+
+        rules = DRAFT_LM_RULES
+
+    cfg_path = os.path.join(str(path), CONFIG_NAME)
+    if not os.path.exists(cfg_path):
+        raise FileNotFoundError(
+            f"{cfg_path}: missing the {CONFIG_NAME} sidecar, so this "
+            f"is not a draft-LM checkpoint (a bare sharded tree has "
+            f"no architecture record); save with "
+            f"models/draft_lm.save_draft_lm")
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    config = draft_config(raw["vocab_size"], raw["seq_len"],
+                          **{k: raw[k] for k in _ARCH_DEFAULTS
+                             if k in raw})
+    params = restore_sharded(str(path), mesh=mesh, rules=rules)
+    return params, config
+
+
+class DraftLM:
+    """Learned drafter over a distilled draft-LM checkpoint.
+
+    Satisfies the models/draft.py contract with a host-side greedy
+    rollout (`propose`), and flags `uses_engine=True` so the serving
+    scheduler instead batches proposals for ALL running slots through
+    `SlotEngine.propose_all()` — one jitted device dispatch per cycle
+    against the drafter's own per-slot ring KV caches. The host path
+    stays for engines without drafter state (and for bit-identity
+    tests across checkpoint restores).
+
+    `adapters=(u [T, V, r], v [T, r, V])` optionally stacks per-tenant
+    low-rank drafter heads; the engine applies them with the traced-tid
+    gather (models/lm.py `make_adapter_head_hook`), so mixed-tenant
+    batches stay one dispatch.
+    """
+
+    uses_engine = True
+
+    def __init__(self, k: int, params, config: dict, *, adapters=None):
+        if k < 1:
+            raise ValueError(f"need k >= 1 draft tokens, got {k}")
+        self.k = int(k)
+        self.params = params
+        self.config = draft_config(config["vocab_size"],
+                                   config["seq_len"],
+                                   **{key: config[key]
+                                      for key in _ARCH_DEFAULTS
+                                      if key in config})
+        vocab = int(params["embed"].shape[0])
+        if vocab != self.config["vocab_size"]:
+            raise ValueError(
+                f"draft params embed a {vocab}-token vocab but the "
+                f"config says {self.config['vocab_size']}; the sidecar "
+                f"and the tree disagree — re-save with save_draft_lm")
+        if adapters is not None:
+            u, v = adapters
+            if (u.ndim != 3 or v.ndim != 3 or u.shape[0] != v.shape[0]
+                    or u.shape[1] != vocab or v.shape[2] != vocab
+                    or u.shape[2] != v.shape[1]):
+                raise ValueError(
+                    f"drafter adapters must be u [T, V, r] / v [T, r, V] "
+                    f"with V={vocab}, got u {getattr(u, 'shape', None)} "
+                    f"v {getattr(v, 'shape', None)}")
+        self.adapters = adapters
+        self._fwd = None
+
+    @property
+    def learned(self) -> "DraftLM":
+        """The engine-backed member (serve/api.py arms the engine's
+        drafter state from this)."""
+        return self
+
+    @property
+    def vocab_size(self) -> int:
+        return self.config["vocab_size"]
+
+    def _forward(self):
+        if self._fwd is None:
+            model = draft_lm(self.config)
+
+            def pick(params, toks, last):
+                logits, _ = model.apply(params, {}, toks, train=False)
+                return jnp.argmax(logits[0, last, :], -1)
+
+            self._fwd = jax.jit(pick)
+        return self._fwd
+
+    def propose(self, history) -> np.ndarray | None:
+        """Host-side greedy rollout of k tokens. Fixed shapes — the
+        window is always [1, seq_len] and `last` is a traced index —
+        so any history length hits ONE compiled program."""
+        h = np.asarray(history, np.int32).ravel()
+        if h.size == 0:
+            return None
+        seq = self.config["seq_len"]
+        fwd = self._forward()
+        toks = np.zeros(seq, np.int32)
+        tail = h[-seq:]
+        n = tail.size
+        toks[:n] = tail
+        out = np.empty(self.k, np.int32)
+        for j in range(self.k):
+            nxt = int(fwd(self.params, toks[None], n - 1))
+            out[j] = nxt
+            if n < seq:
+                toks[n] = nxt
+                n += 1
+            else:                       # slide the window by one
+                toks[:-1] = toks[1:]
+                toks[-1] = nxt
+        return out
+
+    def propose_batched(self, engine, slots, hists) -> dict:
+        """One `SlotEngine.propose_all()` dispatch covering every
+        running slot; rows come back per requested slot (None where
+        the drafter had no valid context)."""
+        res = engine.propose_all()
+        if res is None:
+            return {s: None for s in slots}
+        drafts, valid = res
+        return {s: (np.asarray(drafts[s], np.int32) if valid[s]
+                    else None) for s in slots}

@@ -35,7 +35,7 @@ _D, _M = meshlib.DATA_AXIS, meshlib.MODEL_AXIS
 # seq-only serve mesh they degrade to replicated. Order matters: first
 # match wins, the catch-all replicates LN scales/biases and the rest.
 # docs/SHARDING.md walks every rule.
-LM_RULES = partition.PartitionRules((
+_LM_RULE_PAIRS = (
     (r"mha/w[qkv]$", P(_D, _M)),       # [E, E] column-parallel
     (r"mha/wo$", P(_M, _D)),           # [E, E] row-parallel
     (r"fc1/kernel$", P(_D, _M)),       # [E, mlp] column-parallel
@@ -47,14 +47,25 @@ LM_RULES = partition.PartitionRules((
     (r"pos$", P(None, _D)),            # [T, E] FSDP on E
     (r".*", P()),                      # LN scale/bias, bo, fc2/bias,
     #                                    step counter: replicated
-))
+)
+LM_RULES = partition.PartitionRules(_LM_RULE_PAIRS)
+
+# The learned drafter (models/draft_lm.py) is a scaled-down
+# attention_lm — same param-tree schema — so the same regex policy
+# applies verbatim. It still gets its OWN named rule set: the drafter's
+# placement is tuned independently of the target's (a 2-block student
+# rarely wants the target's TP split; swapping its rules must not
+# perturb the target), and serve/engine.py + the draft-LM checkpoint
+# path resolve through this name.
+DRAFT_LM_RULES = partition.PartitionRules(_LM_RULE_PAIRS)
 
 # name -> default rule set; "lm" serves attention_lm trees (train AND
-# serve resolve through it), classifier names alias their ModelSpec's
-# rules so both lookups agree.
+# serve resolve through it), "draft_lm" the learned drafter,
+# classifier names alias their ModelSpec's rules so both lookups agree.
 PARTITION_RULES: dict[str, partition.PartitionRules] = {
     "replicated": REPLICATED_RULES,
     "lm": LM_RULES,
+    "draft_lm": DRAFT_LM_RULES,
 }
 
 

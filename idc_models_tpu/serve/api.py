@@ -111,7 +111,7 @@ class LMServer:
                  kv_pages: int | None = None,
                  kv_decode_reserve: int | None = None,
                  registry=None, tenancy=None, partition_rules=None,
-                 compile_cache=None):
+                 draft_partition_rules=None, compile_cache=None):
         import jax.numpy as jnp
 
         from idc_models_tpu.serve.engine import SlotEngine
@@ -147,7 +147,9 @@ class LMServer:
             admit_after_collect=admit_after_collect, clock=clock,
             prefill_chunk=prefill_chunk, kv_dtype=kv_dtype,
             spec_decode=spec_decode, draft_k=draft_k,
-            draft_order=draft_order, kv_page_size=kv_page_size,
+            draft_order=draft_order, drafter=drafter,
+            draft_partition_rules=draft_partition_rules,
+            kv_page_size=kv_page_size,
             kv_pages=kv_pages, kv_decode_reserve=kv_decode_reserve,
             partition_rules=partition_rules,
             compile_cache=compile_cache)
@@ -191,6 +193,18 @@ class LMServer:
             from idc_models_tpu.models.draft import NGramDrafter
 
             drafter = NGramDrafter(draft_k, order=draft_order)
+        # a LEARNED drafter (models/draft_lm.DraftLM, or a
+        # ChainedDrafter wrapping one) exposes `.learned` — the model
+        # handle that arms the engine's device-resident drafter state
+        # (per-slot ring caches + the batched propose program); host
+        # drafters leave it None and the engine builds spec-off-cheap
+        draft_model = getattr(drafter, "learned", None)
+        if draft_model is None and draft_partition_rules is not None:
+            raise ValueError(
+                "draft_partition_rules without a learned drafter: the "
+                "rules place models/draft_lm.DraftLM params — pass "
+                "drafter=DraftLM(...) (or a ChainedDrafter containing "
+                "one), or drop the rules")
         # tenancy (serve/tenancy.py, ISSUE 14): accept a built Tenancy
         # runtime OR a TenantRegistry (built here against THIS model's
         # vocab with the server's logger/registry/clock — adapter
@@ -214,7 +228,8 @@ class LMServer:
             kv_decode_reserve=kv_decode_reserve,
             adapter_bank=(tenancy.bank if tenancy is not None
                           else None),
-            partition_rules=partition_rules)
+            partition_rules=partition_rules, draft_model=draft_model,
+            draft_partition_rules=draft_partition_rules)
         # slo: an optional observe.slo.SLOEngine — the metrics hooks
         # feed its declared objectives (ttft/queue_wait/error_rate) and
         # evaluate burn rates once per scheduler cycle

@@ -572,6 +572,138 @@ def _engine_fns(cfg, pad_id: int, quant: bool = False,
                       verify)
 
 
+class _DrafterFns(NamedTuple):
+    init_caches: object   # (n_slots) -> per-block ring pairs
+    insert: object        # (dcaches, new_caches, slot) — row scatter
+    ingest: object        # (dparams, dcaches, toks, pos0, live)
+    propose: object       # (dparams, dcaches, adapters, tslot, toks,
+    #                        n_new, pos0, live) -> (dcaches, drafts)
+
+
+@functools.lru_cache(maxsize=16)
+def _drafter_fns(dcfg, pad_id: int, draft_k: int) -> _DrafterFns:
+    """Compile-once LEARNED-DRAFTER programs (models/draft_lm.py) — the
+    device half of batched proposal. The drafter keeps its own small
+    per-slot ring KV caches ([S, t_max, Hd, Dd] at the DRAFT model's
+    dims, positions mirroring the target's), and `propose` turns every
+    running slot's un-ingested emitted tokens into `draft_k` greedy
+    proposals in ONE dispatch: a chunk ingest of the pending tokens
+    (`_chunk_batch_forward` + the batched chunk fold) followed by a
+    K-1-step autoregressive scan of the shared per-token forward.
+
+    The ingest chunk width is FIXED at C = draft_k + 1 — the most a
+    verify emits per slot per cycle, so the steady state is one
+    propose dispatch per cycle; a backlog (plain windows wider than C,
+    a fresh admission's deferred token) drains through `ingest`
+    rounds first. C also bounds the ring writes: the scheduler only
+    proposes for slots with verify room (pos + K + 1 <= t_max), so
+    every chunk splice and speculative append lands inside t_max, and
+    positions past a slot's committed frontier hold dead K/V that the
+    next ingest overwrites before the visibility mask could ever
+    reveal it — the same dead-row discipline as the decode window.
+
+    `adapters`/`tslot` are the per-tenant drafter HEADS (the PR 14
+    traced-tid gather, models/lm.make_adapter_head_hook): tenant mixes
+    steer a gather by VALUE, so mixed-tenant batches stay one
+    executable. Greedy only — a draft is a proposal, not a sample, and
+    the verify re-picks with the request's real rule either way."""
+    mesh, t_max = dcfg.mesh, dcfg.t_max
+    head_dim = dcfg.embed_dim // dcfg.num_heads
+    C = int(draft_k) + 1
+    K = int(draft_k)
+    fold = make_batched_ring_decode(mesh, jit=False)
+    chunk_fold = make_batched_chunk_ring_decode(mesh, jit=False)
+    ln = core.layer_norm(dcfg.embed_dim)
+    cache_sh = meshlib.batch_seq_sharding(mesh, trailing=0)
+
+    def pin(caches):
+        # same canonical-sharding discipline as _engine_fns.pin_state:
+        # one spelling for every producer keeps one jit cache key
+        return tuple(
+            (lax.with_sharding_constraint(kc, cache_sh),
+             lax.with_sharding_constraint(vc, cache_sh))
+            for kc, vc in caches)
+
+    def init_caches(n_slots: int):
+        def mk():
+            return meshlib.put_with_sharding(
+                np.zeros((n_slots, t_max, dcfg.num_heads, head_dim),
+                         jnp.dtype(dcfg.cache_dtype)), cache_sh)
+
+        return tuple((mk(), mk()) for _ in range(dcfg.num_blocks))
+
+    def chunk_step(params, caches, toks, pos0, live):
+        def block_fold(i, kc, vc, q, k, v):
+            return chunk_fold(kc, vc, q, k, v, pos0, live)
+
+        return _chunk_batch_forward(dcfg, ln, params, caches, toks,
+                                    pos0, block_fold)
+
+    def ingest_body(params, caches, toks, pos0, live):
+        # backlog drain: splice one C-chunk of pending tokens per live
+        # row, logits discarded (only the FINAL chunk's feed a draft)
+        _, caches = chunk_step(params, caches, toks, pos0, live)
+        return pin(caches)
+
+    ingest = jax.jit(ingest_body, donate_argnums=(1,))
+
+    def propose_body(params, caches, adapters, tslot, toks, n_new,
+                     pos0, live):
+        # final chunk + autoregressive rollout, one program: the chunk
+        # forward yields logits at EVERY position, so the last REAL
+        # pending token's logits (index n_new - 1) seed draft 0 with
+        # no extra dispatch; K - 1 masked token steps then extend the
+        # drafter's own stream speculatively
+        L, caches = chunk_step(params, caches, toks, pos0, live)
+        idx = jnp.clip(n_new - 1, 0, C - 1)
+        lg = jnp.take_along_axis(L, idx[:, None, None], axis=1)[:, 0]
+        eff = (make_adapter_head_hook(*adapters, tslot) if adapters
+               else None)
+
+        def pick_tok(row):
+            pl = row if eff is None else eff(row)
+            return jnp.argmax(pl, axis=-1).astype(jnp.int32)
+
+        d0 = pick_tok(lg)
+        front = pos0 + n_new
+
+        def step(carry, j):
+            caches, cur = carry
+            p = jnp.clip(front + j, 0, t_max - 1)
+
+            def block_fold(i, kc, vc, q, k, v):
+                return fold(kc, vc, q, k, v, p, live)
+
+            lg2, caches = _token_forward(dcfg, ln, params, caches,
+                                         cur, p, block_fold)
+            return (caches, pick_tok(lg2)), cur
+
+        (caches, last), ys = lax.scan(
+            step, (caches, d0), jnp.arange(K - 1, dtype=jnp.int32))
+        drafts = jnp.concatenate(
+            [jnp.moveaxis(ys, 0, 1).astype(jnp.int32),
+             last[:, None]], axis=1)
+        return pin(caches), drafts
+
+    propose = jax.jit(propose_body, donate_argnums=(1,))
+
+    def insert_body(caches, new_caches, slot):
+        # admission row scatter, slot TRACED — one executable for
+        # every slot, the same recycle discipline as the target insert
+        out = []
+        for (kc, vc), (nk, nv) in zip(caches, new_caches):
+            kc = lax.dynamic_update_slice(kc, nk.astype(kc.dtype),
+                                          (slot, 0, 0, 0))
+            vc = lax.dynamic_update_slice(vc, nv.astype(vc.dtype),
+                                          (slot, 0, 0, 0))
+            out.append((kc, vc))
+        return pin(tuple(out))
+
+    insert = jax.jit(insert_body, donate_argnums=(0,))
+
+    return _DrafterFns(init_caches, insert, ingest, propose)
+
+
 @functools.lru_cache(maxsize=16)
 def _paged_engine_fns(cfg, pad_id: int, quant: bool, draft_k,
                       page_size: int, n_pages: int,
@@ -841,7 +973,8 @@ class SlotEngine:
                  kv_page_size: int | None = None,
                  kv_pages: int | None = None,
                  kv_decode_reserve: int | None = None,
-                 adapter_bank=None, partition_rules=None):
+                 adapter_bank=None, partition_rules=None,
+                 draft_model=None, draft_partition_rules=None):
         if n_slots < 1:
             raise ValueError(f"need n_slots >= 1, got {n_slots}")
         # paged KV mode (ISSUE 11): the per-slot [t_max, H, D] ring
@@ -1014,6 +1147,10 @@ class SlotEngine:
         self.eos_id = eos_id
         self.temperature = float(temperature)
         vocab = params["head"]["kernel"].shape[1]
+        # the serving vocab, public: the scheduler's draft validation
+        # bounds proposed ids by it, the CLI's --draft-ckpt gate
+        # compares against it
+        self.vocab = int(vocab)
         # dtype only — never np.asarray the head: on a real model that
         # is a multi-hundred-MB device→host fetch per engine build
         ldtype = jnp.result_type(params["head"]["kernel"].dtype)
@@ -1039,6 +1176,76 @@ class SlotEngine:
             self.n_tenants = u.shape[0]
             self._adapters = (meshlib.put_with_sharding(u, rep),
                               meshlib.put_with_sharding(v, rep))
+        # learned drafter (models/draft_lm.py, ROADMAP 2): its own
+        # small per-slot ring caches + the batched propose/ingest
+        # programs, riding the same insert/recycle/export-import
+        # lifecycle as the target's state
+        self._dcfg = self._dfns = self._dsfns = None
+        self._draft_partition_rules = draft_partition_rules
+        if draft_model is None:
+            if draft_partition_rules is not None:
+                raise ValueError(
+                    "draft_partition_rules without draft_model: the "
+                    "rules shard the learned drafter's params — pass "
+                    "draft_model (models/draft_lm.DraftLM.learned) or "
+                    "drop the rules")
+        else:
+            if self.draft_k is None:
+                raise ValueError(
+                    "a draft_model needs draft_k: its proposals feed "
+                    "the speculative verify program, which only exists "
+                    "on a spec-armed engine — build with draft_k=K")
+            dparams = draft_model.params
+            dconfig = draft_model.config
+            dvocab = int(dparams["embed"].shape[0])
+            if dvocab != vocab:
+                raise ValueError(
+                    f"draft model vocab {dvocab} != target vocab "
+                    f"{vocab}: speculation verifies draft token IDS "
+                    f"against the target's own picks, so the two "
+                    f"models must share one tokenizer/vocab — distill "
+                    f"the drafter from THIS target "
+                    f"(models/draft_lm.distill_draft_lm)")
+            d_seq = int(dparams["pos"].shape[0])
+            if d_seq < t_max:
+                raise ValueError(
+                    f"draft model position table {d_seq} < engine "
+                    f"t_max {t_max}: the drafter's ring mirrors the "
+                    f"target's positions up to t_max — distill with "
+                    f"draft_config(seq_len >= t_max)")
+            self._dcfg = _serve_config(
+                dparams, embed_dim=dconfig["embed_dim"],
+                num_heads=dconfig["num_heads"],
+                num_blocks=dconfig["num_blocks"], t_max=t_max,
+                mesh=self._cfg.mesh, cache_dtype=cache_dtype,
+                block_impl=block_impl, temperature=0.0, top_k=None)
+            self._dfns = _drafter_fns(self._dcfg, int(pad_id),
+                                      self.draft_k)
+            self._dsfns = _serving_fns(self._dcfg)
+            self._dparams = _place_params(dparams, self._dcfg.mesh,
+                                          rules=draft_partition_rules)
+            self._dadapters = ()
+            dad = getattr(draft_model, "adapters", None)
+            if dad is not None:
+                du = np.asarray(dad[0], np.float32)
+                dv = np.asarray(dad[1], np.float32)
+                if self.n_tenants and du.shape[0] != self.n_tenants:
+                    raise ValueError(
+                        f"drafter adapter bank has {du.shape[0]} "
+                        f"tenant rows but the engine serves "
+                        f"{self.n_tenants} tenants — the traced-tid "
+                        f"gather indexes both banks by the same slot "
+                        f"tenant ids")
+                self._dadapters = (meshlib.put_with_sharding(du, rep),
+                                   meshlib.put_with_sharding(dv, rep))
+            self._dcaches = self._dfns.init_caches(n_slots)
+            # host-side drafter stream bookkeeping: _dfront[s] tokens
+            # of slot s's history are ingested into the drafter ring;
+            # _dpend[s] holds emitted-but-not-yet-ingested tokens
+            # (invariant: _dfront + len(_dpend) == the slot's history
+            # length == its target position)
+            self._dpend: list[list[int]] = [[] for _ in range(n_slots)]
+            self._dfront = np.zeros(n_slots, np.int64)
         # device state — placed under the canonical shardings every
         # engine program pins its outputs to (one jit cache key for the
         # whole loop), donated through every window/insert
@@ -1127,6 +1334,11 @@ class SlotEngine:
         refcounts."""
         self._occupied[slot] = False
         self._rem_h[slot] = 0
+        if self._dfns is not None:
+            # the drafter row's dead K/V stays, like the target row's:
+            # the next admission's _draft_admit insert overwrites it
+            self._dpend[slot] = []
+            self._dfront[slot] = 0
         if self.paged:
             if slot in self._slot_pages:
                 self._set_page_row(slot, [], kill=True)
@@ -1184,7 +1396,7 @@ class SlotEngine:
                              f"running request has state to export")
         p = int(self._pos_h[slot])
         head_dim = self._cfg.embed_dim // self._cfg.num_heads
-        return {
+        snap = {
             "pos": p,
             "rem": int(self._rem_h[slot]),
             "eos": int(self._eos_h[slot]),
@@ -1199,6 +1411,23 @@ class SlotEngine:
                              np.asarray(vc[slot:slot + 1, :p]))
                             for kc, vc in self._caches),
         }
+        if self._dfns is not None:
+            # the learned drafter's shadow state rides the same
+            # handoff: ring rows truncated to the DRAFTER frontier
+            # (everything past it is dead K/V) plus the host-side
+            # frontier/pending-token shadows, so a migrated slot's
+            # proposals are bit-identical to an unmigrated run
+            df = int(self._dfront[slot])
+            snap["draft"] = {
+                "front": df,
+                "pend": [int(t) for t in self._dpend[slot]],
+                "num_heads": self._dcfg.num_heads,
+                "head_dim": self._dcfg.embed_dim // self._dcfg.num_heads,
+                "caches": tuple((np.asarray(kc[slot:slot + 1, :df]),
+                                 np.asarray(vc[slot:slot + 1, :df]))
+                                for kc, vc in self._dcaches),
+            }
+        return snap
 
     def import_slot(self, slot: int, snap: dict, *, tid: int = 0) -> None:
         """Adopt an exported slot snapshot into free `slot` through the
@@ -1243,6 +1472,37 @@ class SlotEngine:
                 f"(blocks={self._cfg.num_blocks}, "
                 f"heads={self._cfg.num_heads}, head_dim={head_dim}) — "
                 f"slots only migrate between config-identical replicas")
+        dsnap = snap.get("draft")
+        if dsnap is None and self._dfns is not None:
+            raise ValueError(
+                "snapshot carries no learned-drafter state but this "
+                "engine has a draft_model armed — resuming here would "
+                "propose from an empty drafter cache and silently "
+                "change acceptance; migrate between replicas with the "
+                "same drafter configuration (or export from an engine "
+                "with the drafter armed)")
+        if dsnap is not None and self._dfns is None:
+            raise ValueError(
+                "snapshot carries learned-drafter state but this "
+                "engine has no draft_model — its frontier and ring "
+                "rows would be dropped and the resumed request would "
+                "stop speculating; migrate between replicas with the "
+                "same drafter configuration")
+        if dsnap is not None:
+            dhd = self._dcfg.embed_dim // self._dcfg.num_heads
+            if (len(dsnap["caches"]) != self._dcfg.num_blocks
+                    or dsnap["num_heads"] != self._dcfg.num_heads
+                    or dsnap["head_dim"] != dhd):
+                raise ValueError(
+                    f"snapshot drafter geometry (blocks="
+                    f"{len(dsnap['caches'])}, heads="
+                    f"{dsnap['num_heads']}, head_dim="
+                    f"{dsnap['head_dim']}) does not match this "
+                    f"engine's draft model (blocks="
+                    f"{self._dcfg.num_blocks}, heads="
+                    f"{self._dcfg.num_heads}, head_dim={dhd}) — "
+                    f"slots only migrate between config-identical "
+                    f"replicas, drafter included")
         self._check_tid(tid)
         from idc_models_tpu.ring_decode import cache_sharding
         sh = cache_sharding(self._cfg.mesh)
@@ -1269,6 +1529,20 @@ class SlotEngine:
         self._rem_h[slot] = rem
         self._eos_h[slot] = eos
         self._occupied[slot] = True
+        if dsnap is not None:
+            def _dgrow(a):
+                a = jnp.pad(
+                    jnp.asarray(np.asarray(a), self._dcfg.cache_dtype),
+                    ((0, 0), (0, self.t_max - a.shape[1]),
+                     (0, 0), (0, 0)))
+                return meshlib.put_with_sharding(a, sh)
+
+            drow = tuple((_dgrow(kc), _dgrow(vc))
+                         for kc, vc in dsnap["caches"])
+            self._dcaches = self._dfns.insert(self._dcaches, drow,
+                                              np.int32(slot))
+            self._dfront[slot] = int(dsnap["front"])
+            self._dpend[slot] = [int(t) for t in dsnap["pend"]]
 
     def _validate_admit(self, slot, prompt, max_new_tokens, rng):
         """The one admission contract, shared by the monolithic and
@@ -1314,11 +1588,15 @@ class SlotEngine:
                 f"tenants")
 
     def _insert(self, slot, caches1, logits1, p_len, max_new_tokens,
-                eos_id, rng, tid: int = 0) -> None:
+                eos_id, rng, tid: int = 0, prompt=None) -> None:
         """Scatter a fully prefilled request into the batch row — the
         shared tail of both admission paths. `tid` is the request's
         tenant id (0 = default): a traced scalar into the tslot row,
-        steering the window/verify adapter gather for this slot."""
+        steering the window/verify adapter gather for this slot.
+        `prompt` (the [P] token row) seeds the learned drafter's state
+        for this slot when one is armed — both admission paths pass
+        it; `import_slot` restores drafter state from its snapshot
+        instead."""
         eos = self.eos_id if eos_id is None else eos_id
         eos = -1 if eos is None else int(eos)
         kd_row = (_key_data(rng) if rng is not None
@@ -1344,6 +1622,34 @@ class SlotEngine:
         self._rem_h[slot] = max_new_tokens
         self._eos_h[slot] = eos
         self._occupied[slot] = True
+        if self._dfns is not None and prompt is not None:
+            self._draft_admit(slot, np.asarray(prompt, np.int32).ravel())
+
+    def _draft_admit(self, slot: int, prompt: np.ndarray) -> None:
+        """Seed the learned drafter's row for a fresh admission: prefill
+        the prompt MINUS its last token through the drafter's own
+        bucketed prefill (the draft-dim `_serving_fns` — compile-once,
+        any length), scatter the row in, and leave the last prompt
+        token PENDING. Deferring that token is what makes the drafter
+        stateless beyond its ring: the propose program's chunk ingest
+        always has >= 1 pending token whose position-indexed logits
+        seed draft 0, so no per-slot drafter logits row exists to
+        carry, migrate, or invalidate."""
+        p_len = prompt.shape[0]
+        if p_len <= 1:
+            row = self._dsfns.init_caches(1)
+            front = 0
+        else:
+            bucket = prefill_bucket(p_len - 1, self.t_max, self._n_ring)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[:, :p_len - 1] = prompt[None, :p_len - 1]
+            _, row = self._dsfns.prefill(self._dparams, padded,
+                                         np.int32(p_len - 1))
+            front = p_len - 1
+        self._dcaches = self._dfns.insert(self._dcaches, row,
+                                          np.int32(slot))
+        self._dfront[slot] = front
+        self._dpend[slot] = [int(prompt[-1])]
 
     def admit(self, slot: int, prompt, max_new_tokens: int, *,
               rng=None, eos_id: int | None = None, tag=None,
@@ -1387,7 +1693,7 @@ class SlotEngine:
             logits1, caches1 = self._sfns.prefill(self._params, padded,
                                                   np.int32(p_len))
             self._insert(slot, caches1, logits1, p_len, max_new_tokens,
-                         eos_id, rng, tid)
+                         eos_id, rng, tid, prompt=prompt[0])
 
     # -- chunked prefill --------------------------------------------------
 
@@ -1592,7 +1898,8 @@ class SlotEngine:
                 self._stamp_decode_scales(pend.pages[n_prompt:],
                                           pend.pages[n_prompt - 1])
             self._insert(slot, pend.caches, pend.logits, p_len,
-                         pend.budget, pend.eos_id, pend.rng, pend.tid)
+                         pend.budget, pend.eos_id, pend.rng, pend.tid,
+                         prompt=pend.prompt)
         return done
 
     def cancel_prefill(self, slot: int) -> None:
@@ -1652,6 +1959,80 @@ class SlotEngine:
         if self.draft_k is None:
             return False
         return bool(self._pos_h[slot] + self.draft_k + 1 <= self.t_max)
+
+    def propose_all(self):
+        """LEARNED proposals for every speculating slot in ONE device
+        round-trip: drain each slot's pending emitted tokens (queued by
+        collect(), see `_note_emitted`) into the drafter's ring caches,
+        then roll the drafter `draft_k` greedy steps for ALL qualifying
+        slots in a single jitted dispatch. Returns `(drafts, live)` —
+        int32 [n_slots, draft_k] proposals plus the bool mask of rows
+        they are real for — or None when no slot qualifies this cycle.
+
+        The steady state (every slot emitted <= draft_k + 1 tokens
+        last cycle, the verify maximum) is exactly one `propose`
+        dispatch; a deeper backlog (plain-window fallback cycles, a
+        fresh admission's deferred prompt token) drains through
+        fixed-width `ingest` rounds first, REMAINDER-FIRST per slot so
+        every live slot's final chunk lands in the single shared final
+        round with 1..C real tokens. Only slots with `spec_room` are
+        proposed for — beyond keeping proposals useful, that bound is
+        what keeps every chunk splice inside t_max (pos0 + C <= t_max
+        needs pos + draft_k + 1 <= t_max)."""
+        if self._dfns is None:
+            raise RuntimeError(
+                "propose_all() requires a learned drafter: build the "
+                "engine with draft_model= (a models/draft_lm.DraftLM) "
+                "— host-side drafters (NGramDrafter) propose via "
+                "their own propose(history) instead")
+        if self._pending is not None:
+            raise RuntimeError("a window is already in flight — "
+                               "collect() it first")
+        C = self.draft_k + 1
+        live = np.array([
+            bool(self._occupied[s]) and self._rem_h[s] >= 1
+            and len(self._dpend[s]) > 0 and self.spec_room(s)
+            for s in range(self.n_slots)])
+        if not live.any():
+            return None
+        pend = {int(s): np.asarray(self._dpend[s], np.int32)
+                for s in np.flatnonzero(live)}
+        offs = dict.fromkeys(pend, 0)
+        rounds = max(-(-len(p) // C) for p in pend.values())
+        with trace.span("serve.propose", slots=int(live.sum()),
+                        rounds=rounds):
+            for r in range(rounds - 1):
+                left = rounds - r
+                toks = np.zeros((self.n_slots, C), np.int32)
+                pos0 = np.zeros(self.n_slots, np.int32)
+                rlive = np.zeros(self.n_slots, bool)
+                for s, p in pend.items():
+                    remaining = len(p) - offs[s]
+                    if remaining <= (left - 1) * C:
+                        continue
+                    n = remaining - (left - 1) * C
+                    toks[s, :n] = p[offs[s]:offs[s] + n]
+                    pos0[s] = self._dfront[s] + offs[s]
+                    rlive[s] = True
+                    offs[s] += n
+                self._dcaches = self._dfns.ingest(
+                    self._dparams, self._dcaches, toks, pos0, rlive)
+            toks = np.zeros((self.n_slots, C), np.int32)
+            pos0 = np.zeros(self.n_slots, np.int32)
+            n_new = np.zeros(self.n_slots, np.int32)
+            for s, p in pend.items():
+                n = len(p) - offs[s]
+                toks[s, :n] = p[offs[s]:]
+                pos0[s] = self._dfront[s] + offs[s]
+                n_new[s] = n
+            self._dcaches, drafts = self._dfns.propose(
+                self._dparams, self._dcaches, self._dadapters,
+                self._tslot, toks, n_new, pos0, live)
+            drafts = np.asarray(drafts)
+        for s in pend:
+            self._dfront[s] += len(self._dpend[s])
+            self._dpend[s] = []
+        return drafts.astype(np.int32), live
 
     def ensure_decode_room(self, n_tokens: int) -> list[int]:
         """Paged engines only (contiguous rooms are sized at admission
@@ -1826,6 +2207,7 @@ class SlotEngine:
                     self._rem_h[s] = rem_before[s] - n
                 self._pos_h[s] += n
                 out[s] = row
+            self._note_emitted(out)
             return out
         for s in range(self.n_slots):
             if not occupied[s]:
@@ -1839,7 +2221,20 @@ class SlotEngine:
                 self._rem_h[s] = rem_before[s] - len(row)
             self._pos_h[s] += len(row)
             out[s] = row
+        self._note_emitted(out)
         return out
+
+    def _note_emitted(self, out: dict[int, list[int]]) -> None:
+        """Queue this cycle's emitted tokens for the learned drafter.
+        The drafter's ring caches ingest them lazily — one chunked
+        dispatch for ALL slots at the start of the next propose_all()
+        — so collect() never touches the device on the drafter's
+        behalf and spec-off serving pays nothing."""
+        if self._dfns is None:
+            return
+        for s, row in out.items():
+            if row:
+                self._dpend[s].extend(row)
 
     def step_window(self, n_steps: int) -> dict[int, list[int]]:
         """Synchronous window: begin + collect in one call."""
@@ -2013,6 +2408,14 @@ class SlotEngine:
     def _sfns_jit(self):
         return getattr(self._sfns, "_base", self._sfns)
 
+    @property
+    def _dfns_jit(self):
+        return getattr(self._dfns, "_base", self._dfns)
+
+    @property
+    def _dsfns_jit(self):
+        return getattr(self._dsfns, "_base", self._dsfns)
+
     def cache_sizes(self) -> dict:
         """Jit-cache entry counts for the no-recompile contract: after
         warmup, admitting requests of ANY prompt length/budget into any
@@ -2039,6 +2442,14 @@ class SlotEngine:
                     sfns.prefill_chunk._cache_size())
         if self.draft_k is not None:
             out["verify"] = efns.verify._cache_size()
+        if self._dfns is not None:
+            # the learned drafter's programs ride the same contract:
+            # mixed draft-hit patterns (deep backlogs, fresh
+            # admissions, all-miss cycles) must not grow these
+            out["propose"] = self._dfns_jit.propose._cache_size()
+            out["draft_ingest"] = self._dfns_jit.ingest._cache_size()
+            out["draft_insert"] = self._dfns_jit.insert._cache_size()
+            out["draft_prefill"] = self._dsfns_jit.prefill._cache_size()
         return out
 
     def program_costs(self, window: int) -> dict:
@@ -2094,6 +2505,7 @@ class SlotEngine:
                             np.zeros((self.n_slots, self.draft_k),
                                      np.int32),
                             np.zeros(self.n_slots, bool)).compile())
+                self._register_propose_cost(out, prof)
                 return out
             out["serve.window"] = prof.register_program(
                 "serve.window",
@@ -2131,7 +2543,26 @@ class SlotEngine:
                         np.zeros((self.n_slots, self.draft_k),
                                  np.int32),
                         np.zeros(self.n_slots, bool)).compile())
+            self._register_propose_cost(out, prof)
         return out
+
+    def _register_propose_cost(self, out: dict, prof) -> None:
+        """Register the learned drafter's batched propose program
+        (when armed) alongside window/verify — the profile serve
+        verb's roofline verdicts then cover the drafter's per-cycle
+        overhead with the same accounting as the programs it rides
+        between. No-op without a draft model. Caller holds the
+        `prof.compiling(None)` suppression."""
+        if self._dfns is None:
+            return
+        zc = np.zeros((self.n_slots, self.draft_k + 1), np.int32)
+        zi = np.zeros(self.n_slots, np.int32)
+        zb = np.zeros(self.n_slots, bool)
+        out["serve.propose"] = prof.register_program(
+            "serve.propose",
+            self._dfns_jit.propose.lower(
+                self._dparams, self._dcaches, self._dadapters,
+                self._tslot, zc, zi, zi, zb).compile())
 
     def cache_fingerprint(self) -> dict:
         """The identity an AOT-serialized executable is valid for: the
@@ -2164,6 +2595,16 @@ class SlotEngine:
             "adapter_rank": (int(self._adapters[0].shape[2])
                              if self._adapters else 0),
             "partition_rules": repr(self._partition_rules),
+            # the learned drafter compiles its own programs against
+            # its own dims — a same-target engine with a different
+            # (or no) drafter must read as a MISS for them
+            "draft_model": (None if self._dcfg is None else {
+                "embed_dim": self._dcfg.embed_dim,
+                "num_heads": self._dcfg.num_heads,
+                "num_blocks": self._dcfg.num_blocks,
+                "cache_dtype": str(jnp.dtype(self._dcfg.cache_dtype)),
+                "partition_rules": repr(self._draft_partition_rules),
+            }),
             "mesh_axes": {str(k): int(v)
                           for k, v in self._cfg.mesh.shape.items()},
             "devices": [f"{d.platform}:{d.id}"
@@ -2221,23 +2662,23 @@ class SlotEngine:
             i_nd = undonated(efns.insert)
             p_nd = undonated(efns.prefill_chunk)
             plans = [
-                ("window", "e", lambda: w_nd.lower(
+                ("window", "e", "window", lambda: w_nd.lower(
                     self._params, self._caches, self._pt, self._logits,
                     self._kd, self._pos, self._rem, self._eos,
                     self._scales, self._adapters, self._tslot, n_steps)),
-                ("insert", "e", lambda: i_nd.lower(
+                ("insert", "e", "insert", lambda: i_nd.lower(
                     self._logits, self._kd, self._pos, self._rem,
                     self._eos, self._tslot, logits1, np.int32(0),
                     np.int32(1), np.int32(1), np.int32(-1), np.int32(0),
                     kd0)),
-                ("prefill_chunk", "e", lambda: p_nd.lower(
+                ("prefill_chunk", "e", "prefill_chunk", lambda: p_nd.lower(
                     self._params, self._caches, self._pt, self._scales,
                     np.int32(0), np.zeros((1, c), np.int32),
                     np.int32(0), np.int32(0))),
             ]
         else:
             w_nd = undonated(efns.window, (10,))
-            plans = [("window", "e", lambda: w_nd.lower(
+            plans = [("window", "e", "window", lambda: w_nd.lower(
                 self._params, self._caches, self._logits, self._kd,
                 self._pos, self._rem, self._eos, self._scales,
                 self._adapters, self._tslot, n_steps))]
@@ -2247,28 +2688,55 @@ class SlotEngine:
                 p_nd = undonated(sfns.prefill_chunk)
                 i_nd = undonated(efns.insert)
                 plans.append(
-                    ("prefill_chunk", "s", lambda: p_nd.lower(
+                    ("prefill_chunk", "s", "prefill_chunk",
+                     lambda: p_nd.lower(
                         self._params, caches1, np.zeros((1, c), np.int32),
                         np.int32(0), np.int32(c))))
-                plans.append(("insert", "e", lambda: i_nd.lower(
+                plans.append(("insert", "e", "insert", lambda: i_nd.lower(
                     self._caches, self._logits, self._kd, self._pos,
                     self._rem, self._eos, self._tslot, self._scales,
                     caches1, logits1, np.int32(0), np.int32(1),
                     np.int32(1), np.int32(-1), np.int32(0), kd0)))
-        overlay_e, overlay_s = {}, {}
+        if self._dfns is not None:
+            # the learned drafter's per-cycle programs: propose +
+            # backlog ingest, cached under DRAFTER-distinct names (the
+            # target's "insert" already claims that key under this
+            # fingerprint). The draft insert stays in-process jit like
+            # the bucketed prefills — its inputs come from two
+            # producers (drafter prefill, init_caches) whose layouts
+            # an AOT executable could only match one of.
+            dfns = self._dfns_jit
+            zc = np.zeros((self.n_slots, self.draft_k + 1), np.int32)
+            zi = np.zeros(self.n_slots, np.int32)
+            zb = np.zeros(self.n_slots, bool)
+            pr_nd = undonated(dfns.propose)
+            g_nd = undonated(dfns.ingest)
+            plans.append(("propose", "d", "propose",
+                          lambda: pr_nd.lower(
+                              self._dparams, self._dcaches,
+                              self._dadapters, self._tslot, zc, zi,
+                              zi, zb)))
+            plans.append(("draft_ingest", "d", "ingest",
+                          lambda: g_nd.lower(
+                              self._dparams, self._dcaches, zc, zi,
+                              zb)))
+        overlay_e, overlay_s, overlay_d = {}, {}, {}
         with prof.naming_compiles("replica.spinup"):
-            for name, ns, lower in plans:
+            for name, ns, attr, lower in plans:
                 key = cache.key(program=name, fingerprint=fp)
                 exe = cache.load(key)
                 if exe is None:
                     exe = cache.compile_and_store(key, lower())
                 if name == "window":
                     exe = _AotWindow(exe, n_steps, efns.window)
-                (overlay_e if ns == "e" else overlay_s)[name] = exe
+                {"e": overlay_e, "s": overlay_s,
+                 "d": overlay_d}[ns][attr] = exe
         if overlay_e:
             self._efns = _AotPrograms(efns, overlay_e)
         if overlay_s:
             self._sfns = _AotPrograms(sfns, overlay_s)
+        if overlay_d:
+            self._dfns = _AotPrograms(self._dfns_jit, overlay_d)
 
     def warmup(self, n_steps: int, compile_cache=None) -> None:
         """Compile every program the serve loop will touch — so
@@ -2363,6 +2831,39 @@ class SlotEngine:
                 self._scales = self._efns.stamp_scales(
                     self._scales, np.int32(0),
                     np.full(self._l_pages, self.kv_pages, np.int32))
+        if self._dfns is not None:
+            # the learned drafter's chain, interleaved like the target
+            # loop above so every program sees every producer's
+            # (pinned) outputs: admission rows from BOTH producers (a
+            # fresh init_caches row for <=1-token prompts, a
+            # prefill-bucket row for the rest) scattered into state
+            # that has flowed through ingest AND propose — the serve
+            # loop's steady state admits into propose-output caches.
+            # Every row is dead (live all-False, slot 0 free), so the
+            # dispatches are bit-level no-ops and slot 0's garbage row
+            # is overwritten by any real admission's insert.
+            zc = np.zeros((self.n_slots, self.draft_k + 1), np.int32)
+            zi = np.zeros(self.n_slots, np.int32)
+            zb = np.zeros(self.n_slots, bool)
+            drow = self._dsfns.init_caches(1)
+            self._dcaches = self._dfns.insert(self._dcaches, drow,
+                                              np.int32(0))
+            for b in prefill_buckets(self.t_max, self._n_ring):
+                _, drow = self._dsfns.prefill(
+                    self._dparams, np.zeros((1, b), np.int32),
+                    np.int32(b))
+                self._dcaches = self._dfns.ingest(
+                    self._dparams, self._dcaches, zc, zi, zb)
+                self._dcaches, _ = self._dfns.propose(
+                    self._dparams, self._dcaches, self._dadapters,
+                    self._tslot, zc, zi, zi, zb)
+                self._dcaches = self._dfns.insert(
+                    self._dcaches, drow, np.int32(0))
+            self._dcaches = self._dfns.ingest(
+                self._dparams, self._dcaches, zc, zi, zb)
+            self._dcaches, _ = self._dfns.propose(
+                self._dparams, self._dcaches, self._dadapters,
+                self._tslot, zc, zi, zi, zb)
         # the health reduce is part of the armed serve loop's steady
         # state (one dispatch per cycle) — warm it with everything else
         self.slot_health()

@@ -223,6 +223,10 @@ class ServingMetrics:
         self.spec_accepted = 0
         self.spec_emitted = 0
         self.spec_slot_verifies = 0
+        # drafting-pass wall time (host scans + the learned drafter's
+        # batched dispatch) — the draft-overhead numerator
+        self.propose_s = 0.0
+        self.propose_calls = 0
         self.submitted = 0
         self.rejected = 0
         self.timed_out = 0
@@ -471,6 +475,16 @@ class ServingMetrics:
         self._log(event="serve_spec_verify", drafted=drafted,
                   accepted=accepted, emitted=emitted, slots=slots)
 
+    def on_propose(self, seconds: float) -> None:
+        """One drafting pass completed (scheduler._propose_drafts):
+        `seconds` of wall time spent producing proposals — the n-gram
+        scans and/or the learned drafter's batched device dispatch.
+        Rollup only (one call per cycle; no per-cycle event spam, no
+        new exposition lines — the /metrics byte-equality gates
+        stay)."""
+        self.propose_s += float(seconds)
+        self.propose_calls += 1
+
     # -- paged KV ---------------------------------------------------------
 
     def on_pages(self, *, pages_total: int, pages_used: int,
@@ -648,6 +662,12 @@ class ServingMetrics:
             "serve_spec_tokens_per_dispatch": (
                 round(self.spec_emitted / self.spec_slot_verifies, 3)
                 if self.spec_slot_verifies else None),
+            # draft-model overhead: total drafting-pass wall seconds
+            # (None when speculation never drafted — spec-off runs
+            # keep their summary shape unchanged)
+            "serve_spec_propose_s": (
+                round(self.propose_s, 6) if self.propose_calls
+                else None),
             # paged-KV rollup (additive, ISSUE 11): pool size and peak
             # occupancy, the peak tokens-resident-per-HBM-byte the
             # capacity claim is stated in, and how often the pool ran

@@ -249,6 +249,10 @@ class Scheduler:
             health_checks = retry is not None or fault_plan is not None
         self.health_checks = bool(health_checks)
         self._retrying: list[Entry] = []
+        # cumulative wall seconds spent in the drafting pass (host
+        # scans + the learned drafter's batched device dispatch) — the
+        # numerator of the bench's draft-overhead-percent key
+        self.propose_seconds = 0.0
         self._cycle = 0
         self._closed = False
         # drain mode (elastic scale-down / SIGTERM): submits refuse
@@ -1065,7 +1069,16 @@ class Scheduler:
 
         Slots that have room but no proposal still participate
         (vlive) with zeroed drafts: a verify row whose drafts all
-        miss emits exactly the one token a window step would."""
+        miss emits exactly the one token a window step would.
+
+        Drafters advertising `propose_batched` (the learned
+        models/draft_lm.DraftLM, ChainedDrafter wrapping one) get ONE
+        call covering every running slot — the engine-resident path
+        dispatches a single jitted propose program for the whole
+        batch. Host drafters keep the per-slot scan. Either way, every
+        proposal flows through the `_check_proposal` choke point, and
+        the wall time of the whole drafting pass accrues to
+        `propose_seconds` (the bench's draft-overhead key)."""
         eng = self.engine
         k = eng.draft_k
         # room check FIRST, across every slot: one slot without room
@@ -1078,24 +1091,77 @@ class Scheduler:
         drafts = np.zeros((eng.n_slots, k), np.int32)
         vlive = np.zeros(eng.n_slots, bool)
         proposed = np.zeros(eng.n_slots, bool)
+        slots, hists = [], []
         for slot, e in self._running.items():
             vlive[slot] = True
-            hist = np.concatenate([
+            slots.append(slot)
+            hists.append(np.concatenate([
                 np.asarray(e.prompt, np.int64).ravel(),
-                np.asarray(e.tokens + just.get(id(e), []), np.int64)])
-            prop = self.drafter.propose(hist)
+                np.asarray(e.tokens + just.get(id(e), []), np.int64)]))
+        t0 = self.clock()
+        batched = getattr(self.drafter, "propose_batched", None)
+        if batched is not None:
+            props = batched(eng, slots, hists)
+        else:
+            props = {s: self.drafter.propose(h)
+                     for s, h in zip(slots, hists)}
+        dt = self.clock() - t0
+        self.propose_seconds += dt
+        if self.metrics:
+            on_prop = getattr(self.metrics, "on_propose", None)
+            if on_prop is not None:
+                on_prop(dt)
+        for slot in slots:
+            prop = self._check_proposal(props.get(slot), k)
             if prop is None:
                 continue
-            prop = np.asarray(prop, np.int32).ravel()
-            if prop.shape[0] != k:
-                raise ValueError(
-                    f"drafter proposed {prop.shape[0]} tokens; the "
-                    f"verify program is compiled at exactly {k}")
             drafts[slot] = prop
             proposed[slot] = True
         if not proposed.any():
             return None
         return drafts, vlive, proposed
+
+    def _check_proposal(self, prop, k: int):
+        """The ONE validation choke point between any `propose()`
+        return and `begin_verify`: a malformed proposal raises a
+        teaching error here, naming the drafter class and the
+        contract, instead of flowing raw into the verify dispatch
+        (where a float dtype jit-misses a new program, a 2-D shape
+        trips an opaque reshape, and an out-of-vocab id is silently
+        CLAMPED by the embedding gather — verified as a different
+        token than proposed). None passes through: it is the
+        contract's honest nothing-to-verify answer."""
+        if prop is None:
+            return None
+        name = type(self.drafter).__name__
+        contract = (f"the models/draft.py contract: propose(history) "
+                    f"-> np.ndarray [k={k}] integer token ids in "
+                    f"[0, {self.engine.vocab}), or None")
+        arr = np.asarray(prop)
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise ValueError(
+                f"{name}.propose returned dtype {arr.dtype}: draft "
+                f"tokens are ids the verify program compares against "
+                f"the target's own integer picks — {contract}")
+        if arr.ndim != 1:
+            raise ValueError(
+                f"{name}.propose returned shape {tuple(arr.shape)}: "
+                f"the verify program takes ONE flat row of drafts per "
+                f"slot — {contract}")
+        if arr.shape[0] != k:
+            raise ValueError(
+                f"{name}.propose returned {arr.shape[0]} tokens; the "
+                f"verify program is compiled at exactly k={k} — "
+                f"{contract}")
+        vocab = self.engine.vocab
+        if (arr < 0).any() or (arr >= vocab).any():
+            bad = arr[(arr < 0) | (arr >= vocab)][0]
+            raise ValueError(
+                f"{name}.propose returned out-of-vocab id {int(bad)} "
+                f"(vocab is {vocab}): the verify embedding gather "
+                f"would silently clamp it and accept-check a "
+                f"DIFFERENT token than proposed — {contract}")
+        return arr.astype(np.int32)
 
     def drain(self) -> list[Entry]:
         """Tick until every queued and running request has finished."""

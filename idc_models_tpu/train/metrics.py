@@ -22,8 +22,15 @@ def auto_accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     Keras `metrics=['accuracy']` auto-selection (dist_model_tf_vgg.py:132
     vs dist_model_tf_dense.py:144). Sequence logits [B,T,V] with token
     labels [B,T] (the LM convention, models/lm.py) score shifted
-    next-token accuracy, matching `next_token_loss`'s objective."""
+    next-token accuracy, matching `next_token_loss`'s objective.
+    Soft labels [B,T,V] (teacher logits, models/draft_lm.py distillation)
+    score UNSHIFTED greedy agreement — teacher and student logits at
+    position t both predict token t+1, so no shift applies."""
     if logits.ndim == 3 and logits.shape[-1] > 1:
+        if labels.ndim == 3:
+            pred = jnp.argmax(logits, -1)
+            return jnp.mean((pred == jnp.argmax(labels, -1))
+                            .astype(jnp.float32))
         pred = jnp.argmax(logits[:, :-1], -1)
         return jnp.mean((pred == labels[:, 1:].astype(pred.dtype))
                         .astype(jnp.float32))

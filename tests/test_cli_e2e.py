@@ -19,6 +19,20 @@ pytestmark = pytest.mark.filterwarnings(
     "ignore:.*synthetic.*:UserWarning")
 
 
+@pytest.fixture(autouse=True)
+def _restore_backend_roofs():
+    """`profile --peak-tflops/--peak-gbps` registers the declared roof
+    under the live device kind ("cpu" here) in the process-global
+    BACKEND_ROOFS — restore it so tests/test_profile.py's
+    unknown-backend assertions see the pristine table."""
+    from idc_models_tpu.observe import profile as prof
+
+    saved = dict(prof.BACKEND_ROOFS)
+    yield
+    prof.BACKEND_ROOFS.clear()
+    prof.BACKEND_ROOFS.update(saved)
+
+
 def _run(args, capsys):
     assert cli.main(args) == 0
     return capsys.readouterr().out
@@ -359,6 +373,51 @@ def test_cli_serve_synthetic_trace(tmp_path, capsys):
                 "--vocab", "11", "--embed-dim", "16", "--num-heads", "2",
                 "--mlp-dim", "32", "--num-blocks", "1"], capsys)
     assert "serving 2 requests" in out and "served: ok=2" in out
+
+
+def test_cli_serve_drafter_learned_and_usage_gates(tmp_path, capsys):
+    """`serve --drafter chained --draft-ckpt DIR` from the product
+    surface: an (untrained) distilled checkpoint loads, the chained
+    drafter serves the trace, and the speculative epilogue names the
+    drafter and its propose accounting. Usage misfits — a learned
+    drafter without its checkpoint, a drafter outside the speculative
+    loop, an orphaned checkpoint, a vocab mismatch — die as teaching
+    errors before any device work."""
+    import jax
+
+    from idc_models_tpu.models import draft_lm as dlm
+
+    cfg = dlm.draft_config(11, 32)
+    dparams = dlm.draft_lm(cfg).init(jax.random.key(5)).params
+    ckpt = str(tmp_path / "draft_ckpt")
+    dlm.save_draft_lm(ckpt, jax.device_get(dparams),
+                      config=cfg).wait()
+    dims = ["--host-devices", "8", "--requests", "4", "--slots", "2",
+            "--window", "4", "--t-max", "32", "--vocab", "11",
+            "--embed-dim", "16", "--num-heads", "2", "--mlp-dim",
+            "32", "--num-blocks", "1"]
+    out = _run(["serve", *dims, "--spec-decode", "--draft-k", "3",
+                "--drafter", "chained", "--draft-ckpt", ckpt], capsys)
+    assert "served: ok=4" in out
+    assert "speculative (chained):" in out
+    assert "propose_s=" in out
+    # usage gates: each one a SystemExit that says what to change
+    with pytest.raises(SystemExit):
+        cli.main(["serve", *dims, "--spec-decode", "--draft-k", "3",
+                  "--drafter", "learned"])        # no --draft-ckpt
+    with pytest.raises(SystemExit):
+        cli.main(["serve", *dims, "--drafter", "learned",
+                  "--draft-ckpt", ckpt])          # no --spec-decode
+    with pytest.raises(SystemExit):
+        cli.main(["serve", *dims, "--spec-decode", "--draft-k", "3",
+                  "--draft-ckpt", ckpt])          # ckpt with ngram
+    # tokenizer mismatch: vocab-11 checkpoint against a --vocab 13
+    # target dies naming both vocabs
+    dims13 = [a if a != "11" else "13" for a in dims]
+    with pytest.raises(SystemExit) as e:
+        cli.main(["serve", *dims13, "--spec-decode", "--draft-k", "3",
+                  "--drafter", "learned", "--draft-ckpt", ckpt])
+    assert "vocab" in str(e.value)
 
 
 def test_cli_serve_chunked_prefix_int8(tmp_path, capsys):
@@ -756,6 +815,7 @@ def test_cli_profile_serve(tmp_path, capsys):
                 "--steps", "5", "--path", str(tmp_path)], capsys)
     assert "profile: serve decode loop" in out
     assert "serve.window" in out and "serve.prefill" in out
+    assert "serve.propose" in out        # drafter roofline rides along
     assert "serve.tick" in out
     recs = [json.loads(l) for l in
             (tmp_path / "logs" / "profile.jsonl").read_text()

@@ -540,6 +540,12 @@ TMAX_KV_ALLOWLIST = {
         "the CONTIGUOUS-mode constructor: per-slot [t_max] ring rows "
         "are exactly what that mode is — the paged twin "
         "(_paged_engine_fns) allocates the page pool instead",
+    ("idc_models_tpu/serve/engine.py", "_drafter_fns.init_caches.mk"):
+        "the learned DRAFTER's ring: the draft LM is deliberately "
+        "tiny (a few-MB student), so per-slot [t_max] rows cost "
+        "kilobytes per slot and keep the batched propose ONE jitted "
+        "program — paging the student would buy nothing and add a "
+        "second page table to every slot lifecycle op",
 }
 
 
@@ -1026,3 +1032,92 @@ def test_checkpoint_writes_only_through_atomic_commit():
     stale = set(CKPT_WRITE_ALLOWLIST) - live
     assert not stale, (
         f"checkpoint write allowlist entries match no code: {stale}")
+
+
+# -- serve --drafter registry lockstep ----------------------------------
+#
+# cli.SERVE_DRAFTERS maps each `serve --drafter` choice to the class
+# implementing it. Drift in either direction is a silent failure: a
+# table entry naming a class without `propose` dies deep inside the
+# scheduler on the first speculative cycle, and a drafter class added
+# to models/ but left out of the table simply cannot be reached from
+# the CLI. Classes implementing the contract for composition or
+# testing only (deliberately NOT CLI-selectable) document themselves
+# here — each entry says why.
+DRAFTER_TABLE_EXEMPT = {
+    # none today: every propose-bearing class under models/draft*.py
+    # is CLI-reachable
+}
+
+_DRAFTER_FILES = ("models/draft.py", "models/draft_lm.py")
+
+
+def _propose_bearing_classes():
+    found = set()
+    for rel in _DRAFTER_FILES:
+        tree = ast.parse((PACKAGE / rel).read_text(), filename=rel)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and any(
+                    isinstance(b, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))
+                    and b.name == "propose" for b in node.body):
+                found.add(node.name)
+    return found
+
+
+def test_serve_drafter_table_entries_implement_the_contract():
+    import importlib
+
+    from idc_models_tpu.cli import SERVE_DRAFTERS
+
+    for name, (module, cls_name, story) in SERVE_DRAFTERS.items():
+        cls = getattr(importlib.import_module(module), cls_name)
+        assert callable(getattr(cls, "propose", None)), (
+            f"--drafter {name} maps to {module}.{cls_name}, which "
+            f"has no propose(): every SERVE_DRAFTERS entry must "
+            f"implement the models/draft.py contract")
+        assert story, f"--drafter {name} carries no help story"
+
+
+def test_every_drafter_class_is_cli_reachable_or_exempt():
+    from idc_models_tpu.cli import SERVE_DRAFTERS
+
+    listed = {cls for _mod, cls, _story in SERVE_DRAFTERS.values()}
+    bearing = _propose_bearing_classes()
+    orphans = bearing - listed - set(DRAFTER_TABLE_EXEMPT)
+    assert not orphans, (
+        "drafter class defines propose() but is reachable from "
+        "neither `serve --drafter` (cli.SERVE_DRAFTERS) nor the "
+        "documented DRAFTER_TABLE_EXEMPT — wire it into the table or "
+        f"document why it is composition-only: {sorted(orphans)}")
+    stale = set(DRAFTER_TABLE_EXEMPT) - bearing
+    assert not stale, (
+        f"drafter exemptions match no propose-bearing class: "
+        f"{sorted(stale)}")
+
+
+def test_drafter_argparse_choices_stay_in_lockstep():
+    """The `--drafter` choices expression must be DERIVED from
+    SERVE_DRAFTERS (not a hand-written list), so adding a table entry
+    automatically surfaces it in argparse — and vice versa a choices
+    edit without a table entry is impossible."""
+    tree = ast.parse((PACKAGE / "cli.py").read_text(),
+                     filename="cli.py")
+    hit = None
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "--drafter"):
+            hit = node
+    assert hit is not None, "serve grew no --drafter flag"
+    choices = next((kw.value for kw in hit.keywords
+                    if kw.arg == "choices"), None)
+    assert choices is not None, "--drafter has no choices= keyword"
+    names = {n.id for n in ast.walk(choices)
+             if isinstance(n, ast.Name)}
+    assert "SERVE_DRAFTERS" in names, (
+        "--drafter choices are hand-written instead of derived from "
+        "SERVE_DRAFTERS — the two will drift")
