@@ -736,6 +736,23 @@ def _parse(argv):
                     help="drain drill: gracefully drain replica INDEX "
                          "after --kill-after-steps router steps "
                          "(placement stops, in-flight work completes)")
+    sp.add_argument("--metrics-port", type=int, default=None,
+                    help="serve the FLEET observability surfaces on "
+                         "127.0.0.1:PORT for the run's duration: GET "
+                         "/metrics merges every replica's registry "
+                         "into one replica-labeled exposition plus "
+                         "fleet rollups, GET /healthz embeds every "
+                         "replica's health document with autoscaler "
+                         "and compile-cache state (0 = OS-assigned "
+                         "port, printed; serve/cluster/telemetry.py)")
+    sp.add_argument("--watchdog", action="store_true",
+                    help="arm the cluster anomaly watchdogs "
+                         "(speculative accept-rate collapse, per-"
+                         "replica compile churn, migration-rate "
+                         "spikes, canary-vs-baseline SLO divergence): "
+                         "one detector pass per router step, each "
+                         "firing emits a frozen cluster_anomaly jsonl "
+                         "record and bumps cluster_anomalies_total")
 
     sp = sub.add_parser(
         "profile",
@@ -821,8 +838,12 @@ def _parse(argv):
                              "percentiles over every numeric field, "
                              "timer/span timing tables, and the last "
                              "metrics snapshot — no re-run needed")
-    sp.add_argument("jsonl", help="path to a run.jsonl / serve.jsonl / "
-                                  "exported span jsonl")
+    sp.add_argument("jsonl", nargs="+",
+                    help="path(s) to run.jsonl / serve.jsonl / "
+                         "exported span jsonl — several files (e.g. "
+                         "every replica's log plus the router's) "
+                         "merge into ONE summary, so --request "
+                         "renders a cross-replica timeline")
     sp.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead "
                          "of the human table (includes the per-request "
@@ -1011,10 +1032,11 @@ def _run_stats(ns):
         format_request_timeline, format_summary, summarize_jsonl,
     )
 
-    p = Path(ns.jsonl)
-    if not p.exists():
-        sys.exit(f"stats: no such file: {p}")
-    summary = summarize_jsonl(p)
+    paths = [Path(p) for p in ns.jsonl]
+    for p in paths:
+        if not p.exists():
+            sys.exit(f"stats: no such file: {p}")
+    summary = summarize_jsonl(paths[0] if len(paths) == 1 else paths)
     if ns.request is not None:
         # format_request_timeline owns the unknown-rid message (KeyError)
         # — rendering even on the --json path keeps one validation site
@@ -2692,6 +2714,9 @@ def _run_serve_cluster(ns):
     if ns.autoscale_max is not None and ns.autoscale_max < ns.replicas:
         sys.exit(f"--autoscale-max {ns.autoscale_max} must be >= "
                  f"--replicas {ns.replicas} (it is the fleet ceiling)")
+    if ns.metrics_port is not None and not 0 <= ns.metrics_port <= 65535:
+        sys.exit(f"--metrics-port {ns.metrics_port} must be in "
+                 f"[0, 65535] (0 = OS-assigned)")
 
     logger = (JsonlLogger(Path(ns.path) / "logs" / "cluster.jsonl")
               if ns.path else None)
@@ -2767,6 +2792,29 @@ def _run_serve_cluster(ns):
                        else ns.hedge_after_ms / 1e3),
         prefix_registry=registry, logger=logger,
         autoscaler=autoscaler, replica_factory=replica_factory)
+    # fleet observability (ISSUE 20, serve/cluster/telemetry.py):
+    # merged replica-labeled /metrics + fleet /healthz, armed BEFORE
+    # the trace so a scraper sees the fleet from its first placement
+    exporter = None
+    if ns.metrics_port is not None:
+        from idc_models_tpu.observe import MetricsExporter
+        from idc_models_tpu.serve import ClusterTelemetry
+
+        telemetry = ClusterTelemetry(router,
+                                     compile_cache=compile_cache)
+        try:
+            exporter = MetricsExporter(
+                router.registry, port=ns.metrics_port,
+                cluster=telemetry).start()
+        except OSError as e:
+            sys.exit(f"serve-cluster: cannot bind --metrics-port "
+                     f"{ns.metrics_port}: {e}")
+        print(f"fleet metrics: {exporter.url}/metrics  healthz: "
+              f"{exporter.url}/healthz")
+    if ns.watchdog:
+        from idc_models_tpu.serve import ClusterWatchdog
+
+        router.watchdog = ClusterWatchdog(router, logger=logger)
     if ns.trace:
         trace = load_trace(ns.trace)
     else:
@@ -2829,6 +2877,8 @@ def _run_serve_cluster(ns):
                 results = router.results()
     finally:
         _disarm_sigterm(prev_sigterm)
+        if exporter is not None:
+            exporter.close()
     if drained_on_signal:
         print("SIGTERM: cluster drained gracefully — admissions "
               "stopped, in-flight requests finished on every live "
@@ -2870,6 +2920,12 @@ def _run_serve_cluster(ns):
         print(f"prefix registry: {summary['cluster_prefix_hits']} "
               f"hit(s), {summary['cluster_prefix_published']} "
               f"published, {summary['cluster_prefix_bytes']} bytes")
+    if router.watchdog is not None:
+        kinds = sorted({a["kind"]
+                        for a in router.watchdog.anomalies})
+        print(f"watchdog: {len(router.watchdog.anomalies)} "
+              f"anomaly(ies)"
+              + (f" ({', '.join(kinds)})" if kinds else ""))
     print("cluster summary:", json.dumps(summary))
     if logger:
         logger.log(event="cluster_summary", **summary)

@@ -55,8 +55,14 @@ class MetricsExporter:
     """
 
     def __init__(self, registry: mreg.MetricsRegistry | None = None, *,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1", cluster=None):
         self.registry = registry if registry is not None else mreg.REGISTRY
+        # a serve.cluster.ClusterTelemetry arms the FLEET surfaces:
+        # /metrics serves the merged replica-labeled registry (with
+        # rollups) and /healthz the fleet document. None keeps the
+        # single-process surfaces byte-identical to their historical
+        # shape.
+        self.cluster = cluster
         self._host = host
         self._requested_port = int(port)
         self._server: ThreadingHTTPServer | None = None
@@ -77,7 +83,10 @@ class MetricsExporter:
             def do_GET(self):
                 try:
                     if self.path in ("/metrics", "/metrics/"):
-                        body = exporter.registry.prometheus_text().encode()
+                        text = (exporter.cluster.prometheus_text()
+                                if exporter.cluster is not None
+                                else exporter.registry.prometheus_text())
+                        body = text.encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                     elif self.path in ("/healthz", "/healthz/"):
                         body = (json.dumps(exporter.health())
@@ -139,7 +148,11 @@ class MetricsExporter:
     def health(self) -> dict:
         """The /healthz document, from the registry's gauges alone (no
         reference into the scheduler: any process that maintains the
-        gauges gets an honest health surface)."""
+        gauges gets an honest health surface). Cluster-armed exporters
+        serve the fleet document instead — every replica's health doc
+        embedded, plus autoscaler and compile-cache state."""
+        if self.cluster is not None:
+            return self.cluster.health()
 
         def gauge_value(name):
             # the health gauges are unlabeled by contract — a labeled

@@ -169,6 +169,34 @@ class Histogram(_Instrument):
                     break
             # values above the top bucket land only in +Inf (= count)
 
+    def merge_state(self, state: dict, **labels) -> None:
+        """Fold one exported series state (`_series()`'s value shape:
+        raw per-bucket counts plus count/sum/min/max) into this
+        histogram's series for `labels` — the fleet-merge path
+        (serve/cluster/telemetry.py) relabels a whole per-replica
+        histogram in one call instead of replaying every observation.
+        Bucket layouts must match: a merged distribution across two
+        grids has no honest bucket counts."""
+        if len(state["buckets"]) != len(self.buckets):
+            raise ValueError(
+                f"histogram {self.name!r}: cannot merge a series with "
+                f"{len(state['buckets'])} buckets into {len(self.buckets)}")
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                self._values[key] = {
+                    "buckets": list(state["buckets"]),
+                    "count": state["count"], "sum": state["sum"],
+                    "min": state["min"], "max": state["max"]}
+                return
+            st["buckets"] = [a + b for a, b in
+                             zip(st["buckets"], state["buckets"])]
+            st["count"] += state["count"]
+            st["sum"] += state["sum"]
+            st["min"] = min(st["min"], state["min"])
+            st["max"] = max(st["max"], state["max"])
+
 
 class MetricsRegistry:
     """Name -> instrument table with idempotent registration and the

@@ -277,6 +277,24 @@ class SLOEngine:
                                     burn_long=round(burn_l, 4))
         return fired
 
+    def state_doc(self) -> dict:
+        """Per-objective live state for an embedding health document
+        (the fleet /healthz, ISSUE 20): breached flag, current burn
+        rates, and alerts fired so far — read off the gauges this
+        engine already maintains, so the document and /metrics can
+        never disagree."""
+        return {
+            name: {
+                "kind": self.slos[name].kind,
+                "breached": self._alerting[name],
+                "burn_short": self._g_burn.value(
+                    default=0.0, slo=name, window="short"),
+                "burn_long": self._g_burn.value(
+                    default=0.0, slo=name, window="long"),
+                "alerts": int(self._c_alerts.value(slo=name)),
+            }
+            for name in sorted(self.slos)}
+
     def breached(self, name: str | None = None) -> bool:
         """Live alert state for `name` — the signal an admission policy
         consumes (shed/deprioritize while True). With ``name=None``,

@@ -38,17 +38,29 @@ def _num_stats(values: list[float]) -> dict:
 
 def summarize_jsonl(path) -> dict:
     """Parse a run jsonl into the summary dict `format_summary` prints.
-    Unparseable lines are counted, never fatal (a crash mid-write can
-    truncate the final line of an append-only log)."""
-    path = Path(path)
+    Accepts one path or a list of paths — the CLUSTER case: the router
+    and each replica write their own files, and merging them here is
+    what turns N per-process logs into one fleet view (`JsonlLogger`
+    stamps epoch-seconds ``ts`` and span exports epoch ``wall``, so
+    records from different processes share one time axis and the
+    per-request timelines sort correctly across files). Unparseable
+    lines are counted, never fatal (a crash mid-write can truncate the
+    final line of an append-only log)."""
+    paths = ([Path(p) for p in path]
+             if isinstance(path, (list, tuple)) else [Path(path)])
     records, bad = [], 0
-    for line in path.read_text().splitlines():
-        if not line.strip():
-            continue
-        try:
-            records.append(json.loads(line))
-        except ValueError:
-            bad += 1
+    # files concatenate in argument order (NOT globally re-sorted):
+    # span self-time segmentation depends on each tracer's records
+    # staying contiguous; the timelines sort by wall time themselves
+    for p in paths:
+        for line in p.read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                bad += 1
+    path = paths[0] if len(paths) == 1 else "+".join(map(str, paths))
     by_event: dict[str, dict] = {}
     timers: dict[str, list[float]] = {}
     spans: dict[str, list[float]] = {}
@@ -272,11 +284,16 @@ def _request_timelines(records: list[dict]) -> dict:
     rid-stamped span records from a tracer's jsonl export. Each entry:
     {"t_s": seconds since the request's first record, "what": event or
     span name, "dur_ms": span duration (events: None), "detail": the
-    record's other fields}."""
+    record's other fields}. cluster_* hop events (router placement,
+    handoff, hedge, migration — ISSUE 20) join the serve_* events, so
+    a MERGED cluster log renders one end-to-end cross-replica
+    timeline."""
     reqs: dict[str, list] = {}
     for r in records:
         ev = r.get("event")
-        if (isinstance(ev, str) and ev.startswith("serve_")
+        if (isinstance(ev, str)
+                and (ev.startswith("serve_")
+                     or ev.startswith("cluster_"))
                 and "id" in r):
             reqs.setdefault(str(r["id"]), []).append({
                 "_wall": r.get("ts"), "what": ev, "dur_ms": None,
@@ -457,14 +474,23 @@ def format_request_timeline(summary: dict, rid: str) -> str:
                        f"{': ' + preview if known else ''})")
     out = [f"request {rid} — {len(entries)} records "
            f"({summary['path']}):"]
+    prev = None
     for e in entries:
         t = ("t+?     " if e["t_s"] is None
              else f"t+{e['t_s'] * 1e3:9.3f}ms")
+        # per-hop latency attribution: wall time since the PREVIOUS
+        # timeline record, so "where did the request wait" reads
+        # straight off the merged cluster view
+        delta = ""
+        if e["t_s"] is not None:
+            if prev is not None:
+                delta = f" (+{(e['t_s'] - prev) * 1e3:.3f}ms)"
+            prev = e["t_s"]
         dur = (f" [{e['dur_ms']:.3f} ms]"
                if isinstance(e.get("dur_ms"), (int, float)) else "")
         detail = " ".join(
             f"{k}={v}" for k, v in sorted(e["detail"].items())
             if v is not None)
         out.append(f"  {t}  {e['what']:22s}{dur}"
-                   + (f"  {detail}" if detail else ""))
+                   + (f"  {detail}" if detail else "") + delta)
     return "\n".join(out)

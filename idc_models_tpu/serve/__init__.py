@@ -3,8 +3,8 @@ from idc_models_tpu.serve.api import (  # noqa: F401
 )
 from idc_models_tpu.serve.brownout import BrownoutController  # noqa: F401
 from idc_models_tpu.serve.cluster import (  # noqa: F401
-    AutoscaleConfig, Autoscaler, PrefixRegistry, Replica, Router,
-    build_replica,
+    AutoscaleConfig, Autoscaler, ClusterTelemetry, ClusterWatchdog,
+    PrefixRegistry, Replica, Router, WatchdogConfig, build_replica,
 )
 from idc_models_tpu.serve.compile_cache import (  # noqa: F401
     CompileCache, enable_persistent_xla_cache,

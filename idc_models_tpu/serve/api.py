@@ -268,9 +268,13 @@ class LMServer:
 
     # -- synchronous API -------------------------------------------------
 
-    def submit(self, request: Request) -> bool:
+    def submit(self, request: Request, *,
+               parent_span=None) -> bool:
         """Enqueue a request. False = backpressure (queue at max depth);
-        raises ValueError for requests that could never be served."""
+        raises ValueError for requests that could never be served.
+        `parent_span` (a span id) parents this request's serve.request
+        span under a caller-owned span — the cluster router passes its
+        cluster.request root so the cross-replica export is one tree."""
         from idc_models_tpu.serve.scheduler import Entry
 
         prior = self._results.get(request.id)
@@ -292,6 +296,7 @@ class LMServer:
             rng=request.seed,
             deadline=request.deadline_s,
             trace_id=request.trace_id,
+            parent_span=parent_span,
             tenant=request.tenant)
         ok = self.scheduler.submit(entry)
         if not ok:

@@ -8,3 +8,6 @@ from idc_models_tpu.serve.cluster.replica import (  # noqa: F401
     Replica, build_replica,
 )
 from idc_models_tpu.serve.cluster.router import Router  # noqa: F401
+from idc_models_tpu.serve.cluster.telemetry import (  # noqa: F401
+    ClusterTelemetry, ClusterWatchdog, WatchdogConfig,
+)

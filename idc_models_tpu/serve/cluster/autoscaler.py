@@ -179,3 +179,22 @@ class Autoscaler:
         if self.logger is not None:
             self.logger.log(event="autoscale_decision", **rec)
         return rec
+
+    def state_doc(self) -> dict:
+        """The autoscaler block the fleet /healthz embeds (ISSUE 20):
+        the policy bounds and dwell/cooldown knobs plus the LIVE
+        hysteresis clocks — an operator reading the document can tell
+        "quiet" from "a scale signal is dwelling right now" from
+        "cooling down after an action"."""
+        return {
+            "min_replicas": self.cfg.min_replicas,
+            "max_replicas": self.cfg.max_replicas,
+            "queue_high": self.cfg.queue_high,
+            "queue_low": self.cfg.queue_low,
+            "dwell_s": self.cfg.dwell_s,
+            "cooldown_s": self.cfg.cooldown_s,
+            "up_since": self.state["up_since"],
+            "down_since": self.state["down_since"],
+            "last_action_t": self.state["last_action_t"],
+            "decisions": len(self.decisions),
+        }

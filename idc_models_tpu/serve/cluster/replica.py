@@ -52,12 +52,12 @@ class Replica:
 
     # -- the serving surface the router drives ---------------------------
 
-    def submit(self, request) -> bool:
+    def submit(self, request, *, parent_span=None) -> bool:
         if self.state != "live":
             raise RuntimeError(
                 f"replica {self.replica_id} is {self.state} — the "
                 f"router must not place on it")
-        return self.server.submit(request)
+        return self.server.submit(request, parent_span=parent_span)
 
     def step(self):
         """One scheduler tick; stamps the host-side freshness the

@@ -65,6 +65,7 @@ from idc_models_tpu.observe import trace
 from idc_models_tpu.serve.api import Request, Result
 from idc_models_tpu.serve.journal import pending_requests
 from idc_models_tpu.serve.metrics import aggregate_summaries
+from idc_models_tpu.serve.scheduler import _next_trace_id
 
 
 def _entry_request(entry) -> Request:
@@ -150,6 +151,9 @@ class Router:
         self.logger = logger
         self.clock = clock
         reg = registry if registry is not None else mreg.REGISTRY
+        # kept public: ClusterTelemetry folds the router's own
+        # cluster_* series into the fleet exposition from here
+        self.registry = reg
         self._m_placements = reg.counter(
             "cluster_placements_total",
             "requests placed on a replica by the router",
@@ -246,6 +250,24 @@ class Router:
         self.cluster_sheds = 0
         # the open weight rollout, if any (start_rollout/finish_rollout)
         self._rollout: dict | None = None
+        # an armed ClusterWatchdog (serve/cluster/telemetry.py) runs
+        # its detector pass once per step — assigned after
+        # construction (the watchdog needs the router to exist first)
+        self.watchdog = None
+        # fleet trace context (ISSUE 20): the router assigns each
+        # request its trace_id AT THE DOOR (so every hop event carries
+        # it even before any replica accepts the work), numbers the
+        # hops per request, and holds one detached cluster.request root
+        # span per in-flight request — each replica's serve.request
+        # span opens as its child, so the merged cross-process span
+        # export is one tree under one trace_id
+        self._trace_ids: dict[str, str] = {}
+        self._hop_seq: dict[str, int] = {}
+        self._root_span: dict[str, object] = {}
+        # rid -> source replica_id of a pending from-the-prompt
+        # re-placement (drain or failover) so the cluster_migrate hop
+        # can name where the work came FROM, not just where it landed
+        self._migration_src: dict[str, str] = {}
 
     # -- placement --------------------------------------------------------
 
@@ -282,11 +304,52 @@ class Router:
                 return home
         return best
 
+    # -- fleet trace context (ISSUE 20) -----------------------------------
+
+    def _hop(self, rid) -> int:
+        """The next hop sequence number for `rid` — every placement/
+        handoff/hedge/migration/canary event a request crosses gets one,
+        so the merged timeline orders hops even when two land inside
+        one wall-clock tick."""
+        n = self._hop_seq.get(rid, 0) + 1
+        self._hop_seq[rid] = n
+        return n
+
+    def _trace_context(self, request: Request) -> Request:
+        """Stamp the router-assigned trace_id onto `request` — assigned
+        once per rid at the fleet door and sticky across re-offers,
+        re-placements, and hedges, so every hop event and every
+        replica-side span carries ONE identity. A caller-provided (or
+        journal-recovered) trace_id is adopted, never replaced."""
+        tid = self._trace_ids.get(request.id)
+        if tid is None:
+            tid = request.trace_id or _next_trace_id()
+            self._trace_ids[request.id] = tid
+        if request.trace_id != tid:
+            request = dataclasses.replace(request, trace_id=tid)
+        return request
+
+    def _finalize_trace(self, rid, status) -> None:
+        """Close the request's cluster.request root span (hop count as
+        the closing attribute) and drop its trace bookkeeping — every
+        terminal path (normal finish, shed, failover loss) funnels
+        through here so nothing leaks."""
+        root = self._root_span.pop(rid, None)
+        if root is not None:
+            root.close(status=status, hops=self._hop_seq.get(rid, 0))
+        self._trace_ids.pop(rid, None)
+        self._hop_seq.pop(rid, None)
+
     def _submit_to(self, replica, request: Request) -> bool:
-        ok = replica.submit(request)
+        rid = request.id
+        root = self._root_span.get(rid)
+        if root is None:
+            root = trace.start_span("cluster.request", rid=rid,
+                                    trace_id=request.trace_id)
+            self._root_span[rid] = root
+        ok = replica.submit(request, parent_span=root.span_id)
         if not ok:
             return False
-        rid = request.id
         self._owner[rid] = replica
         self._requests[rid] = request
         self._submit_t[rid] = self.clock()
@@ -300,12 +363,27 @@ class Router:
         self._results.pop(rid, None)
         self.placements[replica.replica_id] += 1
         self._m_placements.inc(replica=replica.replica_id)
-        trace.point("cluster.place", rid=rid,
+        hop = self._hop(rid)
+        trace.point("cluster.place", parent=root.span_id, rid=rid,
                     replica=replica.replica_id,
-                    attempt=self._attempts[rid])
+                    attempt=self._attempts[rid],
+                    trace_id=request.trace_id, hop=hop)
         self._log(event="cluster_place", id=rid,
                   replica=replica.replica_id,
-                  attempt=self._attempts[rid])
+                  attempt=self._attempts[rid],
+                  trace_id=request.trace_id, hop=hop)
+        if (self._rollout is not None
+                and replica is self._rollout["canary"]):
+            # canary assignment is a hop of its own: the divergence
+            # watchdog and the merged timeline both need to know WHICH
+            # requests rode the candidate weights
+            chop = self._hop(rid)
+            trace.point("cluster.canary", parent=root.span_id, rid=rid,
+                        replica=replica.replica_id,
+                        trace_id=request.trace_id, hop=chop)
+            self._log(event="cluster_canary", id=rid,
+                      replica=replica.replica_id,
+                      trace_id=request.trace_id, hop=chop)
         return True
 
     def submit(self, request: Request) -> bool:
@@ -321,6 +399,7 @@ class Router:
             # id colliding with an in-flight hedge copy's would be
             # silently renamed by the first-result-wins mapping
             raise ValueError(f"request id {request.id!r} already used")
+        request = self._trace_context(request)
         self._maybe_handoff(request)
         target = self._place(request)
         if target is None:
@@ -341,9 +420,12 @@ class Router:
                     trace_id=request.trace_id)
                 self.cluster_sheds += 1
                 trace.point("cluster.shed", rid=request.id,
+                            trace_id=request.trace_id,
                             reason="no_live_replica")
                 self._log(event="cluster_shed", id=request.id,
+                          trace_id=request.trace_id,
                           reason="no_live_replica")
+                self._finalize_trace(request.id, "shed")
                 if self.slo is not None and self.slo.has("error_rate"):
                     self.slo.record("error_rate", ok=False)
                 return False
@@ -354,9 +436,16 @@ class Router:
                 # answer, not a queue race to wait out
                 self._results[request.id] = Result(
                     id=request.id, tokens=[], status="shed",
-                    finish_reason="shed")
+                    finish_reason="shed",
+                    trace_id=request.trace_id)
                 self.cluster_sheds += 1
-                trace.point("cluster.shed", rid=request.id)
+                trace.point("cluster.shed", rid=request.id,
+                            trace_id=request.trace_id,
+                            reason="all_shedding")
+                self._log(event="cluster_shed", id=request.id,
+                          trace_id=request.trace_id,
+                          reason="all_shedding")
+                self._finalize_trace(request.id, "shed")
                 if self.slo is not None and self.slo.has("error_rate"):
                     # a cluster-wide shed IS the fleet failing its
                     # users, even though each replica sheds by design
@@ -410,11 +499,14 @@ class Router:
         self._handed_off.add(request.id)
         self.handoffs.append(rec)
         self._m_handoffs.inc()
-        trace.point("cluster.handoff", **rec)
+        hop = self._hop(request.id)
+        trace.point("cluster.handoff", trace_id=request.trace_id,
+                    hop=hop, **rec)
         self._log(event="cluster_handoff", id=rec["rid"],
                   replica=rec["replica"],
                   prefix_tokens=rec["prefix_tokens"],
-                  cached=rec["cached"])
+                  cached=rec["cached"],
+                  trace_id=request.trace_id, hop=hop)
 
     # -- the step loop ----------------------------------------------------
 
@@ -447,6 +539,8 @@ class Router:
             self.slo.evaluate()
         if self.autoscaler is not None:
             self._autoscale()
+        if self.watchdog is not None:
+            self.watchdog.check()
         return out
 
     def _record(self, replica, result: Result) -> list[Result]:
@@ -468,6 +562,7 @@ class Router:
         self._owner.pop(rid, None)
         self._requests.pop(rid, None)
         self._submit_t.pop(rid, None)
+        self._finalize_trace(rid, result.status)
         if self.slo is not None:
             if result.ttft_ms is not None and self.slo.has("ttft"):
                 self.slo.observe("ttft", result.ttft_ms / 1e3)
@@ -582,7 +677,12 @@ class Router:
             target = min(others,
                          key=lambda r: self._score(r, r.health()))
             copy = dataclasses.replace(request, id=hid)
-            if not target.submit(copy):
+            # the copy decodes under the ORIGINAL's hop context: its
+            # serve.request span parents under the same cluster.request
+            # root, so the merged tree shows both carriers of one rid
+            root = self._root_span.get(rid)
+            pspan = root.span_id if root is not None else None
+            if not target.submit(copy, parent_span=pspan):
                 continue
             self._hedges[copy.id] = rid
             self._hedge_target[copy.id] = target
@@ -590,10 +690,13 @@ class Router:
             self._attempts[rid] = self._attempts.get(rid, 0) + 1
             self.hedges_sent += 1
             self._m_hedges.inc()
-            trace.point("cluster.hedge", rid=rid,
-                        replica=target.replica_id)
+            hop = self._hop(rid)
+            trace.point("cluster.hedge", parent=pspan, rid=rid,
+                        replica=target.replica_id,
+                        trace_id=request.trace_id, hop=hop)
             self._log(event="cluster_hedge", id=rid,
-                      replica=target.replica_id)
+                      replica=target.replica_id,
+                      trace_id=request.trace_id, hop=hop)
 
     # -- elasticity (serve/cluster/autoscaler.py) -------------------------
 
@@ -718,6 +821,7 @@ class Router:
             self._results.pop(rid, None)
             self._pending_migration.append(req)
             self._migrating_from[rid] = rep
+            self._migration_src[rid] = rep.replica_id
             moved.append(rid)
         # 2. running slots move live. quiesce() first: it collects the
         # in-flight decode window without dispatching another, which is
@@ -752,6 +856,7 @@ class Router:
                     self._results.pop(rid, None)
                     self._pending_migration.append(req)
                     self._migrating_from[rid] = rep
+                    self._migration_src[rid] = rep.replica_id
                     moved.append(rid)
                     continue
                 self._owner[rid] = target
@@ -767,12 +872,19 @@ class Router:
                     {"rid": rid, "from": rep.replica_id,
                      "to": target.replica_id})
                 self._m_slot_migrations.inc()
-                trace.point("cluster.slot_migrate", rid=rid,
-                            src=rep.replica_id,
-                            dst=target.replica_id)
+                tid = self._trace_ids.get(rid)
+                hop = self._hop(rid)
+                root = self._root_span.get(rid)
+                trace.point("cluster.slot_migrate",
+                            parent=(root.span_id if root is not None
+                                    else None),
+                            rid=rid, src=rep.replica_id,
+                            dst=target.replica_id,
+                            trace_id=tid, hop=hop)
                 self._log(event="cluster_slot_migrate", id=rid,
                           src=rep.replica_id,
-                          dst=target.replica_id)
+                          dst=target.replica_id,
+                          trace_id=tid, hop=hop)
                 moved.append(rid)
         self._place_migrations()
         return moved
@@ -857,9 +969,11 @@ class Router:
                     id=orig, tokens=[], status="error",
                     finish_reason="error",
                     error=f"replica {replica.replica_id} died holding "
-                          f"the hedge copy of an already-lost request")
+                          f"the hedge copy of an already-lost request",
+                    trace_id=self._trace_ids.get(orig))
                 self._results[orig] = lost
                 self._out_of_band.append(lost)
+                self._finalize_trace(orig, "error")
         # terminal results the dying tick already finalized (an
         # engine-failure tick salvages completed entries with their
         # true statuses — api.step's pop_failed path) are real answers;
@@ -915,10 +1029,12 @@ class Router:
                     self._results[req.id] = lost
                     self._out_of_band.append(lost)
                     self._owner.pop(req.id, None)
+                    self._finalize_trace(req.id, "error")
                     continue
                 self._owner.pop(req.id, None)
                 self._results.pop(req.id, None)
                 self._pending_migration.append(req)
+                self._migration_src[req.id] = replica.replica_id
                 migrated.append(req.id)
         else:
             # no WAL: the in-flight requests are honestly lost —
@@ -934,9 +1050,11 @@ class Router:
                     id=rid, tokens=[], status="error",
                     finish_reason="error",
                     error=f"replica {replica.replica_id} died "
-                          f"without a journal")
+                          f"without a journal",
+                    trace_id=self._trace_ids.get(rid))
                 self._results[rid] = lost
                 self._out_of_band.append(lost)
+                self._finalize_trace(rid, "error")
         self._place_migrations()
         return migrated
 
@@ -947,6 +1065,11 @@ class Router:
         behind each other)."""
         while self._pending_migration:
             req = self._pending_migration[0]
+            # a journal-recovered (or direct-submitted) request may not
+            # have crossed submit(): adopt its WAL trace_id into the
+            # router's context — failover must keep the original
+            # identity, never mint a new one
+            req = self._trace_context(req)
             target = self._place(req)
             if target is None or not self._submit_to(target, req):
                 return
@@ -966,11 +1089,17 @@ class Router:
                                     "replica": target.replica_id,
                                     "trace_id": req.trace_id})
             self._m_migrations.inc()
-            trace.point("cluster.migrate", rid=req.id,
-                        replica=target.replica_id,
-                        trace_id=req.trace_id)
+            src_id = self._migration_src.pop(req.id, None)
+            hop = self._hop(req.id)
+            root = self._root_span.get(req.id)
+            trace.point("cluster.migrate",
+                        parent=(root.span_id if root is not None
+                                else None),
+                        rid=req.id, replica=target.replica_id,
+                        src=src_id, trace_id=req.trace_id, hop=hop)
             self._log(event="cluster_migrate", id=req.id,
-                      replica=target.replica_id, trace_id=req.trace_id)
+                      replica=target.replica_id, src=src_id,
+                      trace_id=req.trace_id, hop=hop)
 
     # -- weight rollout (checkpoint/rollout.py at fleet scope) ------------
 
@@ -1096,6 +1225,14 @@ class Router:
         return verdict
 
     # -- lifecycle / observability ----------------------------------------
+
+    @property
+    def rollout_canary(self):
+        """The open rollout's canary replica, or None — the read the
+        canary-divergence watchdog (and an operator poll) uses without
+        reaching into the rollout dict."""
+        return (None if self._rollout is None
+                else self._rollout["canary"])
 
     def close(self) -> None:
         """Shut every replica down (journals flushed); the router's

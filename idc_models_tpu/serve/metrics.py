@@ -73,6 +73,14 @@ class ServingMetrics:
             "serve_tokens_emitted_total", "decode tokens emitted")
         self._m_ttft = reg.histogram(
             "serve_ttft_seconds", "submit -> first token")
+        # inter-token latency (ISSUE 20): the request's mean seconds
+        # per decoded token after the first — TTFT covers the prefill
+        # side of the latency SLO, this histogram covers the decode
+        # side (its p95 is what the fleet view alerts on)
+        self._m_itl = reg.histogram(
+            "serve_itl_seconds",
+            "per-request mean inter-token latency (decode seconds "
+            "per token after the first)")
         self._m_queue = reg.gauge(
             "serve_queue_depth", "admission queue depth (last cycle)")
         self._m_occ = reg.gauge(
@@ -317,7 +325,9 @@ class ServingMetrics:
         self.tokens_out += n_tokens
         self._t_last = t
         if n_tokens > 1 and decode_s > 0:
-            self.token_s.append(decode_s / (n_tokens - 1))
+            itl = decode_s / (n_tokens - 1)
+            self.token_s.append(itl)
+            self._m_itl.observe(itl)
         self._log(event="serve_finish", id=rid, tokens=n_tokens,
                   reason=reason,
                   ttft_ms=None if ttft_s is None else ttft_s * 1e3)
@@ -606,6 +616,10 @@ class ServingMetrics:
             "serve_prefill_ms_p50": _r(_pct(self.prefill_s, 50), 1e3),
             "serve_prefill_ms_p95": _r(_pct(self.prefill_s, 95), 1e3),
             "serve_token_ms_p50": _r(_pct(self.token_s, 50), 1e3),
+            # decode-side tail: p95 inter-token latency (additive key,
+            # ISSUE 20) — the fleet SLO reads this side of the request,
+            # TTFT the prefill side
+            "serve_token_ms_p95": _r(_pct(self.token_s, 95), 1e3),
             "serve_slot_occupancy": (
                 round(float(np.mean(self.occupancies)), 4)
                 if self.occupancies else None),
@@ -746,12 +760,13 @@ def aggregate_summaries(metrics_list) -> dict:
     latest last-finish across the fleet: the wall-clock window a user
     of the whole cluster actually experienced."""
     metrics_list = list(metrics_list)
-    ttft, queue_wait = [], []
+    ttft, queue_wait, itl = [], [], []
     tokens = finished = rejected = timed_out = shed = 0
     t_first, t_last = None, None
     for m in metrics_list:
         ttft.extend(m.ttft_s)
         queue_wait.extend(m.queue_wait_s)
+        itl.extend(m.token_s)
         tokens += m.tokens_out
         finished += m.finished
         rejected += m.rejected
@@ -777,4 +792,7 @@ def aggregate_summaries(metrics_list) -> dict:
         "cluster_ttft_ms_p50": _r(_pct(ttft, 50), 1e3),
         "cluster_ttft_ms_p95": _r(_pct(ttft, 95), 1e3),
         "cluster_queue_wait_ms_p95": _r(_pct(queue_wait, 95), 1e3),
+        # pooled decode-side tail (additive, ISSUE 20): p95 of the
+        # per-request mean inter-token latencies across the fleet
+        "cluster_itl_ms_p95": _r(_pct(itl, 95), 1e3),
     }

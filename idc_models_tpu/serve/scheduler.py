@@ -75,6 +75,11 @@ class Entry:
     eos_id: int | None = None
     rng: object = None               # per-request sampling key
     trace_id: str | None = None      # assigned at submit if not given
+    # the cluster hop context (ISSUE 20): the router's cluster.request
+    # root span id, threaded down so this request's serve.request span
+    # opens as its CHILD and the cross-replica export stitches into one
+    # tree. None = no router above (a direct server submit).
+    parent_span: object = None
     # request-lifecycle span handles (observe/trace.py DETACHED spans —
     # they outlive any one tick, so they never sit on a thread's
     # open-span stack): the whole submit->finish interval, and the
@@ -403,7 +408,9 @@ class Scheduler:
         # the request's full timeline.
         tkw = ({"tenant": entry.tenant}
                if entry.tenant is not None else {})
-        entry.span = trace.start_span("serve.request", rid=entry.rid,
+        entry.span = trace.start_span("serve.request",
+                                      parent=entry.parent_span,
+                                      rid=entry.rid,
                                       trace_id=entry.trace_id, **tkw)
         entry.queue_span = trace.start_span(
             "serve.queued", parent=entry.span.span_id, rid=entry.rid,

@@ -25,6 +25,7 @@ import numpy as np
 import pytest
 
 from idc_models_tpu.models.lm import Generator, attention_lm
+from idc_models_tpu.observe import JsonlLogger
 from idc_models_tpu.serve import (
     PrefixRegistry, Request, RetryPolicy, Router, build_replica,
 )
@@ -291,6 +292,65 @@ def test_kill_drill_migrates_journal_bit_identical(devices, params,
         assert fins[0][1]["status"] == "ok"
 
 
+def test_failover_keeps_trace_id_in_merged_timeline(devices, params,
+                                                    tmp_path):
+    """ISSUE 20 satellite: a journal-recovered request keeps its
+    ORIGINAL trace_id across the crash boundary, and merging the
+    router's and both replicas' jsonl files renders one timeline in
+    which the failover re-placement (`cluster_migrate`, stamped with
+    the dead source replica) is just another hop under that same
+    trace_id."""
+    from idc_models_tpu.observe.stats import (
+        format_request_timeline, summarize_jsonl,
+    )
+
+    logs = [JsonlLogger(tmp_path / f"{n}.jsonl")
+            for n in ("router", "r0", "r1")]
+    reps = [_replica(params, f"r{i}", device=devices[i],
+                     logger=logs[1 + i],
+                     journal_path=str(tmp_path / f"j{i}.jsonl"))
+            for i in range(2)]
+    router = Router(reps, logger=logs[0])
+    reqs = _requests(6, seed=9, budget=8)
+    for q in reqs:
+        assert router.submit(q)
+    for _ in range(2):
+        router.step()
+    migrated = router.kill_replica("r0")
+    assert migrated, "the kill must strand journaled work"
+    router.drain()
+    rid = migrated[0]
+    got = router.poll(rid)
+    assert got is not None and got.status == "ok"
+    assert got.trace_id
+    for lg in logs:
+        lg.close()
+    merged = summarize_jsonl([lg.path for lg in logs])
+    tl = merged["requests"][rid]
+    whats = [e["what"] for e in tl]
+    # place on the victim ... failover hop ... finish on the survivor
+    assert whats.index("cluster_place") < whats.index("cluster_migrate")
+    assert whats.index("cluster_migrate") < whats.index("serve_finish")
+    mig = next(e for e in tl if e["what"] == "cluster_migrate")
+    assert mig["detail"]["src"] == "r0"
+    assert mig["detail"]["replica"] == "r1"
+    # ONE lifecycle identity: every router hop and the Result agree
+    tids = {e["detail"]["trace_id"] for e in tl
+            if e["what"].startswith("cluster_")}
+    assert tids == {got.trace_id}
+    assert "cluster_migrate" in format_request_timeline(merged, rid)
+    # the frozen failover-hop schemas
+    recs = [json.loads(line) for lg in logs
+            for line in lg.path.read_text().splitlines()]
+    assert {frozenset(r) for r in recs
+            if r.get("event") == "cluster_migrate"} == {frozenset(
+                {"ts", "event", "id", "replica", "src", "trace_id",
+                 "hop"})}
+    assert {frozenset(r) for r in recs
+            if r.get("event") == "cluster_replica_dead"} == {frozenset(
+                {"ts", "event", "replica", "error"})}
+
+
 def test_drain_completes_in_flight_work(devices, params):
     """Draining a replica finishes what it holds (no migration, no
     loss) while new work routes around it."""
@@ -392,7 +452,8 @@ def test_no_decode_capable_replica_raises_not_spins(devices, params,
 
 
 def test_hedge_first_result_wins_and_survives_owner_death(devices,
-                                                          params):
+                                                          params,
+                                                          tmp_path):
     """Straggler hedging: past hedge_after_s the request is duplicated
     onto the other replica; when the ORIGINAL owner then dies without
     a journal, the hedge copy answers under the original id (review
@@ -400,7 +461,9 @@ def test_hedge_first_result_wins_and_survives_owner_death(devices,
     still running) — and the result is the bit-identical stream."""
     t = [0.0]
     reps = [_replica(params, f"r{i}") for i in range(2)]
-    router = Router(reps, hedge_after_s=0.5, clock=lambda: t[0])
+    log = JsonlLogger(tmp_path / "hedge.jsonl")
+    router = Router(reps, hedge_after_s=0.5, clock=lambda: t[0],
+                    logger=log)
     q = Request(id="h", prompt=(1, 2, 3), max_new_tokens=6)
     assert router.submit(q)
     owner = router._owner["h"]
@@ -415,6 +478,14 @@ def test_hedge_first_result_wins_and_survives_owner_death(devices,
     # exactly one Result surfaced for the rid — no spurious loss
     assert [r.id for r in out + router.results()].count("h") <= 2
     assert router.poll("h#h") is None   # the copy never leaks its id
+    # the hedge hop joins the trace chain with a frozen schema
+    log.close()
+    hedges = [json.loads(line)
+              for line in log.path.read_text().splitlines()
+              if json.loads(line).get("event") == "cluster_hedge"]
+    assert hedges and {frozenset(r) for r in hedges} == {frozenset(
+        {"ts", "event", "id", "replica", "trace_id", "hop"})}
+    assert hedges[0]["id"] == "h"
 
 
 def test_journalless_death_returns_error_results(devices, params):

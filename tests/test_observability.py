@@ -298,8 +298,9 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
     from idc_models_tpu.serve.metrics import ServingMetrics
 
     log = tmp_path / "serve.jsonl"
+    reg = MetricsRegistry()
     with JsonlLogger(log) as logger:
-        m = ServingMetrics(logger, registry=MetricsRegistry())
+        m = ServingMetrics(logger, registry=reg)
         m.on_submit("r0", 10.0)
         m.on_reject("r1", 10.1)
         m.on_admit("r0", 0.02)
@@ -369,7 +370,9 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
               "serve_ttft_ms_p50", "serve_ttft_ms_p95",
               "serve_queue_wait_ms_p50", "serve_queue_wait_ms_p95",
               "serve_prefill_ms_p50", "serve_prefill_ms_p95",
-              "serve_token_ms_p50", "serve_slot_occupancy",
+              # ISSUE-20 additive ITL tail next to the existing p50
+              "serve_token_ms_p50", "serve_token_ms_p95",
+              "serve_slot_occupancy",
               "serve_queue_depth_mean", "serve_queue_depth_max",
               "serve_window_tokens_mean",
               "serve_prefill_stall_ms_mean",
@@ -405,6 +408,15 @@ def test_serving_metrics_jsonl_schema_unchanged(tmp_path):
     assert s["serve_kv_resident_bytes_peak"] == 40960
     assert s["serve_kv_tokens_per_hbm_byte"] == round(150 / 40960, 6)
     assert s["serve_page_exhaustions"] == 1
+    # ISSUE-20: inter-token latency rides next to TTFT — a histogram
+    # on the registry (the fleet view merges its state) and a p95
+    # summary tail. One finish, 3 tokens over 0.1s decode: the mean
+    # ITL is 0.1 / 2 = 50ms.
+    assert s["serve_token_ms_p95"] == 50.0
+    itl = reg.get("serve_itl_seconds")
+    assert itl is not None and itl.kind == "histogram"
+    (_, st), = itl._series()
+    assert st["count"] == 1 and abs(st["sum"] - 0.05) < 1e-9
 
 
 def test_fed_driver_round_health_schema_unchanged(tmp_path):
@@ -637,6 +649,66 @@ def test_bench_compare_flags_directional_regressions(tmp_path):
         assert f"`{key}`" in docs, (
             f"bench_compare headline key {key!r} missing from "
             f"docs/BENCHMARKS.md")
+
+
+def test_bench_keys_all_classified_directional_or_neutral():
+    """ISSUE-20 satellite: every constant key a bench_* function returns
+    must be classified — either in a direction table (and therefore
+    documented, via the gate above) or in bench.NEUTRAL_KEYS with a
+    rationale.  A new bench metric that lands unclassified fails here
+    instead of silently dropping out of bench_compare; a NEUTRAL_KEYS
+    entry whose bench went away fails the stale check."""
+    import ast
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    repo = _Path(__file__).parent.parent
+    _sys.path.insert(0, str(repo))
+    try:
+        import bench
+    finally:
+        _sys.path.pop(0)
+
+    tree = ast.parse((repo / "bench.py").read_text())
+    emitted = set()
+    for fn in ast.walk(tree):
+        if not (isinstance(fn, ast.FunctionDef)
+                and fn.name.startswith("bench_")):
+            continue
+        # dict literals assigned to a local that is later returned count
+        # the same as a literal `return {...}`
+        assigned: dict[str, ast.Dict] = {}
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Dict)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                assigned[node.targets[0].id] = node.value
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return):
+                continue
+            val = node.value
+            if isinstance(val, ast.Name):
+                val = assigned.get(val.id)
+            if not isinstance(val, ast.Dict):
+                continue
+            for key in val.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                        key.value, str):
+                    emitted.add(key.value)
+    assert len(emitted) > 100, "bench.py key scan came back implausibly thin"
+
+    directional = set(bench.HIGHER_IS_BETTER) | set(bench.LOWER_IS_BETTER)
+    neutral = set(bench.NEUTRAL_KEYS)
+    assert not (directional & neutral), sorted(directional & neutral)
+    unclassified = emitted - directional - neutral
+    assert not unclassified, (
+        f"bench keys missing a direction (add to HIGHER_IS_BETTER / "
+        f"LOWER_IS_BETTER + docs, or to NEUTRAL_KEYS): "
+        f"{sorted(unclassified)}")
+    stale = neutral - emitted
+    assert not stale, (
+        f"NEUTRAL_KEYS entries no bench emits any more: {sorted(stale)}")
 
 
 def test_profile_program_jsonl_schema_frozen(tmp_path, devices):
@@ -927,3 +999,187 @@ def test_checkpoint_rollout_jsonl_schemas_frozen(tmp_path, devices):
     assert st["rollouts"][-1]["outcome"] == "promoted"
     rendered = format_summary(st)
     assert "checkpoints:" in rendered and "rollouts" in rendered
+
+
+# -- ISSUE 20: every emitted event name is pinned or allowlisted ------------
+
+
+def test_prefix_and_compile_cache_event_schemas_frozen(tmp_path):
+    """The remaining serve-side cache events, frozen from their first
+    pinning: prefix hit/miss/evict, the cluster-registry adoption
+    marker, and the compile-cache epilogue snapshot (whose payload IS
+    `CompileCache.summary()` — one source of truth for both)."""
+    from idc_models_tpu.serve.compile_cache import CompileCache
+    from idc_models_tpu.serve.metrics import ServingMetrics
+    from idc_models_tpu.serve.prefix_cache import PrefixCache
+    from idc_models_tpu.serve.cluster import PrefixRegistry
+
+    log = tmp_path / "cache.jsonl"
+    chunk = 4
+    snap = lambda: {"k": np.zeros((chunk, 4), np.float32)}
+    logits = np.zeros(4, np.float32)
+    shared = PrefixRegistry(chunk, 1 << 20)
+    with JsonlLogger(log) as logger:
+        # a sibling cache publishes a prefix into the cluster registry
+        feeder = PrefixCache(chunk, 1 << 20, shared=shared)
+        assert feeder.insert(np.arange(chunk), snap(), logits)
+        # budget fits ONE snapshot: the second insert LRU-evicts
+        one = PrefixCache(chunk, 96, logger=logger,
+                          registry=MetricsRegistry())
+        assert one.insert(np.arange(chunk), snap(), logits)
+        one.lookup(np.arange(2 * chunk))               # hit
+        assert one.insert(np.arange(chunk) + 1, snap(), logits)
+        one.lookup(np.arange(chunk) + 3)               # miss
+        # an EMPTY local cache adopts the registry's longer prefix
+        adopter = PrefixCache(chunk, 1 << 20, logger=logger,
+                              registry=MetricsRegistry(),
+                              shared=shared)
+        n, caches, _ = adopter.lookup(np.arange(2 * chunk))
+        assert n == chunk and caches is not None
+        cc = CompileCache(tmp_path / "cc")
+        m = ServingMetrics(logger, registry=MetricsRegistry())
+        m.on_compile_cache(cc)
+    recs = [json.loads(l) for l in open(log)]
+    by_event = {}
+    for r in recs:
+        by_event.setdefault(r["event"], set()).add(frozenset(r))
+    assert by_event["serve_prefix_hit"] == {frozenset(
+        {"ts", "event", "prefix_tokens", "prompt_tokens"})}
+    assert by_event["serve_prefix_miss"] == {frozenset(
+        {"ts", "event", "prompt_tokens"})}
+    assert by_event["serve_prefix_evict"] == {frozenset(
+        {"ts", "event", "freed_bytes"})}
+    assert by_event["serve_prefix_shared_hit"] == {frozenset(
+        {"ts", "event", "prefix_tokens", "prompt_tokens"})}
+    assert by_event["serve_compile_cache"] == {frozenset(
+        {"ts", "event"} | set(cc.summary()))}
+
+
+# one contract line per jsonl event name the package can emit — either
+# "pin:" the test that freezes its schema, or "allow:" WHY no frozen
+# per-event schema applies. The scan below fails on any event emitted
+# but missing here (new events must be pinned or documented before
+# they ship) AND on any entry no longer emitted (stale contracts rot).
+EVENT_CONTRACTS = {
+    # serving metrics events (serve/metrics.py)
+    **dict.fromkeys(
+        ["serve_submit", "serve_reject", "serve_admit",
+         "serve_first_token", "serve_finish", "serve_slot_fault",
+         "serve_retry", "serve_shed", "serve_clamp",
+         "serve_fault_injected", "serve_spec_verify",
+         "serve_page_exhausted"],
+        "pin:test_serving_metrics_jsonl_schema_unchanged"),
+    **dict.fromkeys(
+        ["serve_tenant_finish", "serve_tenant_quota_reject",
+         "serve_tenant_shed"],
+        "pin:test_tenant_jsonl_schemas_frozen_from_day_one"),
+    **dict.fromkeys(
+        ["serve_rollout", "ckpt_save", "ckpt_restore"],
+        "pin:test_checkpoint_rollout_jsonl_schemas_frozen"),
+    **dict.fromkeys(
+        ["serve_prefix_hit", "serve_prefix_miss", "serve_prefix_evict",
+         "serve_prefix_shared_hit", "serve_compile_cache"],
+        "pin:test_prefix_and_compile_cache_event_schemas_frozen"),
+    "profile_program": "pin:test_profile_program_jsonl_schema_frozen",
+    "profile_step": "pin:test_profile_step_jsonl_schema_frozen",
+    "fed_cohort": "pin:test_fed_cohort_jsonl_schema_frozen",
+    "round_health": "pin:test_fed_driver_round_health_schema_unchanged",
+    "epoch": "pin:test_fit_epoch_jsonl_schema_unchanged",
+    "metrics_snapshot": "pin:test_registry_jsonl_snapshot_and_stats",
+    # cluster trace-hop + fleet events (ISSUE 20)
+    **dict.fromkeys(
+        ["cluster_place", "cluster_handoff", "cluster_slot_migrate",
+         "cluster_scale_up", "cluster_drain", "cluster_prefix_publish",
+         "autoscale_decision"],
+        "pin:test_fleet_observability.py::"
+        "test_autoscaled_migration_renders_one_merged_timeline"),
+    **dict.fromkeys(
+        ["cluster_canary", "cluster_shed", "cluster_rollout"],
+        "pin:test_fleet_observability.py::"
+        "test_canary_and_shed_events_carry_the_trace_schema"),
+    "cluster_anomaly": (
+        "pin:test_fleet_observability.py::"
+        "test_watchdog_detectors_fire_once_and_stay_silent_when_clean"),
+    **dict.fromkeys(
+        ["cluster_migrate", "cluster_replica_dead"],
+        "pin:test_cluster.py::"
+        "test_failover_keeps_trace_id_in_merged_timeline"),
+    "cluster_hedge": (
+        "pin:test_cluster.py::"
+        "test_hedge_first_result_wins_and_survives_owner_death"),
+    # journal WAL records (serve/journal.py)
+    **dict.fromkeys(
+        ["journal_submit", "journal_finish"],
+        "pin:test_cluster.py::"
+        "test_kill_drill_migrates_journal_bit_identical"),
+    "journal_migrate": "pin:test_elastic.py (drain/migration drills)",
+    "journal_progress": ("pin:test_serve_resilience.py (journal "
+                         "replay drills)"),
+    "compile_cache": "pin:test_elastic.py (warm spin-up drills)",
+    "slo_alert": "pin:test_slo.py",
+    "slo_resolved": "pin:test_slo.py",
+    # dynamic-payload records: their keys are METRIC sets, not fixed
+    # schemas — the corresponding summary-key tests freeze the keys
+    "serve_summary": ("allow: payload is LMServer.summary() — keys "
+                      "frozen by the summary-key assertions in "
+                      "test_serving_metrics_jsonl_schema_unchanged"),
+    "cluster_summary": ("allow: payload is Router.summary() — the "
+                        "cluster rollup keys, asserted in "
+                        "test_cluster.py"),
+    "step": "allow: training-loop record; metric keys are preset-defined",
+    "round": "allow: fed-loop record; metric keys are preset-defined",
+    "val": "allow: eval-loop record; metric keys are preset-defined",
+    "test": "allow: eval-loop record; metric keys are preset-defined",
+    "generate": "allow: sampling demo record (cli), free-form text",
+    "timer": ("allow: {name, seconds} utility record — behavior "
+              "covered by test_timer_routes_through_tracer"),
+}
+
+
+def _emitted_event_names():
+    """AST scan: every constant ``event=`` kwarg passed to a ``.log``
+    or ``._log`` call anywhere in the package."""
+    import ast
+    from pathlib import Path
+
+    import idc_models_tpu
+
+    root = Path(idc_models_tpu.__file__).parent
+    names = set()
+    for p in sorted(root.rglob("*.py")):
+        tree = ast.parse(p.read_text(), filename=str(p))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            attr = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if attr not in ("log", "_log"):
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "event"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    names.add(kw.value.value)
+    return names
+
+
+def test_every_emitted_event_name_is_pinned_or_allowlisted():
+    """The frozen-jsonl discipline, enforced structurally: a NEW event
+    name cannot ship without either a schema-pinning test or a
+    documented allowlist reason, and a contract for an event that no
+    longer exists fails loudly instead of rotting."""
+    emitted = _emitted_event_names()
+    assert emitted, "the scan found no events — scanner broken?"
+    unpinned = emitted - set(EVENT_CONTRACTS)
+    assert not unpinned, (
+        f"events emitted without a schema pin or allowlist entry: "
+        f"{sorted(unpinned)} — add a frozen-schema test (preferred) "
+        f"or a documented allow: entry to EVENT_CONTRACTS")
+    stale = set(EVENT_CONTRACTS) - emitted
+    assert not stale, (
+        f"EVENT_CONTRACTS entries no longer emitted anywhere: "
+        f"{sorted(stale)} — delete them (or the event was renamed "
+        f"without updating its pin)")
+    for name, contract in EVENT_CONTRACTS.items():
+        assert contract.startswith(("pin:", "allow:")), (name, contract)
